@@ -1,0 +1,3 @@
+"""Compatibility / verification layer: torch reference-semantics models used
+for numerical parity tests and honest CPU baselines (torch is CPU-only in this
+environment; it is never on the TPU compute path)."""

@@ -1,0 +1,171 @@
+"""Reference-semantics GGNN in plain PyTorch (CPU).
+
+This module reproduces, without DGL, the exact math of the reference model
+stack — ``dgl.nn.GatedGraphConv`` + ``dgl.nn.GlobalAttentionPooling`` as used
+by ``DDFA/code_gnn/models/flow_gnn/ggnn.py:22-109`` — using dense ops and
+``index_add_`` scatter. It has two jobs:
+
+1. **Numerical parity oracle** for the Flax GGNN (weights are copied across
+   and outputs compared in ``tests/test_ggnn_parity.py``).
+2. **Honest CPU baseline** for ``bench.py``: the reference's own GPU harness
+   cannot run here (no CUDA, no DGL wheel), so the recorded ``vs_baseline``
+   compares our TPU throughput against this same-semantics torch-CPU model.
+
+Written against the published DGL op semantics, not the DGL source.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch import nn
+
+SUBKEYS = ("api", "datatype", "literal", "operator")
+
+
+class TorchGatedGraphConv(nn.Module):
+    """a_v = Σ_{(u,v)∈E} (W h_u + b);  h'_v = GRUCell(a_v, h_v), n_steps times.
+    Input zero-padded from in_feats to out_feats (DGL contract)."""
+
+    def __init__(self, in_feats: int, out_feats: int, n_steps: int):
+        super().__init__()
+        assert in_feats <= out_feats
+        self.in_feats, self.out_feats, self.n_steps = in_feats, out_feats, n_steps
+        self.edge_linear = nn.Linear(out_feats, out_feats)
+        self.gru = nn.GRUCell(out_feats, out_feats)
+
+    def forward(self, h, senders, receivers):
+        n = h.shape[0]
+        if h.shape[1] < self.out_feats:
+            h = torch.cat(
+                [h, torch.zeros(n, self.out_feats - h.shape[1], dtype=h.dtype)], dim=1
+            )
+        for _ in range(self.n_steps):
+            msg = self.edge_linear(h)[senders]
+            agg = torch.zeros_like(h).index_add_(0, receivers, msg)
+            h = self.gru(agg, h)
+        return h
+
+
+class TorchGlobalAttentionPooling(nn.Module):
+    def __init__(self, dim: int):
+        super().__init__()
+        self.gate = nn.Linear(dim, 1)
+
+    def forward(self, h, node_gidx, n_graphs):
+        logits = self.gate(h)[:, 0]
+        # per-graph softmax via stable exp + scatter sums
+        maxes = torch.full((n_graphs,), -torch.inf).index_reduce_(
+            0, node_gidx, logits, "amax", include_self=True
+        )
+        exp = torch.exp(logits - maxes[node_gidx])
+        denom = torch.zeros(n_graphs).index_add_(0, node_gidx, exp)
+        gate = exp / denom[node_gidx]
+        out = torch.zeros(n_graphs, h.shape[1]).index_add_(
+            0, node_gidx, gate[:, None] * h
+        )
+        return out
+
+
+class TorchGGNN(nn.Module):
+    """Same architecture/hparams as ``FlowGNNGGNNModule`` (reference golden
+    config: hidden 32, 5 steps, 3 output layers, concat_all_absdf)."""
+
+    def __init__(
+        self,
+        input_dim: int,
+        hidden_dim: int = 32,
+        n_steps: int = 5,
+        num_output_layers: int = 3,
+        concat_all_absdf: bool = True,
+        encoder_mode: bool = False,
+        label_style: str = "graph",
+    ):
+        super().__init__()
+        self.concat_all_absdf = concat_all_absdf
+        self.encoder_mode = encoder_mode
+        self.label_style = label_style
+        embed_dim = hidden_dim
+        if concat_all_absdf:
+            self.embeddings = nn.ModuleDict(
+                {sk: nn.Embedding(input_dim, embed_dim) for sk in SUBKEYS}
+            )
+            embed_dim *= len(SUBKEYS)
+            hidden_dim *= len(SUBKEYS)
+        else:
+            self.embedding = nn.Embedding(input_dim, embed_dim)
+        self.ggnn = TorchGatedGraphConv(embed_dim, hidden_dim, n_steps)
+        out_in = embed_dim + hidden_dim
+        self.out_dim = out_in
+        if label_style == "graph":
+            self.pooling = TorchGlobalAttentionPooling(out_in)
+        if not encoder_mode:
+            layers = []
+            for i in range(num_output_layers):
+                last = i == num_output_layers - 1
+                layers.append(nn.Linear(out_in, 1 if last else out_in))
+                if not last:
+                    layers.append(nn.ReLU())
+            self.head = nn.Sequential(*layers)
+
+    def forward(self, node_feats: dict, senders, receivers, node_gidx, n_graphs):
+        if self.concat_all_absdf:
+            feat_embed = torch.cat(
+                [
+                    self.embeddings[sk](node_feats[f"_ABS_DATAFLOW_{sk}"])
+                    for sk in SUBKEYS
+                ],
+                dim=1,
+            )
+        else:
+            feat_embed = self.embedding(node_feats["_ABS_DATAFLOW"])
+        ggnn_out = self.ggnn(feat_embed, senders, receivers)
+        out = torch.cat([ggnn_out, feat_embed], dim=-1)
+        if self.label_style == "graph":
+            out = self.pooling(out, node_gidx, n_graphs)
+        if self.encoder_mode:
+            return out
+        return self.head(out)[..., 0]
+
+
+def export_params_to_flax(model: TorchGGNN) -> dict:
+    """Flax param tree (numpy) matching ``deepdfa_tpu.models.ggnn.GGNN``."""
+
+    def lin(mod):
+        return {
+            "kernel": mod.weight.detach().numpy().T,
+            "bias": mod.bias.detach().numpy(),
+        }
+
+    params: dict = {}
+    if model.concat_all_absdf:
+        for sk in SUBKEYS:
+            params[f"embed_{sk}"] = {
+                "embedding": model.embeddings[sk].weight.detach().numpy()
+            }
+    else:
+        params["embed"] = {"embedding": model.embedding.weight.detach().numpy()}
+
+    gru = model.ggnn.gru
+    H = gru.hidden_size
+    w_ih, w_hh = gru.weight_ih.detach().numpy(), gru.weight_hh.detach().numpy()
+    b_ih, b_hh = gru.bias_ih.detach().numpy(), gru.bias_hh.detach().numpy()
+    names = ("r", "z", "n")
+    gru_params = {}
+    for j, g in enumerate(names):
+        gru_params[f"i{g}" if g != "n" else "in"] = {
+            "kernel": w_ih[j * H : (j + 1) * H].T,
+            "bias": b_ih[j * H : (j + 1) * H],
+        }
+        gru_params[f"h{g}"] = {
+            "kernel": w_hh[j * H : (j + 1) * H].T,
+            "bias": b_hh[j * H : (j + 1) * H],
+        }
+    params["ggnn"] = {"edge_linear": lin(model.ggnn.edge_linear), "gru": gru_params}
+
+    if model.label_style == "graph":
+        params["pooling"] = {"gate": lin(model.pooling.gate)}
+    if not model.encoder_mode:
+        dense_layers = [m for m in model.head if isinstance(m, nn.Linear)]
+        for i, m in enumerate(dense_layers):
+            params[f"out_{i}"] = lin(m)
+    return params

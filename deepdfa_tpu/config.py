@@ -1,0 +1,278 @@
+"""Typed configuration for the whole framework.
+
+Replaces two reference mechanisms with structured dataclasses:
+
+- the **feat-string DSL** ``_ABS_DATAFLOW_{subkeys}_all_limitall_{N}_limitsubkeys_{M}``
+  parsed ad hoc at ``DDFA/sastvd/helpers/datasets.py:560-585`` and consumed at
+  ``linevd/datamodule.py:89-93`` / ``flow_gnn/ggnn.py:36-37`` → :class:`FeatureConfig`;
+- **LightningCLI layered YAML + argument links** (``code_gnn/main_cli.py:73-99,315-321``)
+  → :func:`load_config` (later files override earlier ones, dotted CLI overrides)
+  plus explicit derivation properties (:attr:`FeatureConfig.input_dim`,
+  :attr:`ExperimentConfig.input_dim`) in place of instantiation-time links.
+
+Golden values mirror ``DDFA/configs/config_default.yaml`` /
+``config_bigvul.yaml`` / ``config_ggnn.yaml``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+ALL_SUBKEYS = ("api", "datatype", "literal", "operator")
+
+# Subkeys whose per-definition value is single-valued (reference
+# ``datasets.py:550-556``): datatype has exactly one value per def.
+SINGLE_SUBKEYS = {"api": False, "datatype": True, "literal": False, "operator": False}
+
+
+@dataclass(frozen=True)
+class FeatureConfig:
+    """Abstract-dataflow feature vocabulary settings.
+
+    ``input_dim = limit_all + 2`` accounts for the not-a-definition token (0)
+    and the UNKNOWN token, parity with ``linevd/datamodule.py:87-96``.
+    """
+
+    subkeys: tuple[str, ...] = ALL_SUBKEYS
+    limit_subkeys: int | None = 1000
+    limit_all: int | None = 1000
+    combined: bool = True  # the "_all" combined-hash vocabulary
+    include_unknown: bool = False  # "includeunknown" variant
+
+    def __post_init__(self):
+        for k in self.subkeys:
+            if k not in ALL_SUBKEYS:
+                raise ValueError(f"unknown subkey {k!r}")
+
+    @property
+    def input_dim(self) -> int:
+        if not self.combined:
+            raise NotImplementedError("multi-hot (non-combined) features")
+        assert self.limit_all is not None
+        return self.limit_all + 2
+
+    def feat_string(self) -> str:
+        """Render the reference-compatible feat string (for artifact naming
+        and cross-framework comparisons only; never parsed internally)."""
+        parts = ["_ABS_DATAFLOW", *sorted(self.subkeys)]
+        if self.combined:
+            parts.append("all")
+        if self.include_unknown:
+            parts.append("includeunknown")
+        parts += [f"limitall_{self.limit_all}", f"limitsubkeys_{self.limit_subkeys}"]
+        return "_".join(parts)
+
+    @classmethod
+    def from_feat_string(cls, feat: str) -> "FeatureConfig":
+        """Parse a reference feat string (compat shim for reference configs)."""
+
+        def _limit(key: str, default: int | None) -> int | None:
+            if key not in feat:
+                return default
+            start = feat.find(key) + len(key) + 1
+            end = feat.find("_", start)
+            tok = feat[start:] if end == -1 else feat[start:end]
+            return None if tok == "None" else int(tok)
+
+        return cls(
+            subkeys=tuple(k for k in ALL_SUBKEYS if k in feat) or ALL_SUBKEYS,
+            limit_subkeys=_limit("limitsubkeys", 1000),
+            limit_all=_limit("limitall", 1000),
+            combined="all" in feat.split("_"),
+            include_unknown="includeunknown" in feat,
+        )
+
+
+@dataclass(frozen=True)
+class GGNNConfig:
+    """GGNN architecture (golden values: ``configs/config_ggnn.yaml:1-4``)."""
+
+    hidden_dim: int = 32
+    n_steps: int = 5
+    num_output_layers: int = 3
+    label_style: str = "graph"  # graph | node | dataflow_solution_in | dataflow_solution_out
+    concat_all_absdf: bool = True
+    encoder_mode: bool = False
+    dtype: str = "float32"  # compute dtype; bfloat16 for TPU speed runs
+
+    @property
+    def out_dim(self) -> int:
+        """Pooled embedding width: embed + hidden, each ×4 when concatenating
+        all four subkey embeddings (``ggnn.py:47-64``)."""
+        mult = len(ALL_SUBKEYS) if self.concat_all_absdf else 1
+        return 2 * self.hidden_dim * mult
+
+
+@dataclass(frozen=True)
+class BatchConfig:
+    """Static-shape batch budgets (the TPU-critical knobs; no reference
+    equivalent — DGL batched dynamically, XLA cannot)."""
+
+    batch_graphs: int = 256  # graphs per batch (``config_bigvul.yaml`` batch 256)
+    max_nodes: int = 40960  # node budget incl. 1 padding node
+    max_edges: int = 81920  # edge budget
+    drop_oversize: bool = True  # drop graphs that alone exceed the budget
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    dsname: str = "bigvul"
+    sample: bool = False
+    split: str = "fixed"  # fixed | random | linevul-style named splits
+    seed: int = 0
+    undersample: str | None = "v1.0"  # "vX" = X × #vul nonvul kept (``dclass.py:84-105``)
+    oversample: float | None = None
+    batch: BatchConfig = field(default_factory=BatchConfig)
+    feature: FeatureConfig = field(default_factory=FeatureConfig)
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """Golden values from ``configs/config_default.yaml:44-48``."""
+
+    lr: float = 1e-3
+    weight_decay: float = 1e-2
+    max_epochs: int = 25
+    use_weighted_loss: bool = True
+    grad_clip: float | None = None
+    # Node-label training only: keep all vul nodes, sample nonvul nodes to
+    # ``factor × n_vul`` in the loss (``base_module.py:97-137``).
+    undersample_node_on_loss_factor: float | None = None
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh axes. dp×fsdp×tp×sp must equal the device count; -1 on a
+    single axis means "all remaining devices"."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def axis_sizes(self, n_devices: int) -> dict[str, int]:
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = 1
+        for k, v in sizes.items():
+            if v != -1:
+                fixed *= v
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} != {n_devices} devices")
+        return sizes
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    """Parity with ``config_default.yaml:20-31`` + ``periodic_checkpoint.py``."""
+
+    save_best_metric: str = "val_loss"
+    save_best_mode: str = "min"
+    save_last: bool = True
+    periodic_every: int = 25
+    keep: int = 3
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    data: DataConfig = field(default_factory=DataConfig)
+    model: GGNNConfig = field(default_factory=GGNNConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    seed: int = 0
+    run_name: str | None = None
+    profile: bool = False
+    time: bool = False
+
+    @property
+    def input_dim(self) -> int:
+        """Explicit replacement for the LightningCLI data→model argument link
+        (``main_cli.py:95-99``)."""
+        return self.data.feature.input_dim
+
+
+def _to_dict(cfg: Any) -> Any:
+    if dataclasses.is_dataclass(cfg):
+        return {f.name: _to_dict(getattr(cfg, f.name)) for f in dataclasses.fields(cfg)}
+    if isinstance(cfg, (list, tuple)):
+        return [_to_dict(v) for v in cfg]
+    return cfg
+
+
+def to_json(cfg: Any) -> str:
+    return json.dumps(_to_dict(cfg), indent=2, sort_keys=True)
+
+
+def _from_dict(cls: type, data: dict[str, Any]) -> Any:
+    kwargs = {}
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    for key, value in data.items():
+        if key not in fields:
+            raise KeyError(f"{cls.__name__} has no field {key!r}")
+        target = _NESTED.get((cls.__name__, key))
+        if target is not None and isinstance(value, dict):
+            value = _from_dict(target, value)
+        elif key == "subkeys" and isinstance(value, list):
+            value = tuple(value)
+        kwargs[key] = value
+    return cls(**kwargs)
+
+
+_NESTED: dict[tuple[str, str], type] = {
+    ("DataConfig", "batch"): BatchConfig,
+    ("DataConfig", "feature"): FeatureConfig,
+    ("ExperimentConfig", "data"): DataConfig,
+    ("ExperimentConfig", "model"): GGNNConfig,
+    ("ExperimentConfig", "optim"): OptimConfig,
+    ("ExperimentConfig", "mesh"): MeshConfig,
+    ("ExperimentConfig", "checkpoint"): CheckpointConfig,
+}
+
+
+def _deep_merge(base: dict, new: dict) -> dict:
+    out = dict(base)
+    for k, v in new.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def load_config(
+    *paths: str | Path, overrides: dict[str, Any] | None = None
+) -> ExperimentConfig:
+    """Load layered JSON/YAML configs (later files win) with dotted overrides.
+
+    Same layering semantics as the reference's
+    ``--config default --config bigvul --config ggnn`` chain
+    (``DDFA/scripts/train.sh:1``), but type-checked at construction.
+    """
+    merged: dict[str, Any] = {}
+    for p in paths:
+        text = Path(p).read_text()
+        if str(p).endswith((".yaml", ".yml")):
+            import yaml
+
+            data = yaml.safe_load(text)
+        else:
+            data = json.loads(text)
+        merged = _deep_merge(merged, data or {})
+    for dotted, value in (overrides or {}).items():
+        cursor = merged
+        *parents, leaf = dotted.split(".")
+        for part in parents:
+            cursor = cursor.setdefault(part, {})
+        cursor[leaf] = value
+    return _from_dict(ExperimentConfig, merged)

@@ -1,0 +1,1 @@
+"""Data layer: graph containers/batching, dataset readers, vocab, sampling."""

@@ -1,0 +1,275 @@
+"""Fixed-shape batched graph container and host-side batcher.
+
+Replaces the reference's DGL graph batching (``dgl.batch`` collate in
+``GraphDataLoader``, ``linevd/datamodule.py:110-141``, and the ``graphs.bin``
+serialization of ``sastvd/scripts/dbize_graphs.py:20-33``) with an
+XLA-friendly design:
+
+- :class:`BatchedGraphs` — flat arrays with **static shapes**: every batch in a
+  bucket has exactly ``max_nodes`` nodes, ``max_edges`` edges and
+  ``max_graphs`` graph slots; real entries are marked by masks.
+- Padding convention: the **last graph slot(s)** own all padding nodes; padding
+  edges are self-loops on the last (padding) node. Segment reductions therefore
+  dump padding contributions into padding slots that masks exclude — no
+  device-side filtering needed.
+- :func:`batch_np` — host-side (numpy) packer: concatenate graphs with node
+  offsets, then pad to the bucket budget.
+- :class:`GraphBatcher` — greedy packer over a dataset producing fixed-shape
+  batches under (graphs, nodes, edges) budgets, with optional multi-bucket
+  support to bound padding waste at a bounded number of XLA compilations.
+
+Serialization: ``save_shards``/``load_shards`` store per-graph arrays in
+``.npz`` shards (replacing DGL's ``graphs.bin``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, NamedTuple, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Graph",
+    "BatchedGraphs",
+    "batch_np",
+    "GraphBatcher",
+    "BucketSpec",
+    "save_shards",
+    "load_shards",
+]
+
+
+@dataclasses.dataclass
+class Graph:
+    """A single (host-side, numpy) graph.
+
+    ``node_feats`` values are ``[n_nodes, ...]`` arrays; integer feature ids,
+    labels (``_VULN``), dataflow bit-vectors etc. all live here.
+    """
+
+    senders: np.ndarray  # [n_edges] int32, source node index
+    receivers: np.ndarray  # [n_edges] int32
+    node_feats: dict[str, np.ndarray]
+    gid: int = -1  # dataset graph id (Big-Vul function id); host-side only
+
+    @property
+    def n_nodes(self) -> int:
+        for v in self.node_feats.values():
+            return int(v.shape[0])
+        if self.senders.size == 0:
+            return 0
+        return int(max(self.senders.max(), self.receivers.max()) + 1)
+
+    @property
+    def n_edges(self) -> int:
+        return int(self.senders.shape[0])
+
+    def with_self_loops(self) -> "Graph":
+        """Append one self-loop per node (parity with ``dbize_graphs.py:26``,
+        which calls ``dgl.add_self_loop``); required by GGNN message passing so
+        every node sees its own state."""
+        n = self.n_nodes
+        loop = np.arange(n, dtype=np.int32)
+        return dataclasses.replace(
+            self,
+            senders=np.concatenate([self.senders.astype(np.int32), loop]),
+            receivers=np.concatenate([self.receivers.astype(np.int32), loop]),
+        )
+
+
+class BatchedGraphs(NamedTuple):
+    """Device-ready batch. All shapes static within a bucket.
+
+    node_feats: dict of ``[max_nodes, ...]`` arrays.
+    senders/receivers: ``[max_edges]`` int32 into the node axis.
+    node_gidx: ``[max_nodes]`` int32 graph slot of each node.
+    node_mask / edge_mask / graph_mask: bool validity masks.
+    """
+
+    node_feats: dict
+    senders: np.ndarray
+    receivers: np.ndarray
+    node_gidx: np.ndarray
+    node_mask: np.ndarray
+    edge_mask: np.ndarray
+    graph_mask: np.ndarray
+
+    @property
+    def max_nodes(self) -> int:
+        return self.node_gidx.shape[0]
+
+    @property
+    def max_graphs(self) -> int:
+        return self.graph_mask.shape[0]
+
+
+def batch_np(
+    graphs: Sequence[Graph],
+    max_graphs: int,
+    max_nodes: int,
+    max_edges: int,
+    extra_feat_pad: dict[str, float] | None = None,
+) -> BatchedGraphs:
+    """Concatenate ``graphs`` and pad to the static budget (numpy, host-side).
+
+    Requires ``sum(n_nodes) <= max_nodes - 1`` (one node reserved for edge
+    padding) and ``len(graphs) <= max_graphs - 1`` (one slot reserved as the
+    padding graph).
+    """
+    n_real = len(graphs)
+    tot_nodes = sum(g.n_nodes for g in graphs)
+    tot_edges = sum(g.n_edges for g in graphs)
+    if n_real > max_graphs - 1:
+        raise ValueError(f"{n_real} graphs > budget {max_graphs - 1}")
+    if tot_nodes > max_nodes - 1:
+        raise ValueError(f"{tot_nodes} nodes > budget {max_nodes - 1}")
+    if tot_edges > max_edges:
+        raise ValueError(f"{tot_edges} edges > budget {max_edges}")
+
+    senders = np.full(max_edges, max_nodes - 1, dtype=np.int32)
+    receivers = np.full(max_edges, max_nodes - 1, dtype=np.int32)
+    node_gidx = np.full(max_nodes, max_graphs - 1, dtype=np.int32)
+
+    node_off = 0
+    edge_off = 0
+    for gi, g in enumerate(graphs):
+        nn, ne = g.n_nodes, g.n_edges
+        senders[edge_off : edge_off + ne] = g.senders + node_off
+        receivers[edge_off : edge_off + ne] = g.receivers + node_off
+        node_gidx[node_off : node_off + nn] = gi
+        node_off += nn
+        edge_off += ne
+
+    node_feats: dict[str, np.ndarray] = {}
+    keys = graphs[0].node_feats.keys() if graphs else ()
+    pad_values = extra_feat_pad or {}
+    for key in keys:
+        parts = [g.node_feats[key] for g in graphs]
+        sample = parts[0]
+        shape = (max_nodes,) + sample.shape[1:]
+        out = np.full(shape, pad_values.get(key, 0), dtype=sample.dtype)
+        cat = np.concatenate(parts, axis=0)
+        out[: cat.shape[0]] = cat
+        node_feats[key] = out
+
+    node_mask = np.arange(max_nodes) < tot_nodes
+    edge_mask = np.arange(max_edges) < tot_edges
+    graph_mask = np.arange(max_graphs) < n_real
+    return BatchedGraphs(
+        node_feats=node_feats,
+        senders=senders,
+        receivers=receivers,
+        node_gidx=node_gidx,
+        node_mask=node_mask,
+        edge_mask=edge_mask,
+        graph_mask=graph_mask,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    max_graphs: int
+    max_nodes: int
+    max_edges: int
+
+    def fits(self, n_graphs: int, n_nodes: int, n_edges: int) -> bool:
+        return (
+            n_graphs <= self.max_graphs - 1
+            and n_nodes <= self.max_nodes - 1
+            and n_edges <= self.max_edges
+        )
+
+
+class GraphBatcher:
+    """Greedy fixed-shape packer.
+
+    Packs graphs in the given order until the next graph would exceed the
+    bucket budget, then emits a padded :class:`BatchedGraphs`. With multiple
+    buckets, each emitted batch uses the smallest bucket that fits, bounding
+    both padding waste and the number of distinct compiled shapes.
+
+    This is the XLA replacement for per-epoch dynamic ``dgl.batch`` collate;
+    per-epoch undersampling composes with it by re-ordering/re-selecting the
+    graph list host-side each epoch (see ``data/sampler.py``).
+    """
+
+    def __init__(self, buckets: Sequence[BucketSpec], drop_oversize: bool = True):
+        if not buckets:
+            raise ValueError("need at least one bucket")
+        self.buckets = sorted(buckets, key=lambda b: (b.max_nodes, b.max_edges, b.max_graphs))
+        self.big = self.buckets[-1]
+        self.drop_oversize = drop_oversize
+        self.n_dropped = 0
+
+    def batches(self, graphs: Sequence[Graph]) -> Iterator[BatchedGraphs]:
+        self.n_dropped = 0  # per-pass count (batches() is re-run every epoch)
+        pending: list[Graph] = []
+        nn = ne = 0
+        for g in graphs:
+            if not self.big.fits(1, g.n_nodes, g.n_edges):
+                if self.drop_oversize:
+                    self.n_dropped += 1
+                    continue
+                raise ValueError(
+                    f"graph gid={g.gid} ({g.n_nodes} nodes, {g.n_edges} edges) "
+                    f"exceeds the largest bucket {self.big}"
+                )
+            if pending and not self.big.fits(len(pending) + 1, nn + g.n_nodes, ne + g.n_edges):
+                yield self._emit(pending, nn, ne)
+                pending, nn, ne = [], 0, 0
+            pending.append(g)
+            nn += g.n_nodes
+            ne += g.n_edges
+        if pending:
+            yield self._emit(pending, nn, ne)
+
+    def _emit(self, pending: list[Graph], nn: int, ne: int) -> BatchedGraphs:
+        bucket = next(b for b in self.buckets if b.fits(len(pending), nn, ne))
+        return batch_np(pending, bucket.max_graphs, bucket.max_nodes, bucket.max_edges)
+
+
+def save_shards(graphs: Sequence[Graph], out_dir, shard_size: int = 4096) -> int:
+    """Write graphs to ``shard_{i:05d}.npz`` files (replaces ``graphs.bin``)."""
+    from pathlib import Path
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    n_shards = 0
+    for si in range(0, len(graphs), shard_size):
+        chunk = graphs[si : si + shard_size]
+        payload: dict[str, np.ndarray] = {
+            "gids": np.array([g.gid for g in chunk], dtype=np.int64)
+        }
+        for i, g in enumerate(chunk):
+            payload[f"s{i}"] = g.senders.astype(np.int32)
+            payload[f"r{i}"] = g.receivers.astype(np.int32)
+            for key, val in g.node_feats.items():
+                payload[f"f{i}:{key}"] = val
+        np.savez_compressed(out / f"shard_{n_shards:05d}.npz", **payload)
+        n_shards += 1
+    return n_shards
+
+
+def load_shards(in_dir) -> list[Graph]:
+    from pathlib import Path
+
+    graphs: list[Graph] = []
+    for shard in sorted(Path(in_dir).glob("shard_*.npz")):
+        with np.load(shard) as z:
+            gids = z["gids"]
+            for i, gid in enumerate(gids):
+                feats = {
+                    k.split(":", 1)[1]: z[k]
+                    for k in z.files
+                    if k.startswith(f"f{i}:")
+                }
+                graphs.append(
+                    Graph(
+                        senders=z[f"s{i}"],
+                        receivers=z[f"r{i}"],
+                        node_feats=feats,
+                        gid=int(gid),
+                    )
+                )
+    return graphs

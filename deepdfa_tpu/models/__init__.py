@@ -1,0 +1,1 @@
+"""Flax models: GGNN encoder/classifier, fusion heads, Llama-family LLM."""

@@ -1,0 +1,1 @@
+"""Device-side ops: segment reductions, set-union ops, attention kernels."""

@@ -1,0 +1,11 @@
+"""Distributed layer: mesh construction, sharding rules, collectives.
+
+The reference's distributed story was NCCL-via-Lightning DDP,
+``torch.nn.DataParallel`` and HF ``device_map`` placement (SURVEY.md §2.3).
+Here there is a single unified backend: XLA collectives over a
+``jax.sharding.Mesh`` — ``psum`` gradient reductions over ICI for data
+parallelism, GSPMD-partitioned matmuls for tensor/FSDP sharding of the LLM,
+and ``jax.distributed.initialize`` + DCN for multi-host pods.
+"""
+
+from deepdfa_tpu.parallel.mesh import build_mesh, local_mesh  # noqa: F401

@@ -1,0 +1,242 @@
+"""Training/eval steps and the epoch loop for the GGNN classifier.
+
+Covers the reference's Lightning ``BaseModule`` semantics
+(``DDFA/code_gnn/models/base_module.py``) rebuilt as pure JAX:
+
+- label extraction per ``label_style`` (graph / node / dataflow_solution_in /
+  dataflow_solution_out — ``base_module.py:83-95``), with **masked** segment
+  reductions: empty padded graph slots get label 0 and weight 0 (the DGL path
+  never saw padding, ours must mask it).
+- ``BCEWithLogitsLoss(pos_weight=...)`` (``base_module.py:72-74``).
+- node-level undersampled loss (``base_module.py:97-137``): the reference
+  samples an exact count of non-vul node indices per batch — a dynamic shape.
+  TPU version: Bernoulli mask with matching expected count, which keeps
+  shapes static; the loss is reweighted identically in expectation.
+- ``cut_nodef`` masking for dataflow-label training (``base_module.py:148-155``).
+- metric accumulation inside the jitted step (no per-batch host sync).
+
+Everything here is single-device; the multi-device wrapper lives in
+``deepdfa_tpu/parallel``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Iterable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepdfa_tpu.config import ExperimentConfig
+from deepdfa_tpu.data.graphs import BatchedGraphs
+from deepdfa_tpu.models.ggnn import GGNN
+from deepdfa_tpu.ops.segment import segment_max
+from deepdfa_tpu.train.metrics import ConfusionState, compute_metrics, update_confusion
+
+__all__ = [
+    "TrainState",
+    "graph_labels",
+    "extract_labels",
+    "bce_with_logits",
+    "make_train_step",
+    "make_eval_step",
+    "Trainer",
+]
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    step: jnp.ndarray
+
+
+def graph_labels(batch: BatchedGraphs) -> jnp.ndarray:
+    """Graph-level label = max of node ``_VULN`` per graph
+    (``base_module.py:86-88``). Empty padded slots → 0 (they carry 0 weight
+    anyway, but a finite value keeps the loss NaN-free)."""
+    vuln = batch.node_feats["_VULN"].astype(jnp.float32)
+    # _VULN ∈ {0,1}; empty-segment identity is -inf, so clamp at 0.
+    return jnp.maximum(segment_max(vuln, batch.node_gidx, batch.max_graphs), 0.0)
+
+
+def extract_labels(
+    batch: BatchedGraphs, label_style: str
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (labels, weights) for the given style; weights exclude padding
+    (and non-definition nodes for dataflow_solution_in, parity ``cut_nodef``).
+    """
+    if label_style == "graph":
+        return graph_labels(batch), batch.graph_mask.astype(jnp.float32)
+    if label_style == "node":
+        labels = batch.node_feats["_VULN"].astype(jnp.float32)
+        return labels, batch.node_mask.astype(jnp.float32)
+    if label_style in ("dataflow_solution_in", "dataflow_solution_out"):
+        key = "_DF_IN" if label_style.endswith("_in") else "_DF_OUT"
+        labels = batch.node_feats[key].astype(jnp.float32)
+        weights = batch.node_mask.astype(jnp.float32)
+        if label_style.endswith("_in"):
+            # cut_nodef: only definition nodes (nonzero abstract-dataflow id)
+            # contribute (base_module.py:148-155).
+            feat_key = (
+                "_ABS_DATAFLOW"
+                if "_ABS_DATAFLOW" in batch.node_feats
+                else "_ABS_DATAFLOW_datatype"
+            )
+            weights = weights * (batch.node_feats[feat_key] != 0).astype(jnp.float32)
+        return labels, weights
+    raise NotImplementedError(label_style)
+
+
+def bce_with_logits(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    weights: jnp.ndarray,
+    pos_weight: float | None = None,
+) -> jnp.ndarray:
+    """Weighted-mean BCE-with-logits, torch ``BCEWithLogitsLoss`` semantics
+    including ``pos_weight`` scaling of the positive term."""
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    pw = 1.0 if pos_weight is None else pos_weight
+    per = -(pw * labels * log_p + (1.0 - labels) * log_not_p)
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    return jnp.sum(per * weights) / denom
+
+
+def _node_loss_undersample_weights(
+    rng: jax.Array, labels: jnp.ndarray, weights: jnp.ndarray, factor: float
+) -> jnp.ndarray:
+    """Static-shape analogue of ``BaseModule.resample``: keep all positive
+    nodes, keep each negative with prob ``factor * n_pos / n_neg``."""
+    n_pos = jnp.sum(weights * labels)
+    n_neg = jnp.maximum(jnp.sum(weights * (1.0 - labels)), 1.0)
+    p_keep = jnp.clip(factor * n_pos / n_neg, 0.0, 1.0)
+    keep = jax.random.bernoulli(rng, p_keep, labels.shape).astype(jnp.float32)
+    return weights * jnp.where(labels > 0, 1.0, keep)
+
+
+def make_train_step(
+    model: GGNN,
+    optimizer: optax.GradientTransformation,
+    label_style: str = "graph",
+    pos_weight: float | None = None,
+    undersample_node_on_loss_factor: float | None = None,
+) -> Callable:
+    """Build the jitted train step: forward, masked loss, grads, update,
+    in-step metric accumulation."""
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch)
+        labels, weights = extract_labels(batch, label_style)
+        if label_style == "node" and undersample_node_on_loss_factor is not None:
+            weights = _node_loss_undersample_weights(
+                rng, labels, weights, undersample_node_on_loss_factor
+            )
+        loss = bce_with_logits(logits, labels, weights, pos_weight)
+        return loss, (logits, labels, weights)
+
+    @jax.jit
+    def train_step(state: TrainState, batch: BatchedGraphs, metrics: ConfusionState):
+        rng, sub = jax.random.split(state.rng)
+        (loss, (logits, labels, weights)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, batch, sub)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        probs = jax.nn.sigmoid(logits)
+        metrics = update_confusion(metrics, probs, labels, weights > 0)
+        new_state = TrainState(params, opt_state, rng, state.step + 1)
+        return new_state, metrics, loss, jnp.sum(weights)
+
+    return train_step
+
+
+def make_eval_step(
+    model: GGNN, label_style: str = "graph", pos_weight: float | None = None
+) -> Callable:
+    @jax.jit
+    def eval_step(params, batch: BatchedGraphs, metrics: ConfusionState):
+        logits = model.apply({"params": params}, batch)
+        labels, weights = extract_labels(batch, label_style)
+        loss = bce_with_logits(logits, labels, weights, pos_weight)
+        probs = jax.nn.sigmoid(logits)
+        metrics = update_confusion(metrics, probs, labels, weights > 0)
+        return metrics, loss, probs, labels, weights
+
+    return eval_step
+
+
+def _weighted_mean(losses: list, wsums: list) -> float:
+    """Per-example mean over the epoch: per-batch means re-weighted by their
+    real (masked-in) example counts, matching the reference's batch_size-
+    weighted Lightning loss logging (``base_module.py:139-146``). The greedy
+    packer emits a ragged final batch, so an unweighted mean would be biased."""
+    total_w = float(sum(float(w) for w in wsums))
+    if total_w == 0:
+        return 0.0
+    return float(sum(float(l) * float(w) for l, w in zip(losses, wsums))) / total_w
+
+
+@dataclasses.dataclass
+class Trainer:
+    """Minimal epoch driver; the full-featured CLI trainer (checkpointing,
+    logging, profiling — parity with ``main_cli.py``) composes this."""
+
+    model: GGNN
+    cfg: ExperimentConfig
+    pos_weight: float | None = None
+
+    def __post_init__(self):
+        o = self.cfg.optim
+        tx = optax.adamw(o.lr, weight_decay=o.weight_decay)
+        if o.grad_clip:
+            tx = optax.chain(optax.clip_by_global_norm(o.grad_clip), tx)
+        self.optimizer = tx
+        self.train_step = make_train_step(
+            self.model,
+            self.optimizer,
+            label_style=self.cfg.model.label_style,
+            pos_weight=self.pos_weight if o.use_weighted_loss else None,
+            undersample_node_on_loss_factor=o.undersample_node_on_loss_factor,
+        )
+        self.eval_step = make_eval_step(
+            self.model,
+            label_style=self.cfg.model.label_style,
+            pos_weight=self.pos_weight if o.use_weighted_loss else None,
+        )
+
+    def init_state(self, example_batch: BatchedGraphs) -> TrainState:
+        rng = jax.random.key(self.cfg.seed)
+        rng, init_rng = jax.random.split(rng)
+        params = self.model.init(init_rng, example_batch)["params"]
+        return TrainState(params, self.optimizer.init(params), rng, jnp.zeros((), jnp.int32))
+
+    def train_epoch(
+        self, state: TrainState, batches: Iterable[BatchedGraphs]
+    ) -> tuple[TrainState, dict[str, float], float]:
+        metrics = ConfusionState.zeros()
+        losses, wsums = [], []
+        for batch in batches:
+            batch = jax.tree.map(jnp.asarray, batch)
+            state, metrics, loss, wsum = self.train_step(state, batch, metrics)
+            losses.append(loss)
+            wsums.append(wsum)
+        return state, compute_metrics(metrics, "train_"), _weighted_mean(losses, wsums)
+
+    def evaluate(
+        self, params, batches: Iterable[BatchedGraphs], prefix: str = "val_"
+    ) -> tuple[dict[str, float], float]:
+        metrics = ConfusionState.zeros()
+        losses, wsums = [], []
+        for batch in batches:
+            batch = jax.tree.map(jnp.asarray, batch)
+            metrics, loss, _probs, _labels, weights = self.eval_step(params, batch, metrics)
+            losses.append(loss)
+            wsums.append(jnp.sum(weights))
+        mean_loss = _weighted_mean(losses, wsums)
+        out = compute_metrics(metrics, prefix)
+        out[f"{prefix}loss"] = mean_loss
+        return out, mean_loss

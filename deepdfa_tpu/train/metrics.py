@@ -1,0 +1,126 @@
+"""Classification metrics with masking, parity with the reference's
+torchmetrics collections (``base_module.py:34-68,348-383``): Accuracy,
+Precision, Recall, F1 per split, positive-only / negative-only test
+collections, PR curves, confusion matrix, and mean-metrics for label /
+prediction proportions.
+
+Design: metric state is a small pytree of scalar counts that lives on device
+and is updated *inside* the jitted step (so no host sync per batch); masked
+rows contribute nothing. ``compute`` mirrors torchmetrics' micro-average
+defaults (global counts, threshold 0.5). PR curves are computed host-side from
+gathered (pred, label) pairs with sklearn, matching
+``torchmetrics.PrecisionRecallCurve`` semantics.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "ConfusionState",
+    "update_confusion",
+    "compute_metrics",
+    "MeanState",
+    "update_mean",
+    "pr_curve",
+    "binned_pr_curve",
+]
+
+
+class ConfusionState(NamedTuple):
+    tp: jnp.ndarray
+    fp: jnp.ndarray
+    tn: jnp.ndarray
+    fn: jnp.ndarray
+
+    @classmethod
+    def zeros(cls) -> "ConfusionState":
+        z = jnp.zeros((), jnp.float32)
+        return cls(z, z, z, z)
+
+
+def update_confusion(
+    state: ConfusionState,
+    probs: jnp.ndarray,
+    labels: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    threshold: float = 0.5,
+) -> ConfusionState:
+    """Accumulate confusion counts. ``probs`` in [0,1]; ``labels`` {0,1}."""
+    preds = (probs >= threshold).astype(jnp.float32)
+    labels = labels.astype(jnp.float32)
+    m = jnp.ones_like(preds) if mask is None else mask.astype(jnp.float32)
+    tp = jnp.sum(m * preds * labels)
+    fp = jnp.sum(m * preds * (1 - labels))
+    fn = jnp.sum(m * (1 - preds) * labels)
+    tn = jnp.sum(m * (1 - preds) * (1 - labels))
+    return ConfusionState(state.tp + tp, state.fp + fp, state.tn + tn, state.fn + fn)
+
+
+def compute_metrics(state: ConfusionState, prefix: str = "") -> dict[str, float]:
+    """Micro-averaged Accuracy/Precision/Recall/F1 from accumulated counts.
+
+    Matches torchmetrics' zero-division convention (0 when denominator is 0).
+    """
+    tp, fp, tn, fn = (float(x) for x in state)
+    total = tp + fp + tn + fn
+    acc = (tp + tn) / total if total else 0.0
+    prec = tp / (tp + fp) if (tp + fp) else 0.0
+    rec = tp / (tp + fn) if (tp + fn) else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if (prec + rec) else 0.0
+    return {
+        f"{prefix}Accuracy": acc,
+        f"{prefix}Precision": prec,
+        f"{prefix}Recall": rec,
+        f"{prefix}F1Score": f1,
+    }
+
+
+class MeanState(NamedTuple):
+    total: jnp.ndarray
+    count: jnp.ndarray
+
+    @classmethod
+    def zeros(cls) -> "MeanState":
+        z = jnp.zeros((), jnp.float32)
+        return cls(z, z)
+
+    def compute(self) -> float:
+        c = float(self.count)
+        return float(self.total) / c if c else 0.0
+
+
+def update_mean(state: MeanState, value, weight=1.0) -> MeanState:
+    value = jnp.asarray(value, jnp.float32)
+    weight = jnp.asarray(weight, jnp.float32)
+    return MeanState(state.total + value * weight, state.count + weight)
+
+
+def pr_curve(probs: np.ndarray, labels: np.ndarray):
+    """(precision, recall, thresholds) — reference writes these to ``pr.csv``
+    (``base_module.py:358-359``)."""
+    from sklearn.metrics import precision_recall_curve
+
+    precision, recall, thresholds = precision_recall_curve(labels, probs)
+    return precision, recall, np.concatenate([thresholds, [1.0]])
+
+
+def binned_pr_curve(probs: np.ndarray, labels: np.ndarray, bins: int = 1):
+    """Fixed-threshold PR curve, parity with
+    ``torchmetrics.BinnedPrecisionRecallCurve(num_thresholds=bins)``."""
+    thresholds = np.linspace(0, 1, bins)
+    precision = np.zeros(bins + 1)
+    recall = np.zeros(bins + 1)
+    for i, t in enumerate(thresholds):
+        preds = probs >= t
+        tp = float(np.sum(preds & (labels == 1)))
+        fp = float(np.sum(preds & (labels == 0)))
+        fn = float(np.sum(~preds & (labels == 1)))
+        precision[i] = tp / (tp + fp) if (tp + fp) else 1.0
+        recall[i] = tp / (tp + fn) if (tp + fn) else 0.0
+    precision[bins] = 1.0
+    recall[bins] = 0.0
+    return precision, recall, np.concatenate([thresholds, [1.0]])

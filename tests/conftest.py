@@ -1,0 +1,34 @@
+"""Test harness: run JAX on a virtual 8-device CPU platform so sharding and
+collective paths are exercised without TPU hardware (SURVEY.md §4).
+
+Hosts with a remote-TPU tunnel plugin (axon) eagerly register their backend in
+every interpreter via sitecustomize, and ``jax.devices()`` deadlocks if asked
+for CPU while that registration is live. Tests must be hermetic and
+device-free, so before any backend initialises we drop the tunnel factory and
+pin the CPU platform with 8 virtual devices.
+"""
+
+import os
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+from jax._src import xla_bridge as _xb
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.device_count()} ({jax.default_backend()})"

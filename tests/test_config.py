@@ -1,0 +1,57 @@
+import json
+
+import pytest
+
+from deepdfa_tpu.config import (
+    ExperimentConfig,
+    FeatureConfig,
+    GGNNConfig,
+    MeshConfig,
+    load_config,
+)
+
+
+def test_feature_input_dim():
+    # parity: input_dim = limit_all + 2 (datamodule.py:87-96)
+    assert FeatureConfig(limit_all=1000).input_dim == 1002
+
+
+def test_feat_string_roundtrip():
+    cfg = FeatureConfig(limit_all=500, limit_subkeys=5000)
+    parsed = FeatureConfig.from_feat_string(cfg.feat_string())
+    assert parsed.limit_all == 500 and parsed.limit_subkeys == 5000
+    assert parsed.combined and parsed.subkeys == cfg.subkeys
+
+
+def test_parse_reference_golden_feat_string():
+    # the golden config feat string from configs/config_bigvul.yaml
+    feat = "_ABS_DATAFLOW_datatype_all_limitall_1000_limitsubkeys_1000"
+    cfg = FeatureConfig.from_feat_string(feat)
+    assert cfg.limit_all == 1000 and cfg.limit_subkeys == 1000
+    assert cfg.combined and "datatype" in cfg.subkeys
+    assert cfg.input_dim == 1002
+
+
+def test_ggnn_out_dim():
+    # embed(32*4) + hidden(32*4) = 256 with concat_all_absdf (ggnn.py:47-64)
+    assert GGNNConfig().out_dim == 256
+    assert GGNNConfig(concat_all_absdf=False).out_dim == 64
+
+
+def test_mesh_axis_sizes():
+    assert MeshConfig(dp=-1).axis_sizes(8) == {"dp": 8, "fsdp": 1, "tp": 1, "sp": 1}
+    assert MeshConfig(dp=2, tp=4).axis_sizes(8)["tp"] == 4
+    with pytest.raises(ValueError):
+        MeshConfig(dp=3).axis_sizes(8)
+
+
+def test_layered_load(tmp_path):
+    base = tmp_path / "base.json"
+    over = tmp_path / "over.json"
+    base.write_text(json.dumps({"model": {"hidden_dim": 32}, "seed": 0}))
+    over.write_text(json.dumps({"model": {"n_steps": 7}}))
+    cfg = load_config(base, over, overrides={"model.hidden_dim": 64, "seed": 3})
+    assert cfg.model.hidden_dim == 64
+    assert cfg.model.n_steps == 7
+    assert cfg.seed == 3
+    assert isinstance(cfg, ExperimentConfig)

@@ -1,0 +1,83 @@
+import numpy as np
+import pytest
+
+from deepdfa_tpu.data.graphs import (
+    BucketSpec,
+    Graph,
+    GraphBatcher,
+    batch_np,
+    load_shards,
+    save_shards,
+)
+from deepdfa_tpu.data.synthetic import random_dataset
+
+
+def tiny(n, e_extra=0, gid=0):
+    senders = np.arange(n - 1, dtype=np.int32)
+    receivers = senders + 1
+    return Graph(
+        senders=senders,
+        receivers=receivers,
+        node_feats={"x": np.arange(n, dtype=np.int32), "_VULN": np.zeros(n, np.int32)},
+        gid=gid,
+    )
+
+
+def test_self_loops():
+    g = tiny(4).with_self_loops()
+    assert g.n_edges == 3 + 4
+    assert (g.senders[-4:] == g.receivers[-4:]).all()
+
+
+def test_batch_np_offsets_and_masks():
+    g1, g2 = tiny(3, gid=1), tiny(5, gid=2)
+    b = batch_np([g1, g2], max_graphs=4, max_nodes=16, max_edges=16)
+    assert b.node_gidx.shape == (16,)
+    # nodes 0-2 -> graph 0, nodes 3-7 -> graph 1, rest -> padding graph 3
+    assert b.node_gidx[:3].tolist() == [0, 0, 0]
+    assert b.node_gidx[3:8].tolist() == [1] * 5
+    assert b.node_gidx[8:].tolist() == [3] * 8
+    # second graph's edges offset by 3
+    assert b.senders[2:6].tolist() == [3, 4, 5, 6]
+    # padding edges self-loop on last node
+    assert (b.senders[6:] == 15).all() and (b.receivers[6:] == 15).all()
+    assert b.node_mask.sum() == 8 and b.edge_mask.sum() == 6 and b.graph_mask.sum() == 2
+
+
+def test_batch_np_budget_errors():
+    with pytest.raises(ValueError):
+        batch_np([tiny(10)], max_graphs=4, max_nodes=10, max_edges=64)
+    with pytest.raises(ValueError):
+        batch_np([tiny(3), tiny(3)], max_graphs=2, max_nodes=64, max_edges=64)
+
+
+def test_batcher_packs_and_drops():
+    graphs = [tiny(4, gid=i) for i in range(10)] + [tiny(200, gid=99)]
+    batcher = GraphBatcher([BucketSpec(4, 32, 32)])
+    batches = list(batcher.batches(graphs))
+    assert batcher.n_dropped == 1  # the 200-node graph
+    assert all(b.node_gidx.shape == (32,) for b in batches)
+    total_real = sum(int(b.graph_mask.sum()) for b in batches)
+    assert total_real == 10
+
+
+def test_multi_bucket_picks_smallest():
+    small = BucketSpec(4, 16, 16)
+    big = BucketSpec(8, 64, 64)
+    batcher = GraphBatcher([small, big])
+    batches = list(batcher.batches([tiny(3)]))
+    assert batches[0].node_gidx.shape == (16,)
+
+
+def test_shard_roundtrip(tmp_path):
+    graphs = random_dataset(7, seed=1)
+    save_shards(graphs, tmp_path, shard_size=3)
+    back = load_shards(tmp_path)
+    assert len(back) == 7
+    for a, b in zip(graphs, back):
+        assert a.gid == b.gid
+        np.testing.assert_array_equal(a.senders, b.senders)
+        np.testing.assert_array_equal(a.receivers, b.receivers)
+        assert set(a.node_feats) == set(b.node_feats)
+        for k in a.node_feats:
+            np.testing.assert_array_equal(a.node_feats[k], b.node_feats[k])

@@ -1,0 +1,60 @@
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import accuracy_score, f1_score, precision_score, recall_score
+
+from deepdfa_tpu.train.metrics import (
+    ConfusionState,
+    MeanState,
+    binned_pr_curve,
+    compute_metrics,
+    pr_curve,
+    update_confusion,
+    update_mean,
+)
+
+
+def test_confusion_matches_sklearn():
+    rng = np.random.default_rng(0)
+    probs = rng.random(200).astype(np.float32)
+    labels = (rng.random(200) < 0.3).astype(np.int32)
+    state = ConfusionState.zeros()
+    for i in range(0, 200, 50):  # accumulate over batches
+        state = update_confusion(
+            state, jnp.array(probs[i : i + 50]), jnp.array(labels[i : i + 50])
+        )
+    m = compute_metrics(state, prefix="test_")
+    preds = (probs > 0.5).astype(int)
+    assert abs(m["test_Accuracy"] - accuracy_score(labels, preds)) < 1e-6
+    assert abs(m["test_Precision"] - precision_score(labels, preds)) < 1e-6
+    assert abs(m["test_Recall"] - recall_score(labels, preds)) < 1e-6
+    assert abs(m["test_F1Score"] - f1_score(labels, preds)) < 1e-6
+
+
+def test_confusion_mask_excludes_padding():
+    probs = jnp.array([0.9, 0.9, 0.1])
+    labels = jnp.array([1, 0, 0])
+    mask = jnp.array([True, False, True])
+    m = compute_metrics(update_confusion(ConfusionState.zeros(), probs, labels, mask))
+    assert m["Accuracy"] == 1.0 and m["F1Score"] == 1.0
+
+
+def test_zero_division_convention():
+    m = compute_metrics(ConfusionState.zeros())
+    assert m["F1Score"] == 0.0 and m["Precision"] == 0.0
+
+
+def test_mean_metric():
+    s = MeanState.zeros()
+    s = update_mean(s, 1.0)
+    s = update_mean(s, 3.0)
+    assert s.compute() == 2.0
+
+
+def test_pr_curves_shapes():
+    rng = np.random.default_rng(1)
+    probs = rng.random(100)
+    labels = (rng.random(100) < 0.4).astype(int)
+    p, r, t = pr_curve(probs, labels)
+    assert len(p) == len(r) == len(t)
+    p, r, t = binned_pr_curve(probs, labels, bins=1)
+    assert len(p) == 2 and t[-1] == 1.0

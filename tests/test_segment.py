@@ -1,0 +1,51 @@
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.ops.segment import segment_max, segment_mean, segment_softmax, segment_sum
+
+
+def test_segment_sum_basic():
+    data = jnp.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    ids = jnp.array([0, 0, 1])
+    out = segment_sum(data, ids, 3)
+    np.testing.assert_allclose(out, [[4, 6], [5, 6], [0, 0]])
+
+
+def test_segment_softmax_matches_numpy():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=12).astype(np.float32)
+    ids = np.array([0] * 5 + [1] * 4 + [2] * 3)
+    out = np.asarray(segment_softmax(jnp.array(logits), jnp.array(ids), 3))
+    for s in range(3):
+        part = logits[ids == s]
+        expect = np.exp(part - part.max())
+        expect /= expect.sum()
+        np.testing.assert_allclose(out[ids == s], expect, rtol=1e-5)
+    # each segment sums to 1
+    for s in range(3):
+        np.testing.assert_allclose(out[ids == s].sum(), 1.0, rtol=1e-5)
+
+
+def test_segment_softmax_mask_zeroes_padding():
+    logits = jnp.array([100.0, 1.0, 2.0, 50.0])
+    ids = jnp.array([0, 0, 0, 1])
+    mask = jnp.array([False, True, True, False])
+    out = np.asarray(segment_softmax(logits, ids, 2, mask=mask))
+    assert out[0] == 0.0 and out[3] == 0.0
+    np.testing.assert_allclose(out[1] + out[2], 1.0, rtol=1e-6)
+    # big masked logit must not shift the max (no overflow/NaN)
+    assert np.isfinite(out).all()
+
+
+def test_segment_max_and_mean():
+    data = jnp.array([1.0, 5.0, 2.0, -1.0])
+    ids = jnp.array([0, 0, 1, 1])
+    np.testing.assert_allclose(segment_max(data, ids, 2), [5.0, 2.0])
+    np.testing.assert_allclose(segment_mean(data, ids, 2), [3.0, 0.5])
+
+
+def test_segment_mean_masked():
+    data = jnp.array([1.0, 5.0, 9.0])
+    ids = jnp.array([0, 0, 0])
+    mask = jnp.array([True, True, False])
+    np.testing.assert_allclose(segment_mean(data, ids, 1, mask=mask), [3.0])
