@@ -1,0 +1,162 @@
+"""Benchmark: flagship GGNN throughput on the local accelerator.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "graphs/sec", "vs_baseline": N, ...}``.
+
+Headline metric: **GGNN inference graphs/sec** at the reference's golden
+config (hidden 32, 5 steps, concat_all_absdf, batch 256 graphs) on Big-Vul-
+shaped synthetic batches (mean ~50 CFG nodes/function; the real corpus needs
+a network download the bench environment doesn't have).
+
+``vs_baseline``: ratio against a **same-semantics torch-CPU implementation**
+(``deepdfa_tpu/compat/torch_ref.py``) measured in-process. The reference's own
+GPU harness (DGL + CUDA events, ``base_module.py:246-281``) cannot run here —
+no CUDA and no DGL wheel — so this is the honest, reproducible stand-in;
+BASELINE.md records the protocol. Training throughput is also measured and
+reported as an extra field.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def build_batches(n_batches: int, input_dim: int, batch_graphs: int = 256):
+    from deepdfa_tpu.config import BatchConfig
+    from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+    from deepdfa_tpu.data.synthetic import random_dataset
+
+    bc = BatchConfig()
+    bucket = BucketSpec(batch_graphs + 1, bc.max_nodes, bc.max_edges)
+    graphs = random_dataset(n_batches * batch_graphs, seed=0, input_dim=input_dim)
+    batcher = GraphBatcher([bucket])
+    batches = []
+    for b in batcher.batches(graphs):
+        if int(b.graph_mask.sum()) == batch_graphs:  # keep full batches only
+            batches.append(b)
+        if len(batches) == n_batches:
+            break
+    if not batches:
+        raise RuntimeError("no full batches produced; lower batch_graphs or raise budgets")
+    return batches
+
+
+def bench_jax(batches, steps: int, train: bool):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from deepdfa_tpu.config import ExperimentConfig
+    from deepdfa_tpu.models.ggnn import GGNN
+    from deepdfa_tpu.train.loop import Trainer
+    from deepdfa_tpu.train.metrics import ConfusionState
+
+    cfg = ExperimentConfig()
+    model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
+    dev_batches = [jax.tree.map(jnp.asarray, b) for b in batches]
+    trainer = Trainer(model=model, cfg=cfg, pos_weight=15.0)
+    state = trainer.init_state(dev_batches[0])
+
+    if train:
+        step = trainer.train_step
+        metrics = ConfusionState.zeros()
+        state, metrics, loss, w = step(state, dev_batches[0], metrics)  # compile
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics, loss, w = step(state, dev_batches[i % len(dev_batches)], metrics)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    else:
+        fwd = jax.jit(lambda p, b: model.apply({"params": p}, b))
+        out = fwd(state.params, dev_batches[0])
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            out = fwd(state.params, dev_batches[i % len(dev_batches)])
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+    graphs_per_batch = int(batches[0].graph_mask.sum())
+    return steps * graphs_per_batch / dt
+
+
+def bench_torch_cpu(batches, steps: int):
+    """Same-semantics torch-CPU inference baseline."""
+    import torch
+
+    from deepdfa_tpu.compat.torch_ref import TorchGGNN
+    from deepdfa_tpu.config import FeatureConfig
+
+    torch.manual_seed(0)
+    model = TorchGGNN(FeatureConfig().input_dim).eval()
+    prepped = []
+    for b in batches:
+        n_nodes = int(b.node_mask.sum())
+        n_edges = int(b.edge_mask.sum())
+        n_graphs = int(b.graph_mask.sum())
+        feats = {
+            k: torch.tensor(np.asarray(v[:n_nodes], dtype=np.int64))
+            for k, v in b.node_feats.items()
+            if k.startswith("_ABS_DATAFLOW")
+        }
+        prepped.append(
+            (
+                feats,
+                torch.tensor(np.asarray(b.senders[:n_edges], np.int64)),
+                torch.tensor(np.asarray(b.receivers[:n_edges], np.int64)),
+                torch.tensor(np.asarray(b.node_gidx[:n_nodes], np.int64)),
+                n_graphs,
+            )
+        )
+    with torch.no_grad():
+        model(*prepped[0])  # warmup
+        t0 = time.perf_counter()
+        for i in range(steps):
+            model(*prepped[i % len(prepped)])
+        dt = time.perf_counter() - t0
+    return steps * prepped[0][4] / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--baseline-steps", type=int, default=5)
+    ap.add_argument("--batches", type=int, default=4)
+    ap.add_argument("--skip-baseline", action="store_true")
+    args = ap.parse_args()
+
+    from deepdfa_tpu.config import FeatureConfig
+
+    batches = build_batches(args.batches, FeatureConfig().input_dim)
+
+    import jax
+
+    backend = jax.default_backend()
+    infer_gps = bench_jax(batches, args.steps, train=False)
+    train_gps = bench_jax(batches, max(args.steps // 2, 5), train=True)
+
+    if args.skip_baseline:
+        base_gps = None
+    else:
+        base_gps = bench_torch_cpu(batches, args.baseline_steps)
+
+    result = {
+        "metric": "ggnn_inference_graphs_per_sec",
+        "value": round(infer_gps, 1),
+        "unit": "graphs/sec",
+        "vs_baseline": round(infer_gps / base_gps, 2) if base_gps else None,
+        "backend": backend,
+        "train_graphs_per_sec": round(train_gps, 1),
+        "baseline": "torch-cpu same-semantics GGNN (compat/torch_ref.py)",
+        "baseline_graphs_per_sec": round(base_gps, 1) if base_gps else None,
+        "config": "hidden32_steps5_concat4_batch256",
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,7 @@ __all__ = [
     "TrainState",
     "graph_labels",
     "extract_labels",
+    "bce_sums",
     "bce_with_logits",
     "make_train_step",
     "make_eval_step",
@@ -90,20 +91,33 @@ def extract_labels(
     raise NotImplementedError(label_style)
 
 
+def bce_sums(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    weights: jnp.ndarray,
+    pos_weight: float | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sum-form BCE-with-logits: ``(Σ per·w, Σ w)``. The sum form is what
+    cross-device reductions need (psum numerator and denominator separately,
+    then divide) — both the single-device mean and the dp loss derive from it.
+    torch ``BCEWithLogitsLoss`` semantics incl. ``pos_weight`` scaling of the
+    positive term."""
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    pw = 1.0 if pos_weight is None else pos_weight
+    per = -(pw * labels * log_p + (1.0 - labels) * log_not_p)
+    return jnp.sum(per * weights), jnp.sum(weights)
+
+
 def bce_with_logits(
     logits: jnp.ndarray,
     labels: jnp.ndarray,
     weights: jnp.ndarray,
     pos_weight: float | None = None,
 ) -> jnp.ndarray:
-    """Weighted-mean BCE-with-logits, torch ``BCEWithLogitsLoss`` semantics
-    including ``pos_weight`` scaling of the positive term."""
-    log_p = jax.nn.log_sigmoid(logits)
-    log_not_p = jax.nn.log_sigmoid(-logits)
-    pw = 1.0 if pos_weight is None else pos_weight
-    per = -(pw * labels * log_p + (1.0 - labels) * log_not_p)
-    denom = jnp.maximum(jnp.sum(weights), 1.0)
-    return jnp.sum(per * weights) / denom
+    """Weighted-mean BCE-with-logits (see :func:`bce_sums`)."""
+    num, den = bce_sums(logits, labels, weights, pos_weight)
+    return num / jnp.maximum(den, 1.0)
 
 
 def _node_loss_undersample_weights(
