@@ -1,0 +1,21 @@
+"""Code-property-graph toolchain (host-side, offline).
+
+The reference drives Joern (Scala/JVM, pinned v1.1.107) for CPG extraction and
+reaching-definitions solving (``DDFA/storage/external/*.sc``,
+``sastvd/helpers/joern*.py``). This package keeps the **Joern JSON contract**
+as an ingestion path (:mod:`deepdfa_tpu.cpg.joern`) but owns the analysis
+natively:
+
+- :mod:`deepdfa_tpu.cpg.schema`   — columnar CPG container.
+- :mod:`deepdfa_tpu.cpg.joern`    — ``.nodes.json``/``.edges.json``/
+  ``.dataflow.json`` readers + an offline Joern runner (gated on a local
+  joern install).
+- :mod:`deepdfa_tpu.cpg.frontend` — **native C frontend** (pycparser): builds
+  Joern-compatible CPGs (AST/CFG/ARGUMENT edges, ``<operator>.*`` call
+  naming) with no JVM, so the pipeline is hermetic.
+- :mod:`deepdfa_tpu.cpg.dataflow` — reaching-definitions solvers: reference-
+  semantics Python worklist, a NumPy bit-vector fast path, and a C++ worklist
+  solver (``native/dfa_solver.cpp``) via ctypes.
+"""
+
+from deepdfa_tpu.cpg.schema import CPG  # noqa: F401

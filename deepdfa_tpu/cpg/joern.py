@@ -1,0 +1,137 @@
+"""Joern artifact ingestion + offline runner.
+
+Readers for the three per-function artifacts the reference's Joern script
+exports (``DDFA/storage/external/get_func_graph.sc:49-75``):
+
+- ``{f}.nodes.json`` — list of node property dicts;
+- ``{f}.edges.json`` — list of ``[innode, outnode, etype, variable]`` rows
+  (Joern edge: outNode → inNode, so src=outnode);
+- ``{f}.dataflow.json`` — per-method ``problem.gen/problem.kill/
+  solution.in/solution.out`` maps (node id → list of def node ids).
+
+:func:`load_cpg` follows the reference's analysis-side cleanup contract
+(``code_gnn/analysis/dataflow.py:201-250``): keep nodes with line numbers,
+drop dangling/lone nodes, dedupe edges. :func:`load_tables` mirrors the
+ML-side cleanup (``helpers/joern.py:182-319``) used for graph
+materialisation: label/edge-type filtering and TYPE-node synthesis.
+
+:class:`JoernRunner` shells out to a local joern install (the reference
+pinned v1.1.107); it is optional — the native frontend
+(:mod:`deepdfa_tpu.cpg.frontend`) is the hermetic default.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+from pathlib import Path
+
+import pandas as pd
+
+from deepdfa_tpu.cpg.schema import CPG
+
+__all__ = ["load_tables", "load_cpg", "load_dataflow", "JoernRunner"]
+
+NODE_COLUMNS = [
+    "id", "_label", "name", "code", "lineNumber", "columnNumber",
+    "lineNumberEnd", "columnNumberEnd", "controlStructureType", "order",
+    "fullName", "typeFullName",
+]
+
+# Edge types that are bookkeeping, not program structure.
+DROP_ETYPES = {"CONTAINS", "SOURCE_FILE", "DOMINATE", "POST_DOMINATE"}
+DROP_LABELS = {"COMMENT", "FILE"}
+
+
+def read_raw(stem: str | Path) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """Read ``{stem}.nodes.json`` / ``{stem}.edges.json`` into raw tables."""
+    stem = str(stem)
+    with open(stem + ".edges.json") as f:
+        edges = pd.DataFrame(
+            json.load(f), columns=["innode", "outnode", "etype", "dataflow"]
+        ).fillna("")
+    with open(stem + ".nodes.json") as f:
+        nodes = pd.DataFrame.from_records(json.load(f), columns=NODE_COLUMNS).fillna("")
+    return nodes, edges
+
+
+def load_tables(stem: str | Path) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """ML-side tables: filtered labels/etypes, int lines, deduped edges."""
+    nodes, edges = read_raw(stem)
+    if (nodes._label == "METHOD").sum() == 0:
+        raise ValueError(f"{stem}: graph has no METHOD node")
+    nodes = nodes[~nodes._label.isin(DROP_LABELS)].copy()
+    edges = edges[~edges.etype.isin(DROP_ETYPES)].copy()
+    nodes.code = nodes.code.replace("<empty>", "")
+    nodes.code = nodes.apply(lambda r: r.code if r.code != "" else r["name"], axis=1)
+    nodes.lineNumber = pd.to_numeric(nodes.lineNumber, errors="coerce")
+    edges.innode = pd.to_numeric(edges.innode, errors="coerce")
+    edges.outnode = pd.to_numeric(edges.outnode, errors="coerce")
+    edges = edges.dropna(subset=["innode", "outnode"])
+    edges = edges.astype({"innode": int, "outnode": int})
+    edges = edges.drop_duplicates(subset=["innode", "outnode", "etype"])
+    return nodes, edges
+
+
+def load_cpg(stem: str | Path) -> CPG:
+    """Analysis-side CPG (reaching definitions, abstract dataflow): nodes with
+    line numbers, dangling edges dropped, no lone nodes."""
+    nodes, edges = load_tables(stem)
+    nodes = nodes[nodes.lineNumber.notna()].copy()
+    nodes.lineNumber = nodes.lineNumber.astype(int)
+    ids = set(nodes.id.astype(int))
+    edges = edges[edges.innode.isin(ids) & edges.outnode.isin(ids)]
+    connected = set(edges.innode) | set(edges.outnode)
+    nodes = nodes[nodes.id.isin(connected)]
+    return CPG.from_tables(nodes, edges)
+
+
+def load_dataflow(path: str | Path) -> dict:
+    """Parse ``{f}.dataflow.json`` → {method: {key: {node_id: [def ids]}}}
+    with int keys (reference loader: ``helpers/datasets.py:780-796``)."""
+    with open(str(path)) as f:
+        raw = json.load(f)
+    out: dict = {}
+    for method, solution in raw.items():
+        out[method] = {
+            key: {int(k): [int(v) for v in vs] for k, vs in mapping.items()}
+            for key, mapping in solution.items()
+        }
+    return out
+
+
+class JoernRunner:
+    """Batch runner for a local joern install (optional path).
+
+    One-shot invocation per file, parity with ``helpers/joern.py:162-179``:
+    ``joern --script get_func_graph.sc --params filename=...``. Exports land
+    next to the source file; re-runs are skipped when artifacts exist (the
+    reference's idempotence contract, ``get_func_graph.sc:36-48``).
+    """
+
+    def __init__(self, script: str | Path, joern_bin: str = "joern"):
+        self.script = Path(script)
+        self.joern_bin = joern_bin
+
+    @property
+    def available(self) -> bool:
+        return shutil.which(self.joern_bin) is not None
+
+    def run(self, c_file: str | Path, timeout: int = 600) -> Path:
+        c_file = Path(c_file)
+        stem = str(c_file)
+        if Path(stem + ".nodes.json").exists() and Path(stem + ".edges.json").exists():
+            return c_file
+        if not self.available:
+            raise RuntimeError(
+                f"joern binary {self.joern_bin!r} not on PATH; use the native "
+                "frontend (deepdfa_tpu.cpg.frontend) or install joern"
+            )
+        subprocess.run(
+            [self.joern_bin, "--script", str(self.script), "--params", f"filename={stem}"],
+            check=True,
+            timeout=timeout,
+            capture_output=True,
+        )
+        return c_file

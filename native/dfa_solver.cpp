@@ -1,0 +1,66 @@
+// Reaching-definitions worklist solver over a CSR-encoded CFG.
+//
+// Native throughput path for corpus preprocessing: the reference ran this
+// fixpoint inside Joern's JVM (DataFlowSolver / ReachingDefProblem, invoked
+// from DDFA/storage/external/get_func_graph.sc) and kept a Python reference
+// implementation (DDFA/code_gnn/analysis/dataflow.py:155-177). Same MOP
+// semantics here: in[n] = U out[p], out[n] = gen[n] | (in[n] & ~kill[n]),
+// chaotic iteration until fixpoint. Definitions are bit positions in
+// 64-bit word vectors; callers pack/unpack (see cpg/dataflow.py).
+//
+// Exposed via ctypes; no Python.h dependency.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" int solve_reaching_defs(
+    int32_t n_nodes, int32_t n_defs,
+    const int32_t* pred_indptr, const int32_t* pred_indices,
+    const int32_t* succ_indptr, const int32_t* succ_indices,
+    const uint64_t* gen, const uint64_t* kill,
+    uint64_t* in_out, uint64_t* out_out) {
+  if (n_nodes < 0 || n_defs < 0) return 1;
+  if (n_nodes == 0) return 0;
+  const int32_t words = n_defs > 0 ? (n_defs + 63) / 64 : 1;
+
+  std::vector<uint64_t> scratch(words);
+  std::vector<int32_t> work;
+  std::vector<char> in_work(n_nodes, 1);
+  work.reserve(n_nodes);
+  for (int32_t i = 0; i < n_nodes; ++i) work.push_back(i);
+
+  while (!work.empty()) {
+    const int32_t n = work.back();
+    work.pop_back();
+    in_work[n] = 0;
+
+    uint64_t* in_n = in_out + static_cast<size_t>(n) * words;
+    std::memset(in_n, 0, sizeof(uint64_t) * words);
+    for (int32_t e = pred_indptr[n]; e < pred_indptr[n + 1]; ++e) {
+      const uint64_t* out_p = out_out + static_cast<size_t>(pred_indices[e]) * words;
+      for (int32_t w = 0; w < words; ++w) in_n[w] |= out_p[w];
+    }
+
+    const uint64_t* gen_n = gen + static_cast<size_t>(n) * words;
+    const uint64_t* kill_n = kill + static_cast<size_t>(n) * words;
+    uint64_t* out_n = out_out + static_cast<size_t>(n) * words;
+    bool changed = false;
+    for (int32_t w = 0; w < words; ++w) {
+      const uint64_t v = gen_n[w] | (in_n[w] & ~kill_n[w]);
+      if (v != out_n[w]) changed = true;
+      scratch[w] = v;
+    }
+    if (changed) {
+      std::memcpy(out_n, scratch.data(), sizeof(uint64_t) * words);
+      for (int32_t e = succ_indptr[n]; e < succ_indptr[n + 1]; ++e) {
+        const int32_t s = succ_indices[e];
+        if (!in_work[s]) {
+          in_work[s] = 1;
+          work.push_back(s);
+        }
+      }
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,155 @@
+"""Native C frontend: structure of the emitted CPG."""
+
+import pytest
+
+from deepdfa_tpu.cpg.frontend import FrontendError, parse_function, strip_comments
+
+
+def labels(cpg):
+    out = {}
+    for n in cpg.nodes.values():
+        out.setdefault(n.label, []).append(n)
+    return out
+
+
+def test_basic_structure():
+    cpg = parse_function("int f(int a, char *s) { int x = a; return x; }")
+    by = labels(cpg)
+    assert len(by["METHOD"]) == 1 and len(by["METHOD_RETURN"]) == 1
+    params = sorted(by["METHOD_PARAMETER_IN"], key=lambda n: n.order)
+    assert [p.name for p in params] == ["a", "s"]
+    assert params[0].type_full_name == "int"
+    assert params[1].type_full_name == "char *"
+    assert [l.name for l in by["LOCAL"]] == ["x"]
+
+
+def test_assignment_call_shape():
+    cpg = parse_function("int f() { int x; x = 3; return x; }")
+    calls = [n for n in cpg.nodes.values() if n.label == "CALL"]
+    assert len(calls) == 1
+    call = calls[0]
+    assert call.name == "<operator>.assignment"
+    args = cpg.arguments(call.id)
+    assert cpg.nodes[args[1]].code == "x"  # first arg = assigned var
+    assert cpg.nodes[args[2]].code == "3"
+    assert cpg.nodes[args[1]].type_full_name == "int"  # scope-resolved
+
+
+def test_operator_vocabulary():
+    cpg = parse_function(
+        "int f(int a, int *p) { a += 2; a--; ++a; p[0] = a; return *p; }"
+    )
+    names = {n.name for n in cpg.nodes.values() if n.label == "CALL"}
+    assert "<operator>.assignmentPlus" in names
+    assert "<operator>.postDecrement" in names
+    assert "<operator>.preIncrement" in names
+    assert "<operator>.assignment" in names
+    assert "<operator>.indexAccess" in names
+    assert "<operator>.indirection" in names
+
+
+def test_cfg_method_to_return_connectivity():
+    cpg = parse_function("int f(int a) { if (a > 0) { a = 1; } else { a = 2; } return a; }")
+    method = next(n.id for n in cpg.nodes.values() if n.label == "METHOD")
+    mret = next(n.id for n in cpg.nodes.values() if n.label == "METHOD_RETURN")
+    # BFS over CFG from METHOD must reach METHOD_RETURN through both branches
+    seen = set()
+    stack = [method]
+    while stack:
+        n = stack.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(cpg.successors(n, "CFG"))
+    assert mret in seen
+    branch_codes = {cpg.nodes[n].code for n in seen if cpg.nodes[n].label == "CALL"}
+    assert {"a = 1", "a = 2", "a > 0"} <= branch_codes
+
+
+def test_loop_has_back_edge():
+    cpg = parse_function("int f(int n) { int s = 0; for (int i = 0; i < n; i++) s += i; return s; }")
+    # the increment (i++) must flow back to the condition (i < n)
+    inc = next(n.id for n in cpg.nodes.values() if n.name == "<operator>.postIncrement")
+    cond = next(n.id for n in cpg.nodes.values() if n.name == "<operator>.lessThan")
+    assert cond in cpg.successors(inc, "CFG")
+
+
+def test_function_call_arguments():
+    cpg = parse_function('int f(char *b) { memcpy(b, "x", 1); return 0; }')
+    call = next(n for n in cpg.nodes.values() if n.name == "memcpy")
+    args = cpg.arguments(call.id)
+    assert len(args) == 3
+    assert cpg.nodes[args[1]].code == "b"
+
+
+def test_typedef_recovery():
+    cpg = parse_function("int f(size_t n, my_type_t v) { return (int)n; }")
+    params = [n for n in cpg.nodes.values() if n.label == "METHOD_PARAMETER_IN"]
+    assert len(params) == 2  # unknown types recovered via typedef insertion
+
+
+def test_line_numbers_survive_typedef_recovery():
+    cpg = parse_function("int f(size_t n) {\n  int x = 1;\n  return x;\n}")
+    call = next(n for n in cpg.nodes.values() if n.code == "x = 1")
+    assert call.line == 2
+
+
+def test_struct_access_ops():
+    cpg = parse_function(
+        "int f(struct foo *p) { p->x = 1; return 0; }"
+    )
+    names = {n.name for n in cpg.nodes.values() if n.label == "CALL"}
+    assert "<operator>.indirectFieldAccess" in names
+
+
+def test_cast_argument_order():
+    cpg = parse_function("int f(long v) { int x = (int)v; return x; }")
+    cast = next(n for n in cpg.nodes.values() if n.name == "<operator>.cast")
+    args = cpg.arguments(cast.id)
+    assert cpg.nodes[args[1]].label == "TYPE_REF"  # order 1 = type (Joern contract)
+    assert cpg.nodes[args[2]].code == "v"
+
+
+def test_preprocessor_and_comments_stripped():
+    code = "#include <stdio.h>\n// comment\nint f() { /* c */ return 0; }\n"
+    cpg = parse_function(code)
+    assert any(n.label == "METHOD" for n in cpg.nodes.values())
+    m = next(n for n in cpg.nodes.values() if n.label == "METHOD")
+    assert m.line == 3
+
+
+def test_strip_comments_preserves_strings():
+    assert strip_comments('x = "//not a comment";') == 'x = "//not a comment";'
+
+
+def test_garbage_raises():
+    with pytest.raises(FrontendError):
+        parse_function("this is not C at all {{{")
+
+
+def test_switch_and_goto():
+    cpg = parse_function(
+        """
+int f(int a) {
+  switch (a) {
+    case 1: a = 10; break;
+    default: a = 20;
+  }
+  if (a > 5) goto done;
+  a = 0;
+done:
+  return a;
+}
+"""
+    )
+    method = next(n.id for n in cpg.nodes.values() if n.label == "METHOD")
+    mret = next(n.id for n in cpg.nodes.values() if n.label == "METHOD_RETURN")
+    seen = set()
+    stack = [method]
+    while stack:
+        n = stack.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(cpg.successors(n, "CFG"))
+    assert mret in seen

@@ -1,0 +1,196 @@
+"""Reaching-definitions solvers: reference semantics, cross-solver equality,
+and the Joern ``<operators>`` spelling quirk."""
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.cpg.dataflow import (
+    MOD_OPS,
+    ReachingDefinitions,
+    VariableDefinition,
+    solve_bitvec,
+    solve_native,
+)
+from deepdfa_tpu.cpg.frontend import parse_function
+from deepdfa_tpu.cpg.schema import CPG, Node
+
+LOOP_FUNC = """
+int f(int a) {
+    int x = 1;
+    int y = 0;
+    while (a > 0) {
+        x = x + 1;
+        a--;
+    }
+    y = x;
+    return y;
+}
+"""
+
+
+def as_ids(sets):
+    return {k: {d.node for d in v} for k, v in sets.items()}
+
+
+def by_code(cpg):
+    return {n.code: n.id for n in cpg.nodes.values()}
+
+
+def test_gen_kill_and_domain():
+    cpg = parse_function(LOOP_FUNC)
+    rd = ReachingDefinitions(cpg)
+    assert sorted(d.code for d in rd.domain) == [
+        "a--", "x = 1", "x = x + 1", "y = 0", "y = x",
+    ]
+    c = by_code(cpg)
+    assert rd.assigned_variable(c["x = 1"]) == "x"
+    assert rd.assigned_variable(c["a > 0"]) is None
+    # a def of x kills the other defs of x, not itself
+    killed = rd.kill(c["x = x + 1"], rd.domain)
+    assert {d.code for d in killed} == {"x = 1"}
+
+
+def test_loop_fixpoint_semantics():
+    cpg = parse_function(LOOP_FUNC)
+    rd = ReachingDefinitions(cpg)
+    in_sets, out_sets = rd.solve()
+    c = by_code(cpg)
+    code_in = lambda nid: {cpg.nodes[d.node].code for d in in_sets[nid]}
+    # before the condition: both x defs reach (initial + loop back-edge)
+    assert code_in(c["a > 0"]) == {"x = 1", "x = x + 1", "y = 0", "a--"}
+    # after `x = x + 1`, the init def of x is killed on that path
+    assert code_in(c["a--"]) == {"x = x + 1", "y = 0", "a--"}
+    # at return, y = 0 is killed by y = x
+    ret = next(n.id for n in cpg.nodes.values() if n.label == "RETURN")
+    assert code_in(ret) == {"x = 1", "x = x + 1", "y = x", "a--"}
+
+
+@pytest.mark.parametrize("solver", [solve_bitvec, solve_native])
+def test_vector_solvers_match_reference(solver):
+    for code in (
+        LOOP_FUNC,
+        "int g(int n) { int s = 0; for (int i = 0; i < n; i++) { if (i % 2) s += i; else s -= 1; } return s; }",
+        "int h(int a) { int x = 0; do { x++; if (x > 3) break; a -= 1; } while (a); return x; }",
+        "int k(void) { return 0; }",  # no definitions at all
+    ):
+        cpg = parse_function(code)
+        rd = ReachingDefinitions(cpg)
+        in_py, out_py = rd.solve()
+        got_in, got_out = solver(rd)
+        assert as_ids(in_py) == got_in, code
+        assert as_ids(out_py) == got_out, code
+
+
+def test_weird_operators_spelling():
+    """Joern sometimes emits <operators> instead of <operator>; both must be
+    recognised as definitions (reference: dataflow.py:82-84 +
+    test_weird_assignment_operators)."""
+    assert "<operators>.assignment" in MOD_OPS
+    nodes = [
+        Node(1, "CALL", name="<operators>.assignment", code="x = 1", line=1),
+        Node(2, "IDENTIFIER", name="x", code="x", line=1, order=1),
+        Node(3, "LITERAL", code="1", line=1, order=2),
+        Node(4, "CALL", name="foo", code="foo(x)", line=2),
+    ]
+    edges = [(1, 2, "ARGUMENT"), (1, 3, "ARGUMENT"), (1, 4, "CFG")]
+    rd = ReachingDefinitions(CPG(nodes, edges))
+    assert len(rd.domain) == 1
+    assert rd.assigned_variable(1) == "x"
+
+
+def test_variable_definition_identity():
+    a = VariableDefinition("x", 5, "x = 1")
+    b = VariableDefinition("x", 5, "different code")
+    c = VariableDefinition("x", 6, "x = 1")
+    assert a == b and a != c  # identity is the node id (reference contract)
+
+
+def test_large_domain_multiword_bitsets():
+    """>64 definitions exercises multi-word bit vectors in both fast solvers."""
+    lines = [f"  int v{i} = {i};" for i in range(70)]
+    lines += [f"  v{i} = v{i} + 1;" for i in range(70)]
+    code = "int big(void) {\n" + "\n".join(lines) + "\n  return v0;\n}"
+    cpg = parse_function(code)
+    rd = ReachingDefinitions(cpg)
+    assert len(rd.domain) == 140
+    in_py, out_py = rd.solve()
+    for solver in (solve_bitvec, solve_native):
+        got_in, got_out = solver(rd)
+        assert as_ids(in_py) == got_in
+        assert as_ids(out_py) == got_out
+
+
+def test_pointer_and_array_defs_textual():
+    """*p and a[i] definitions use the textual variable id, like the
+    reference (code of the first ARGUMENT child)."""
+    cpg = parse_function("void f(int *p, int a[], int i) { *p = 1; a[i] = 2; }")
+    rd = ReachingDefinitions(cpg)
+    vars_ = {d.var for d in rd.domain}
+    assert vars_ == {"*p", "a[i]"}
+
+
+def test_for_init_declaration_is_a_def():
+    """Regression: `for (int i = 0; ...)` init decl must generate a def."""
+    cpg = parse_function("int f(int n){int s=0; for(int i=0;i<n;i++) s+=i; return s;}")
+    rd = ReachingDefinitions(cpg)
+    assert {d.var for d in rd.domain} == {"s", "i"}
+    assert sorted(d.code for d in rd.domain if d.var == "i") == ["i = 0", "i++"]
+
+
+def test_ternary_branches_fork_cfg():
+    """Regression: defs in one ternary arm must not kill the other arm's."""
+    cpg = parse_function("int h(int c){int x=0; int y = c ? (x=1) : (x=2); return x;}")
+    rd = ReachingDefinitions(cpg)
+    in_sets, _ = rd.solve()
+    ret = next(n.id for n in cpg.nodes.values() if n.label == "RETURN")
+    reaching = {cpg.nodes[d.node].code for d in in_sets[ret] if d.var == "x"}
+    assert reaching == {"x = 1", "x = 2"}  # both arms reach the return
+
+
+def test_short_circuit_forks_cfg():
+    """Regression: `c && (x=1)` may skip the assignment; the pre-existing def
+    must still reach the return."""
+    cpg = parse_function("int g(int c){int x=0; if (c && (x=1)) c = 2; return x;}")
+    rd = ReachingDefinitions(cpg)
+    in_sets, _ = rd.solve()
+    ret = next(n.id for n in cpg.nodes.values() if n.label == "RETURN")
+    reaching = {cpg.nodes[d.node].code for d in in_sets[ret] if d.var == "x"}
+    assert reaching == {"x = 0", "x = 1"}
+
+
+def test_label_on_empty_statement_is_goto_target():
+    """Regression: `done: ;` must materialise a jump target; the goto path
+    must stay connected."""
+    cpg = parse_function("int f(int x){x=5; if(x>0) goto done; x=1; done: ; return x;}")
+    rd = ReachingDefinitions(cpg)
+    in_sets, _ = rd.solve()
+    ret = next(n.id for n in cpg.nodes.values() if n.label == "RETURN")
+    reaching = {cpg.nodes[d.node].code for d in in_sets[ret] if d.var == "x"}
+    assert reaching == {"x = 5", "x = 1"}
+
+
+def test_parse_source_multiple_functions_isolated():
+    """Regression: scopes/labels must not leak across functions."""
+    from deepdfa_tpu.cpg.frontend import parse_source
+
+    cpg = parse_source(
+        "int a(int p){ return p; }\n"
+        "int b(int q){ return q; }\n"
+    )
+    methods = [n for n in cpg.nodes.values() if n.label == "METHOD"]
+    assert {m.name for m in methods} == {"a", "b"}
+    # identifier q in b() must not see a()'s param type via a leaked scope;
+    # and no CFG edge may cross the two functions' node-id ranges
+    ids_a = {n.id for n in cpg.nodes.values() if n.line == 1}
+    ids_b = {n.id for n in cpg.nodes.values() if n.line == 2}
+    for s, d, e in cpg.edges:
+        if e == "CFG":
+            assert not (s in ids_a and d in ids_b) and not (s in ids_b and d in ids_a)
+
+
+def test_pointer_decl_ambiguity_is_declaration():
+    """Regression: `uint8_t *p = x;` must lower as a declaration+assignment
+    of p, not as a multiplication expression."""
+    cpg = parse_function("int f(my_t *b){ uint8_t *p = b; return 0; }")
+    rd = ReachingDefinitions(cpg)
+    assert {d.var for d in rd.domain} == {"p"}

@@ -1,0 +1,66 @@
+"""Golden-file tests for the Joern JSON ingestion path (format contract:
+edges rows are [innode, outnode, etype, variable]; see get_func_graph.sc)."""
+
+from pathlib import Path
+
+import pytest
+
+from deepdfa_tpu.cpg.dataflow import ReachingDefinitions
+from deepdfa_tpu.cpg.joern import JoernRunner, load_cpg, load_dataflow, load_tables
+
+STEM = Path(__file__).parent / "fixtures" / "sample.c"
+
+
+def test_load_tables_filters_and_dedupes():
+    nodes, edges = load_tables(STEM)
+    assert "FILE" not in set(nodes._label)
+    assert "COMMENT" not in set(nodes._label)
+    assert not set(edges.etype) & {"CONTAINS", "SOURCE_FILE", "DOMINATE", "POST_DOMINATE"}
+    # duplicate CFG 2->5 deduped
+    cfg = edges[edges.etype == "CFG"]
+    assert len(cfg[(cfg.outnode == 2) & (cfg.innode == 5)]) == 1
+
+
+def test_edge_direction_contract():
+    """Row [innode, outnode, ...] means outnode -> innode (source first in
+    our CPG)."""
+    cpg = load_cpg(STEM)
+    assert 2 in cpg.successors(1, "CFG")  # METHOD -> assignment
+    assert 3 in cpg.successors(2, "ARGUMENT")
+
+
+def test_load_cpg_drops_lineless_and_lone_nodes():
+    cpg = load_cpg(STEM)
+    assert 102 not in cpg.nodes  # no lineNumber
+    assert all(n.line is not None for n in cpg.nodes.values())
+
+
+def test_rd_on_joern_graph_matches_exported_solution():
+    """Our solver on the ingested graph reproduces Joern's exported
+    solution.in/out for the definition node."""
+    cpg = load_cpg(STEM)
+    rd = ReachingDefinitions(cpg)
+    in_sets, out_sets = rd.solve()
+    golden = load_dataflow(str(STEM) + ".dataflow.json")["f"]
+    for nid, defs in golden["solution.in"].items():
+        got = {d.node for d in in_sets.get(nid, set())}
+        assert got == set(defs), nid
+    for nid, defs in golden["solution.out"].items():
+        got = {d.node for d in out_sets.get(nid, set())}
+        assert got == set(defs), nid
+
+
+def test_missing_method_raises(tmp_path):
+    import json
+
+    (tmp_path / "x.c.nodes.json").write_text(json.dumps([{"id": 1, "_label": "CALL"}]))
+    (tmp_path / "x.c.edges.json").write_text(json.dumps([]))
+    with pytest.raises(ValueError, match="METHOD"):
+        load_tables(tmp_path / "x.c")
+
+
+def test_runner_unavailable_is_clear():
+    r = JoernRunner(script="/nonexistent/get_func_graph.sc", joern_bin="definitely-not-joern")
+    assert not r.available
+    with pytest.raises(RuntimeError, match="native frontend"):
+        r.run("/tmp/nope.c")
