@@ -1,0 +1,588 @@
+"""Dataset ingestion: raw corpora → labeled function tables.
+
+Host-side (pure pandas/difflib) re-design of the reference's ingestion stack:
+
+- comment stripping            — ``DDFA/sastvd/helpers/datasets.py:19-33``
+- Big-Vul CSV reader + filters — ``datasets.py:139-292``
+- Devign JSON reader           — ``datasets.py:36-102``
+- mutated variants             — ``datasets.py:105-126``
+- diff labeling                — ``helpers/git.py:12-165`` (the reference
+  shells out to ``git diff --no-index``; we compute the same combined-view
+  line labels with ``difflib`` — no subprocess, no temp files, same contract:
+  1-based line numbers into the *combined* before+after view)
+- validity / file filters      — ``datasets.py:295-405``
+- split maps + partitioning    — ``datasets.py:431-523``
+- dataset class w/ resampling  — ``helpers/dclass.py:18-118`` (the per-epoch
+  index draw itself lives in ``deepdfa_tpu/data/sampler.py``)
+
+Artifacts are cached under ``cache_dir()/minimal_datasets`` like the
+reference's minimal parquet cache (``datasets.py:144-156``); the format is
+parquet when an engine is available, pickle otherwise.
+"""
+
+from __future__ import annotations
+
+import difflib
+import json
+import re
+from glob import glob
+from pathlib import Path
+from typing import Callable, Iterable
+
+import numpy as np
+import pandas as pd
+
+from deepdfa_tpu import utils
+
+__all__ = [
+    "remove_comments",
+    "diff_lines",
+    "label_diffs",
+    "bigvul",
+    "devign",
+    "ds",
+    "itempath",
+    "check_validity",
+    "filter_dataset",
+    "linevul_splits",
+    "codexglue_splits",
+    "named_splits",
+    "splits_map",
+    "partition",
+    "VulnDataset",
+]
+
+# ---------------------------------------------------------------------------
+# comment stripping
+
+
+_COMMENT_OR_STRING = re.compile(
+    # string literals first so comment markers inside them survive
+    r'"(?:\\.|[^"\\])*"'
+    r"|'(?:\\.|[^'\\])*'"
+    r"|/\*.*?\*/"
+    r"|//[^\n]*",
+    re.DOTALL,
+)
+
+
+def remove_comments(text: str) -> str:
+    """Strip ``//`` and ``/* */`` comments from C code, leaving string
+    literals intact. Comments become a single space (so token boundaries and
+    byte offsets inside a line stay sane), exactly like the reference
+    (``datasets.py:19-33`` replaces with ``" "``, not ``""``)."""
+
+    def _repl(m: re.Match) -> str:
+        s = m.group(0)
+        return " " if s.startswith("/") else s
+
+    return _COMMENT_OR_STRING.sub(_repl, text)
+
+
+# ---------------------------------------------------------------------------
+# diff labeling (combined-view line numbers)
+
+
+def diff_lines(before: str, after: str) -> dict:
+    """Combined diff of two function versions.
+
+    Returns ``{"diff", "added", "removed", "before", "after"}`` where
+
+    - ``diff`` is the hunk body: every line of the combined view prefixed
+      with ``" "``, ``"-"`` (only in before) or ``"+"`` (only in after);
+    - ``added`` / ``removed`` are 1-based line numbers **into the combined
+      view** (parity with ``git.py:74-79``, which indexes the single full-
+      context hunk the reference requests with ``-U<total>``);
+    - ``before`` / ``after`` are the combined views with the other side's
+      lines commented out (``git.py:128-165`` ``allfunc``), so line numbers
+      in both versions agree with the combined numbering — this is what makes
+      per-line vulnerability labels transferable to the CPG.
+    """
+    old_lines = before.splitlines()
+    new_lines = after.splitlines()
+    sm = difflib.SequenceMatcher(a=old_lines, b=new_lines, autojunk=False)
+    diff: list[str] = []
+    added: list[int] = []
+    removed: list[int] = []
+    view_before: list[str] = []
+    view_after: list[str] = []
+    for tag, i1, i2, j1, j2 in sm.get_opcodes():
+        if tag in ("equal",):
+            for line in old_lines[i1:i2]:
+                diff.append(" " + line)
+                view_before.append(line)
+                view_after.append(line)
+        else:
+            for line in old_lines[i1:i2]:
+                diff.append("-" + line)
+                removed.append(len(diff))
+                view_before.append(line)
+                view_after.append("// " + line)
+            for line in new_lines[j1:j2]:
+                diff.append("+" + line)
+                added.append(len(diff))
+                view_before.append("// " + line)
+                view_after.append(line)
+    return {
+        "diff": "\n".join(diff),
+        "added": added,
+        "removed": removed,
+        "before": "\n".join(view_before),
+        "after": "\n".join(view_after),
+    }
+
+
+def _label_one(item: tuple) -> dict:
+    func_before, func_after = item
+    if func_before == func_after:
+        return {
+            "diff": "",
+            "added": [],
+            "removed": [],
+            "before": func_before,
+            "after": func_before,
+        }
+    return diff_lines(func_before, func_after)
+
+
+def label_diffs(df: pd.DataFrame, workers: int = 6) -> pd.DataFrame:
+    """Attach diff/added/removed/before/after columns (parallel host map,
+    replacing the reference's per-id pickle cache + git subprocess fan-out,
+    ``datasets.py:207-217``)."""
+    infos = utils.dfmp(
+        df, _label_one, columns=["func_before", "func_after"], workers=workers,
+        desc="diff: ",
+    )
+    info_df = pd.DataFrame(infos, index=df.index)
+    return pd.concat([df.drop(columns=info_df.columns, errors="ignore"), info_df], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# cache IO (parquet if an engine exists, else pickle)
+
+
+def _cache_path(name: str, sample: bool) -> Path:
+    d = utils.get_dir(utils.cache_dir() / "minimal_datasets")
+    return d / f"minimal_{name}{'_sample' if sample else ''}"
+
+
+def _cache_save(df: pd.DataFrame, base: Path) -> Path:
+    try:
+        path = base.with_suffix(".pq")
+        df.to_parquet(path, index=False)
+        return path
+    except Exception:
+        path = base.with_suffix(".pkl")
+        df.to_pickle(path)
+        return path
+
+
+def _cache_load(base: Path) -> pd.DataFrame | None:
+    for suffix in (".pq", ".pkl"):
+        path = base.with_suffix(suffix)
+        if path.exists():
+            try:
+                if suffix == ".pq":
+                    return pd.read_parquet(path).dropna()
+                return pd.read_pickle(path).dropna()
+            except Exception:
+                continue
+    return None
+
+
+# ---------------------------------------------------------------------------
+# readers
+
+
+_MINIMAL_COLS = ["id", "before", "after", "removed", "added", "diff", "vul", "dataset"]
+
+
+def _abnormal_ending(code: str) -> bool:
+    """Functions that do not end in ``}``/``;`` were truncated upstream
+    (``datasets.py:223-238``)."""
+    stripped = code.strip()
+    if not stripped:
+        return True
+    if stripped[-1] not in ("}", ";"):
+        return True
+    return stripped.endswith(");")
+
+
+def bigvul(
+    csv_path: str | Path | None = None,
+    cache: bool = True,
+    sample: bool = False,
+    workers: int = 6,
+) -> pd.DataFrame:
+    """Big-Vul (MSR) reader: CSV → comment-strip → diff labels → quality
+    filters → minimal table (``datasets.py:139-292``).
+
+    Quality filters applied to vulnerable rows only (non-vul rows pass):
+    no-change diffs, abnormal endings, modified-proportion ≥ 0.7, ≤ 5 lines.
+    """
+    base = _cache_path("bigvul", sample)
+    if cache and csv_path is None:
+        cached = _cache_load(base)
+        if cached is not None:
+            return cached
+    default_source = csv_path is None
+    if csv_path is None:
+        name = "MSR_data_cleaned_SAMPLE.csv" if sample else "MSR_data_cleaned.csv"
+        csv_path = utils.external_dir() / name
+    df = pd.read_csv(csv_path, dtype={"commit_id": str, "project": str})
+    if "Unnamed: 0" in df.columns:
+        df = df.rename(columns={"Unnamed: 0": "id"})
+    if "id" not in df.columns:
+        df = df.rename_axis("id").reset_index()
+    df["dataset"] = "bigvul"
+    df["vul"] = df["vul"].astype(int)
+
+    df["func_before"] = utils.dfmp(
+        df, remove_comments, columns="func_before", workers=workers, cs=500,
+        desc="strip: ",
+    )
+    df["func_after"] = utils.dfmp(
+        df, remove_comments, columns="func_after", workers=workers, cs=500,
+        desc="strip: ",
+    )
+    df = label_diffs(df, workers=workers)
+
+    dfv = df[df.vul == 1]
+    dfv = dfv[dfv.apply(lambda r: len(r.added) + len(r.removed) > 0, axis=1)]
+    dfv = dfv[~dfv.func_before.apply(_abnormal_ending)]
+    dfv = dfv[~dfv.func_after.apply(_abnormal_ending)]
+    dfv = dfv[~dfv.before.apply(lambda c: c.strip().endswith(");"))]
+    if len(dfv):
+        mod_prop = dfv.apply(
+            lambda r: (len(r.added) + len(r.removed))
+            / max(len(r["diff"].splitlines()), 1),
+            axis=1,
+        )
+        dfv = dfv[mod_prop < 0.7]
+    if len(dfv):
+        dfv = dfv[dfv.before.apply(lambda c: len(c.splitlines()) > 5)]
+    keep_vul = set(dfv["id"])
+    df = df[(df.vul == 0) | (df["id"].isin(keep_vul))].copy()
+
+    out = df[_MINIMAL_COLS].reset_index(drop=True)
+    # Only the canonical source may populate the shared cache; a custom
+    # csv_path (subsets, tests) must not poison later default loads.
+    if cache and default_source:
+        _cache_save(out, base)
+    return out
+
+
+def devign(
+    json_path: str | Path | None = None, cache: bool = True, sample: bool = False
+) -> pd.DataFrame:
+    """Devign reader: ``function.json`` → graph-level labels only
+    (``datasets.py:36-102``); no line labels (no before/after pairs)."""
+    base = _cache_path("devign", sample)
+    if cache and json_path is None:
+        cached = _cache_load(base)
+        if cached is not None:
+            return cached
+    default_source = json_path is None
+    if json_path is None:
+        json_path = utils.external_dir() / "function.json"
+    df = pd.read_json(json_path)
+    df = df.rename_axis("id").reset_index()
+    df["dataset"] = "devign"
+    df["before"] = [remove_comments(c).replace("\n\n", "\n") for c in df["func"]]
+    df = df[~df.before.apply(lambda c: not c.strip() or (c.strip()[-1] not in "};"))]
+    df = df[~df.before.apply(lambda c: c.strip().endswith(");"))]
+    df["vul"] = df["target"].astype(int)
+    if sample:
+        df = df.head(50)
+    out = df[["id", "dataset", "before", "target", "vul"]].reset_index(drop=True)
+    if cache and default_source:
+        _cache_save(out, base)
+    return out
+
+
+def mutated(
+    subdataset: str, cache: bool = True, sample: bool = False
+) -> pd.DataFrame:
+    """Mutation-robustness variants: Big-Vul rows joined with mutated sources
+    (``datasets.py:105-126``). ``*_flip`` uses the mutation *source* column."""
+    df = bigvul(cache=cache, sample=sample).drop(columns=["dataset", "before"])
+    fp = utils.external_dir() / "mutated" / f"c_{subdataset.replace('_flip', '')}.jsonl"
+    mut = pd.read_json(fp, lines=True)
+    col = "source" if "flip" in subdataset else "target"
+    mut = mut.rename(columns={col: "before"}).drop(
+        columns=[c for c in ("source", "target") if c != col], errors="ignore"
+    )
+    df = pd.merge(df, mut, left_on="id", right_on="idx", how="inner")
+    df["dataset"] = f"mutated_{subdataset}"
+    return df.drop(columns=["after", "added", "removed", "diff"], errors="ignore")
+
+
+def ds(dsname: str, cache: bool = True, sample: bool = False, **kw) -> pd.DataFrame:
+    """Dataset dispatcher (``datasets.py:129-137``)."""
+    if dsname == "bigvul":
+        return bigvul(cache=cache, sample=sample, **kw)
+    if dsname == "devign":
+        return devign(cache=cache, sample=sample, **kw)
+    if dsname.startswith("mutated"):
+        return mutated(dsname.split("_", maxsplit=1)[1], cache=cache, sample=sample)
+    raise ValueError(f"unknown dataset {dsname!r}")
+
+
+# ---------------------------------------------------------------------------
+# extraction-artifact filters
+
+
+def itempath(_id, dsname: str = "bigvul") -> Path:
+    """Path of the per-function source file whose extraction artifacts
+    (``.nodes.json``/``.edges.json``/``.dataflow.json``) sit next to it
+    (``datasets.py:333-335``)."""
+    return utils.processed_dir() / dsname / "before" / f"{_id}.c"
+
+
+def check_validity(
+    _id,
+    dsname: str = "bigvul",
+    require_line_number: bool = False,
+    require_dataflow: bool = False,
+) -> bool:
+    """A sample is valid when its extracted graph parses, has ≥1 node with a
+    line number, and (optionally) has dataflow edges (``datasets.py:295-330``)."""
+    path = itempath(_id, dsname)
+    try:
+        with open(f"{path}.nodes.json") as f:
+            nodes = json.load(f)
+        with open(f"{path}.edges.json") as f:
+            edges = json.load(f)
+    except Exception:
+        return False
+    if not nodes or not edges:
+        return False
+    if not any("lineNumber" in n for n in nodes):
+        if require_line_number:
+            return False
+    etypes = {e[2] for e in edges}
+    if require_dataflow and not ({"REACHING_DEF", "CDG"} & etypes):
+        return False
+    return True
+
+
+def filter_dataset(
+    df: pd.DataFrame,
+    dsname: str,
+    check_file: bool = False,
+    check_valid: bool = False,
+    vulonly: bool = False,
+    load_code: bool = True,
+    sample: int = -1,
+    sample_mode: bool = False,
+    seed: int = 0,
+    validity_fn: Callable | None = None,
+) -> pd.DataFrame:
+    """Training-time dataset filters (``datasets.py:352-405``): optional random
+    subsample, vul-only, drop rows with no extraction artifacts on disk, drop
+    rows failing validity (with a CSV cache so re-runs skip the scan)."""
+    if sample > 0:
+        df = df.sample(sample, random_state=seed)
+    if vulonly:
+        df = df[df.vul == 1]
+    if check_file:
+        have = {
+            int(Path(p).name.split(".")[0])
+            for p in glob(str(utils.processed_dir() / dsname / "before" / "*.nodes.json"))
+            if not Path(p).name.startswith("~")
+        }
+        df = df[df.id.isin(have)]
+    if check_valid:
+        # A custom validity_fn bypasses the shared cache: the cache file is
+        # keyed only by (dsname, sample_mode) and must stay tied to the
+        # default check (the reference has no validity_fn hook to collide).
+        if validity_fn is not None:
+            valid = [validity_fn(i) for i in df.id]
+            df = df[pd.Series(valid, index=df.index)]
+        else:
+            cache = utils.cache_dir() / f"{dsname}_valid_{sample_mode}.csv"
+            if cache.exists():
+                valid_df = pd.read_csv(cache, index_col=0)
+            else:
+                valid = [check_validity(i, dsname) for i in df.id]
+                valid_df = pd.DataFrame({"id": df.id, "valid": valid}, index=df.index)
+                valid_df.to_csv(cache)
+            df = df[df.id.isin(valid_df[valid_df["valid"]].id)]
+    assert len(df) > 0, "all rows filtered out"
+    if not load_code:
+        df = df.drop(
+            columns=["before", "after", "removed", "added", "diff"], errors="ignore"
+        )
+    return df
+
+
+# ---------------------------------------------------------------------------
+# splits
+
+
+def linevul_splits(path: str | Path | None = None) -> pd.Series:
+    """Fixed Big-Vul splits (LineVul protocol): id-indexed train/val/test
+    (``datasets.py:449-454``)."""
+    path = path or utils.external_dir() / "linevul_splits.csv"
+    s = pd.read_csv(path, index_col=0)["split"]
+    return s.replace("valid", "val")
+
+
+def codexglue_splits(path: str | Path | None = None) -> pd.Series:
+    """Fixed Devign splits (CodeXGLUE protocol) (``datasets.py:457-462``)."""
+    path = path or utils.external_dir() / "codexglue_splits.csv"
+    df = pd.read_csv(path).set_index("example_index")
+    return df["split"].replace("valid", "val")
+
+
+def named_splits(name: str, path: str | Path | None = None) -> pd.Series:
+    """Named cross-project split files (``datasets.py:465-473``); ``holdout``
+    folds into ``test``."""
+    path = path or utils.external_dir() / "splits" / f"{name}.csv"
+    df = pd.read_csv(path, index_col=0).set_index("example_index")
+    return df["split"].replace({"valid": "val", "holdout": "test"})
+
+
+def splits_map(dsname: str) -> dict:
+    """Default fixed-split map per dataset (``datasets.py:431-438``)."""
+    if dsname == "bigvul" or dsname.startswith("mutated"):
+        return linevul_splits().to_dict()
+    if dsname == "devign":
+        return codexglue_splits().to_dict()
+    raise ValueError(dsname)
+
+
+def partition(
+    df: pd.DataFrame,
+    part: str,
+    dsname: str = "bigvul",
+    split: str = "fixed",
+    seed: int = 0,
+    splits: dict | None = None,
+) -> pd.DataFrame:
+    """Label rows with train/val/test and optionally select one partition
+    (``datasets.py:475-520``).
+
+    ``split="random"``: hold out the *fixed* test set entirely, then assign
+    val/test/train as 10/10/80% of a seed-deterministic permutation — same
+    construction as the reference, so same seed ⇒ same split.
+    """
+    df = df.copy()
+    if split == "random":
+        smap = splits if splits is not None else splits_map(dsname)
+        fixed = df.id.map(smap)
+        df = df[fixed != "test"].copy()
+        n = len(df)
+        perm = np.random.RandomState(seed=seed).permutation(df.index.to_numpy())
+        n_val = int(n * 0.1)
+        n_test = int(n * 0.2)
+        # Reference quirk parity (datasets.py:489-500): position i in the
+        # *unpermuted* range decides the label; the permutation decides which
+        # row gets position i.
+        df["label"] = pd.Series(
+            ["val" if i < n_val else "test" if i < n_test else "train" for i in range(n)],
+            index=perm,
+        )
+    elif split == "fixed":
+        smap = splits if splits is not None else splits_map(dsname)
+        df["label"] = df.id.map(smap)
+    elif split == "linevul":
+        # LineVD random splits file (the reference's split="linevul" branch,
+        # datasets.py:506-509, reading bigvul_rand_splits.csv).
+        smap = splits if splits is not None else pd.read_csv(
+            utils.external_dir() / "bigvul_rand_splits.csv"
+        ).set_index("id")["split"].to_dict()
+        df["label"] = df.id.map(smap)
+    else:
+        smap = splits if splits is not None else named_splits(split).to_dict()
+        df["label"] = df.id.map(smap)
+    if part != "all":
+        df = df[df.label == part]
+    return df
+
+
+# ---------------------------------------------------------------------------
+# dataset class
+
+
+class VulnDataset:
+    """Partitioned function-level dataset with per-epoch rebalancing.
+
+    Parity with ``BigVulDataset`` (``dclass.py:18-118``): filter → partition →
+    ``idx2id``; ``epoch_ids`` re-draws the undersampled non-vul subset every
+    epoch (seeded by (seed, epoch) — deterministic, unlike the reference's
+    mutable ``RandomState``, but equally resampled-per-epoch).
+    """
+
+    def __init__(
+        self,
+        dsname: str = "bigvul",
+        part: str = "train",
+        seed: int = 0,
+        sample: int = -1,
+        sample_mode: bool = False,
+        split: str = "fixed",
+        undersample: str | float | None = None,
+        oversample: float | None = None,
+        check_file: bool = True,
+        check_valid: bool = True,
+        vulonly: bool = False,
+        df: pd.DataFrame | None = None,
+        splits: dict | None = None,
+    ):
+        self.part = part
+        self.undersample = undersample
+        self.oversample = oversample
+        self.seed = seed
+        if df is None:
+            df = ds(dsname, sample=sample_mode)
+        df = filter_dataset(
+            df,
+            dsname,
+            check_file=check_file,
+            check_valid=check_valid,
+            vulonly=vulonly,
+            load_code=True,
+            sample=sample,
+            sample_mode=sample_mode,
+            seed=seed,
+        )
+        if not sample_mode:
+            df = partition(df, part, dsname, split=split, seed=seed, splits=splits)
+        self.df = df.reset_index(drop=True)
+        self.idx2id = dict(zip(self.df.index, self.df.id.values))
+
+    def vuln_lines(self, _id) -> dict[int, int]:
+        """Removed (= vulnerable) line numbers for one function
+        (``dclass.py:78-82``)."""
+        removed = self.df[self.df.id == _id].removed.item()
+        return {i: 1 for i in removed}
+
+    def epoch_ids(self, epoch: int = 0, shuffle: bool = True) -> np.ndarray:
+        """Example *ids* to visit this epoch (rebalanced, reshuffled)."""
+        from deepdfa_tpu.data.sampler import epoch_indices
+
+        idx = epoch_indices(
+            self.df.vul.to_numpy(),
+            undersample=self.undersample,
+            oversample=self.oversample,
+            seed=self.seed,
+            epoch=epoch,
+            shuffle=shuffle,
+        )
+        return self.df.id.to_numpy()[idx]
+
+    def positive_weight(self) -> float:
+        from deepdfa_tpu.data.sampler import positive_weight
+
+        return positive_weight(self.df.vul.to_numpy())
+
+    def __getitem__(self, idx: int) -> dict:
+        return self.df.iloc[idx].to_dict()
+
+    def __len__(self) -> int:
+        return len(self.df)
+
+    def __repr__(self) -> str:
+        frac = round(float((self.df.vul == 1).mean()), 3) if len(self.df) else 0.0
+        return f"VulnDataset(part={self.part}, n={len(self.df)}, vul%={frac})"

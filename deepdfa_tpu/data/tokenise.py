@@ -1,0 +1,34 @@
+"""IVDetect-style subtoken tokenizer.
+
+Parity with ``DDFA/sastvd/helpers/tokenise.py:4-35``: split on any
+non-alphanumeric character, then split camelCase boundaries (lower→Upper and
+ACRONYMWord boundaries), drop single-character tokens, join with spaces.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = ["tokenise", "tokenise_lines"]
+
+_NON_ALNUM = re.compile(r"[^a-zA-Z0-9]+")
+_CAMEL = re.compile(
+    r".+?(?:(?<=[a-z])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])|$)"
+)
+
+
+def tokenise(s: str) -> str:
+    words = [w for w in _NON_ALNUM.split(s) if w]
+    subtokens = [m.group(0) for w in words for m in _CAMEL.finditer(w)]
+    return " ".join(t for t in subtokens if len(t) > 1)
+
+
+def tokenise_lines(s: str) -> list[str]:
+    """Per-line tokenisation, empty lines dropped
+    (``tokenise.py:23-35``)."""
+    out = []
+    for line in s.splitlines():
+        tok = tokenise(line)
+        if tok:
+            out.append(tok)
+    return out

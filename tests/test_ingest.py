@@ -1,0 +1,239 @@
+"""Ingestion-layer tests: comment stripping, diff labeling, readers,
+splits, resampling — behavioral parity with the reference's
+``helpers/datasets.py`` / ``helpers/git.py`` / ``helpers/dclass.py``."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from deepdfa_tpu.data import ingest
+from deepdfa_tpu.data.tokenise import tokenise, tokenise_lines
+
+
+# ---------------------------------------------------------------------------
+# remove_comments
+
+
+def test_remove_comments_line_and_block():
+    src = "int a = 1; // trailing\n/* block\ncomment */ int b = 2;\n"
+    out = ingest.remove_comments(src)
+    assert "trailing" not in out
+    assert "block" not in out
+    assert "int a = 1;" in out and "int b = 2;" in out
+
+
+def test_remove_comments_preserves_strings():
+    src = 'char *s = "// not a comment"; char c = \'/\';\n'
+    assert ingest.remove_comments(src) == src
+
+
+def test_remove_comments_replaces_with_space():
+    # " " not "": token boundary must survive (datasets.py:25 note)
+    assert ingest.remove_comments("a/*x*/b") == "a b"
+
+
+# ---------------------------------------------------------------------------
+# diff labeling
+
+
+BEFORE = """bool f(struct data *d, const char *s)
+{
+    int rc = 0;
+    log_enter(d);
+    push(d, TAG);
+    write(d, s);
+    pop(d);
+    log_exit(d);
+    return !d->has_error;
+}
+"""
+
+AFTER = """bool f(struct data *d, const char *s)
+{
+    int rc = 0;
+    log_enter(d);
+    if (!push(d, TAG)) return false;
+    write(d, s);
+    return pop(d);
+    log_exit(d);
+}
+"""
+
+
+def test_diff_lines_combined_numbering():
+    ret = ingest.diff_lines(BEFORE, AFTER)
+    lines = ret["diff"].splitlines()
+    # every removed index points at a '-' line, every added at '+'
+    for i in ret["removed"]:
+        assert lines[i - 1].startswith("-"), lines[i - 1]
+    for i in ret["added"]:
+        assert lines[i - 1].startswith("+"), lines[i - 1]
+    assert ret["removed"] and ret["added"]
+    # combined views have one line per diff line, other side commented out
+    assert len(ret["before"].splitlines()) == len(lines)
+    assert len(ret["after"].splitlines()) == len(lines)
+    for i in ret["added"]:
+        assert ret["before"].splitlines()[i - 1].startswith("// ")
+    for i in ret["removed"]:
+        assert ret["after"].splitlines()[i - 1].startswith("// ")
+
+
+def test_diff_lines_identical_inputs():
+    ret = ingest._label_one((BEFORE, BEFORE))
+    assert ret["added"] == [] and ret["removed"] == []
+    assert ret["before"] == BEFORE
+
+
+# ---------------------------------------------------------------------------
+# readers (synthetic CSV/JSON fixtures)
+
+
+def _fake_bigvul_csv(tmp_path, n_nonvul=6):
+    rows = []
+    # one real vulnerable function with a fix
+    rows.append(
+        dict(func_before=BEFORE, func_after=AFTER, vul=1, project="p",
+             commit_id="c0")
+    )
+    # a vulnerable function with no textual change → filtered
+    rows.append(
+        dict(func_before=BEFORE, func_after=BEFORE, vul=1, project="p",
+             commit_id="c1")
+    )
+    # a truncated vulnerable function → filtered
+    rows.append(
+        dict(func_before="int g(", func_after="int g(int x", vul=1,
+             project="p", commit_id="c2")
+    )
+    for i in range(n_nonvul):
+        code = f"int h{i}(int x)\n{{\n  int y = x + {i};\n  return y;\n}}\n"
+        rows.append(
+            dict(func_before=code, func_after=code, vul=0, project="p",
+                 commit_id=f"n{i}")
+        )
+    df = pd.DataFrame(rows)
+    path = tmp_path / "msr.csv"
+    df.to_csv(path, index=True)
+    return path
+
+
+def test_bigvul_reader_filters(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    path = _fake_bigvul_csv(tmp_path)
+    df = ingest.bigvul(csv_path=path, cache=False, workers=1)
+    assert set(ingest._MINIMAL_COLS) <= set(df.columns)
+    vul = df[df.vul == 1]
+    assert len(vul) == 1  # no-change and truncated rows dropped
+    assert len(df[df.vul == 0]) == 6  # non-vul rows untouched
+    row = vul.iloc[0]
+    assert row.added and row.removed
+
+
+def test_devign_reader(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    funcs = [
+        {"func": "int f() { return 1; } // c", "target": 1, "project": "q"},
+        {"func": "int g() { return 2; }", "target": 0, "project": "q"},
+        {"func": "int bad(", "target": 0, "project": "q"},  # filtered
+    ]
+    path = tmp_path / "function.json"
+    path.write_text(json.dumps(funcs))
+    df = ingest.devign(json_path=path, cache=False)
+    assert len(df) == 2
+    assert df.vul.tolist() == [1, 0]
+    assert "// c" not in df.iloc[0].before
+
+
+# ---------------------------------------------------------------------------
+# splits / partition
+
+
+def _toy_df(n=100):
+    return pd.DataFrame(
+        {"id": np.arange(n), "vul": (np.arange(n) % 10 == 0).astype(int)}
+    )
+
+
+def _fixed_map(n=100):
+    # last 20 ids are the fixed test split
+    return {i: ("test" if i >= 80 else "train" if i < 70 else "val") for i in range(n)}
+
+
+def test_partition_fixed():
+    df = _toy_df()
+    out = ingest.partition(df, "train", split="fixed", splits=_fixed_map())
+    assert set(out.label) == {"train"}
+    assert (out.id < 70).all()
+
+
+def test_partition_random_deterministic_and_excludes_fixed_test():
+    df = _toy_df()
+    a = ingest.partition(df, "all", split="random", seed=42, splits=_fixed_map())
+    b = ingest.partition(df, "all", split="random", seed=42, splits=_fixed_map())
+    assert a["label"].tolist() == b["label"].tolist()
+    # fixed test ids held out entirely (datasets.py:484-487)
+    assert not (a.id >= 80).any()
+    c = ingest.partition(df, "all", split="random", seed=7, splits=_fixed_map())
+    assert c["label"].tolist() != a["label"].tolist()
+    # size-preserving across seeds
+    assert a.label.value_counts().to_dict() == c.label.value_counts().to_dict()
+    # 10/10/80 proportions
+    vc = a.label.value_counts()
+    assert vc["val"] == int(len(a) * 0.1)
+    assert vc["test"] == int(len(a) * 0.2) - int(len(a) * 0.1)
+
+
+# ---------------------------------------------------------------------------
+# VulnDataset
+
+
+def test_vuln_dataset_epoch_resampling(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    n = 120
+    df = pd.DataFrame(
+        {
+            "id": np.arange(n),
+            "vul": (np.arange(n) % 12 == 0).astype(int),
+            "before": ["int f() { return 0; }"] * n,
+            "removed": [[1] if i % 12 == 0 else [] for i in range(n)],
+        }
+    )
+    smap = {i: ("test" if i % 5 == 4 else "val" if i % 5 == 3 else "train") for i in range(n)}
+    dset = ingest.VulnDataset(
+        part="train", df=df, splits=smap, check_file=False, check_valid=False,
+        undersample="v1.0",
+    )
+    assert len(dset) == sum(1 for v in smap.values() if v == "train")
+    ids0 = dset.epoch_ids(epoch=0)
+    ids1 = dset.epoch_ids(epoch=1)
+    # balanced: n_nonvul == n_vul (v1.0)
+    vul_ids = set(dset.df[dset.df.vul == 1].id)
+    n_vul = sum(1 for i in ids0 if i in vul_ids)
+    assert len(ids0) == 2 * n_vul
+    # resampled differently across epochs, deterministically per epoch
+    assert list(ids0) != list(ids1)
+    assert list(ids0) == list(dset.epoch_ids(epoch=0))
+    assert dset.positive_weight() == pytest.approx(
+        (len(dset) - n_vul) / n_vul
+    )
+    assert dset.vuln_lines(0) == {1: 1}
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+
+
+def test_tokenise_ivdetect():
+    # reference doctest input (tokenise.py:8)
+    out = tokenise("FooBar fooBar foo bar_blub23/x~y'z")
+    assert out.split() == ["Foo", "Bar", "foo", "Bar", "foo", "bar", "blub23"]
+
+
+def test_tokenise_acronym_boundary():
+    assert tokenise("HTTPServer") == "HTTP Server"
+
+
+def test_tokenise_lines():
+    assert tokenise_lines("fooBar baz\n\nx\nqux") == ["foo Bar baz", "qux"]
