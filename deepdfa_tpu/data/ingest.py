@@ -199,13 +199,10 @@ _MINIMAL_COLS = ["id", "before", "after", "removed", "added", "diff", "vul", "da
 
 def _abnormal_ending(code: str) -> bool:
     """Functions that do not end in ``}``/``;`` were truncated upstream
-    (``datasets.py:223-238``)."""
+    (``datasets.py:223-238``). The separate ``");"`` filter applies only to
+    the combined before view (``datasets.py:238``), not here."""
     stripped = code.strip()
-    if not stripped:
-        return True
-    if stripped[-1] not in ("}", ";"):
-        return True
-    return stripped.endswith(");")
+    return not stripped or stripped[-1] not in ("}", ";")
 
 
 def bigvul(
@@ -289,7 +286,7 @@ def devign(
     df = df.rename_axis("id").reset_index()
     df["dataset"] = "devign"
     df["before"] = [remove_comments(c).replace("\n\n", "\n") for c in df["func"]]
-    df = df[~df.before.apply(lambda c: not c.strip() or (c.strip()[-1] not in "};"))]
+    df = df[~df.before.apply(_abnormal_ending)]
     df = df[~df.before.apply(lambda c: c.strip().endswith(");"))]
     df["vul"] = df["target"].astype(int)
     if sample:

@@ -1,0 +1,167 @@
+"""CPG → training-ready graphs (the "dbize" stage).
+
+Covers the reference's materialisation scripts:
+
+- node/edge selection — ``sastvd/linevd/utils.py:28-76`` ``feature_extraction``:
+  keep nodes with a line number, restrict edges to the CFG subgraph, drop
+  lone nodes, renumber to 0..n-1;
+- per-node vulnerability labels — ``sastvd/scripts/dbize.py:30-57``:
+  ``vuln = line ∈ removed ∪ dep-add`` for Big-Vul; graph-label broadcast for
+  Devign (``:59-81``);
+- graph construction — ``sastvd/scripts/dbize_graphs.py:20-33``: the
+  reference builds ``dgl.graph((innode, outnode))``, i.e. message passing
+  runs **against** CPG edge direction (a CPG CFG edge is outnode→innode);
+  our ``Graph(senders=innode, receivers=outnode)`` reproduces that, and
+  self-loops are appended as ``dgl.add_self_loop`` does;
+- feature attachment — ``linevd/graphmogrifier.py:59-97``: the combined
+  ``_ABS_DATAFLOW`` id plus per-subkey ``_ABS_DATAFLOW_{subkey}`` ids.
+
+Output graphs serialise via ``data/graphs.py`` ``save_shards`` (the
+``graphs.bin`` replacement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Mapping
+
+import numpy as np
+import pandas as pd
+
+from deepdfa_tpu.config import ALL_SUBKEYS, FeatureConfig
+from deepdfa_tpu.cpg.features import extract_features, features_to_hashes
+from deepdfa_tpu.cpg.schema import CPG
+from deepdfa_tpu.data.graphs import Graph
+from deepdfa_tpu.data.vocab import Vocabulary, build_vocab
+
+__all__ = ["select_cfg_nodes", "graph_from_cpg", "CorpusBuilder"]
+
+
+def select_cfg_nodes(cpg: CPG) -> tuple[list[int], list[tuple[int, int]]]:
+    """(ordered node ids, CFG edge list) after the reference's selection:
+    nodes need a line number, edges are deduped CFG edges between kept nodes,
+    lone nodes are dropped."""
+    with_line = [i for i, n in cpg.nodes.items() if n.line is not None]
+    keep = set(with_line)
+    edges = sorted(
+        {(s, d) for s, d, e in cpg.edges if e == "CFG" and s in keep and d in keep}
+    )
+    connected = {s for s, _ in edges} | {d for _, d in edges}
+    nodes = [i for i in with_line if i in connected]
+    return nodes, edges
+
+
+def graph_from_cpg(
+    cpg: CPG,
+    gid: int,
+    feat_ids: Mapping[str, Mapping[int, int]],
+    vuln_lines: set[int] | None = None,
+    graph_label: int | None = None,
+) -> Graph | None:
+    """Build one training graph. ``feat_ids`` maps feature name →
+    {node_id: int id}. Exactly one of ``vuln_lines`` (per-line labels,
+    Big-Vul) / ``graph_label`` (broadcast, Devign) must be given.
+
+    Returns None when no CFG structure survives selection (the reference
+    drops such graphs at load time, ``linevd/dataset.py:40-45``).
+    """
+    nodes, edges = select_cfg_nodes(cpg)
+    if not nodes:
+        return None
+    pos = {nid: i for i, nid in enumerate(nodes)}
+    # reference direction: dgl.graph((innode, outnode)) — message source is
+    # the CPG edge's *destination* (innode).
+    senders = np.array([pos[d] for _, d in edges], dtype=np.int32)
+    receivers = np.array([pos[s] for s, _ in edges], dtype=np.int32)
+
+    if (vuln_lines is None) == (graph_label is None):
+        raise ValueError("exactly one of vuln_lines/graph_label required")
+    if vuln_lines is not None:
+        vuln = np.array(
+            [1 if cpg.nodes[n].line in vuln_lines else 0 for n in nodes],
+            dtype=np.int32,
+        )
+    else:
+        vuln = np.full(len(nodes), int(graph_label), dtype=np.int32)
+
+    feats: dict[str, np.ndarray] = {"_VULN": vuln}
+    for name, ids in feat_ids.items():
+        feats[name] = np.array([ids.get(n, 0) for n in nodes], dtype=np.int32)
+
+    g = Graph(senders=senders, receivers=receivers, node_feats=feats, gid=gid)
+    return g.with_self_loops()
+
+
+@dataclasses.dataclass
+class CorpusBuilder:
+    """End-to-end feature pipeline over an in-memory corpus of CPGs.
+
+    Run order matches ``DDFA/scripts/preprocess.sh``: stage-1/2 feature
+    extraction → train-split vocab → per-node encoding → graph emission.
+    One instance per :class:`FeatureConfig`; per-subkey features reuse the
+    same extraction with single-subkey configs (``dbize_absdf.py:21-33``'s
+    feature grid collapses to the configs actually requested).
+    """
+
+    feature: FeatureConfig = dataclasses.field(default_factory=FeatureConfig)
+    concat_all_absdf: bool = True
+
+    def extract(self, cpgs: Mapping[int, CPG], raise_all: bool = False) -> pd.DataFrame:
+        """Stage 1+2: per-definition hash table for the whole corpus."""
+        frames = []
+        for gid, cpg in cpgs.items():
+            f = extract_features(cpg, gid, raise_all=raise_all)
+            if len(f):
+                frames.append(f)
+        if not frames:
+            return pd.DataFrame(columns=["graph_id", "node_id", "hash"])
+        feats = pd.concat(frames, ignore_index=True)
+        return features_to_hashes(feats, self.feature.subkeys)
+
+    def vocabs(
+        self, hash_df: pd.DataFrame, train_ids: Iterable[int]
+    ) -> dict[str, Vocabulary]:
+        """The combined vocab plus one single-subkey vocab per subkey when
+        ``concat_all_absdf`` (each with the same limits, as in the
+        reference's feature grid)."""
+        train_ids = list(train_ids)
+        out = {"_ABS_DATAFLOW": build_vocab(hash_df, train_ids, self.feature)}
+        if self.concat_all_absdf:
+            for sk in ALL_SUBKEYS:
+                cfg = dataclasses.replace(self.feature, subkeys=(sk,))
+                out[f"_ABS_DATAFLOW_{sk}"] = build_vocab(hash_df, train_ids, cfg)
+        return out
+
+    def build(
+        self,
+        cpgs: Mapping[int, CPG],
+        train_ids: Iterable[int],
+        vuln_lines: Mapping[int, set[int]] | None = None,
+        graph_labels: Mapping[int, int] | None = None,
+        raise_all: bool = False,
+    ) -> tuple[list[Graph], dict[str, Vocabulary]]:
+        """Full pipeline; returns (graphs, vocabs). Graphs with no CFG are
+        dropped (counted by comparing lengths)."""
+        hash_df = self.extract(cpgs, raise_all=raise_all)
+        vocabs = self.vocabs(hash_df, train_ids)
+        by_graph: dict[int, dict[int, str]] = {}
+        for row in hash_df.itertuples(index=False):
+            by_graph.setdefault(int(row.graph_id), {})[int(row.node_id)] = row.hash
+
+        graphs: list[Graph] = []
+        for gid, cpg in cpgs.items():
+            hashes = by_graph.get(int(gid), {})
+            feat_ids = {
+                name: {n: voc.feature_id(h) for n, h in hashes.items()}
+                for name, voc in vocabs.items()
+            }
+            g = graph_from_cpg(
+                cpg,
+                gid,
+                feat_ids,
+                vuln_lines=set(vuln_lines[gid]) if vuln_lines is not None else None,
+                graph_label=graph_labels[gid] if graph_labels is not None else None,
+            )
+            if g is not None:
+                graphs.append(g)
+        return graphs, vocabs

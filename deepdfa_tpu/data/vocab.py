@@ -1,0 +1,121 @@
+"""Train-split abstract-dataflow vocabularies and node-feature encoding.
+
+Re-design of the reference's ``abs_dataflow`` (``helpers/datasets.py:587-692``)
+and the ``nodes_feat_*`` grid writer (``sastvd/scripts/dbize_absdf.py``):
+
+- per-subkey vocabularies are frequency-ranked over **train-split
+  definitions only** with a ``limit_subkeys`` cutoff; index 0 is reserved
+  (``hashes.insert(0, None)``, ``datasets.py:641-644``);
+- the combined vocabulary re-hashes each definition with out-of-vocab subkey
+  values replaced by ``"UNKNOWN"`` (unless ``include_unknown``), then ranks
+  the combined JSON hashes with a ``limit_all`` cutoff (``:648-688``);
+- node feature ids follow ``dbize_absdf.py:34-43``: ``0`` = not a
+  definition, ``1`` = definition with out-of-vocab hash (UNKNOWN), ``2..``
+  = known hashes — hence ``input_dim = limit_all + 2``
+  (``linevd/datamodule.py:87-96``).
+
+Known deliberate deviation: the reference computes ``hash.all`` through a
+train-frame ``apply`` whose result is assigned back by *positional* index
+(``datasets.py:674-675``), leaving non-train rows' combined hashes
+misaligned; we compute every row's combined hash directly (the evident
+intent — vocab ranking still uses train rows only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, Mapping
+
+import pandas as pd
+
+from deepdfa_tpu.config import ALL_SUBKEYS, SINGLE_SUBKEYS, FeatureConfig
+
+__all__ = ["Vocabulary", "build_vocab", "encode_nodes", "UNKNOWN"]
+
+UNKNOWN = "UNKNOWN"
+
+
+def _hash_values(hash_dict: Mapping[str, list], subkey: str) -> list[str]:
+    """The (deduped, sorted) subkey values of one definition hash; datatype
+    is single-valued (``datasets.py:551-556,620-627``)."""
+    values = [str(v) for v in hash_dict.get(subkey, [])]
+    if SINGLE_SUBKEYS.get(subkey, False):
+        return values[:1]
+    return sorted(set(values))
+
+
+@dataclasses.dataclass(frozen=True)
+class Vocabulary:
+    """Subkey vocabs + the combined vocab for one :class:`FeatureConfig`."""
+
+    cfg: FeatureConfig
+    subkey_vocabs: dict[str, dict[str, int]]
+    all_vocab: dict[str | None, int]
+
+    def combined_hash(self, hash_dict: Mapping[str, list]) -> str:
+        """Canonical combined hash with UNKNOWN substitution
+        (``datasets.py:649-672``)."""
+        out = {}
+        for sk in sorted(self.cfg.subkeys):
+            values = _hash_values(hash_dict, sk)
+            if not self.cfg.include_unknown:
+                vocab = self.subkey_vocabs[sk]
+                values = [v if v in vocab else UNKNOWN for v in values]
+            out[sk] = sorted(set(values))
+        return json.dumps(out)
+
+    def feature_id(self, hash_json: str | None) -> int:
+        """Node feature id: 0 not-a-def, 1 UNKNOWN, 2.. known
+        (``dbize_absdf.py:34-43``)."""
+        if hash_json is None:
+            return 0
+        combined = self.combined_hash(json.loads(hash_json))
+        return self.all_vocab.get(combined, 0) + 1
+
+    @property
+    def input_dim(self) -> int:
+        return self.cfg.input_dim
+
+
+def _rank(values: pd.Series, limit: int | None) -> dict:
+    counts = values.value_counts()
+    if limit is not None:
+        counts = counts.head(limit)
+    return {v: i + 1 for i, v in enumerate(counts.index)}
+
+
+def build_vocab(
+    hash_df: pd.DataFrame, train_ids: Iterable[int], cfg: FeatureConfig
+) -> Vocabulary:
+    """Build vocabularies from stage-2 hashes.
+
+    ``hash_df``: columns ``graph_id, node_id, hash`` (JSON). Ranking uses
+    only rows whose ``graph_id`` is in ``train_ids`` — train-split-only
+    vocab determinism is a correctness requirement (SURVEY.md §7).
+    """
+    train_ids = set(int(i) for i in train_ids)
+    df = hash_df.copy()
+    df["hash_dict"] = df["hash"].apply(json.loads)
+    train = df[df.graph_id.isin(train_ids)]
+
+    subkey_vocabs: dict[str, dict[str, int]] = {}
+    for sk in cfg.subkeys:
+        exploded = train["hash_dict"].apply(lambda h: _hash_values(h, sk)).explode().dropna()
+        subkey_vocabs[sk] = _rank(exploded, cfg.limit_subkeys)
+
+    vocab = Vocabulary(cfg=cfg, subkey_vocabs=subkey_vocabs, all_vocab={})
+    combined_train = train["hash_dict"].apply(vocab.combined_hash)
+    all_vocab = _rank(combined_train, cfg.limit_all)
+    return dataclasses.replace(vocab, all_vocab=all_vocab)
+
+
+def encode_nodes(
+    node_ids: Iterable[int],
+    graph_hashes: Mapping[int, str],
+    vocab: Vocabulary,
+) -> list[int]:
+    """Feature ids for one graph's nodes. ``graph_hashes`` maps node_id →
+    stage-2 hash JSON for that graph's definitions; non-definition nodes
+    get 0."""
+    return [vocab.feature_id(graph_hashes.get(int(n))) for n in node_ids]

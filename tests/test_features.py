@@ -1,0 +1,280 @@
+"""Feature-pipeline tests: abstract-dataflow extraction over CPGs from the
+native C frontend, train-split vocab construction, node encoding, and graph
+materialisation — parity with ``abstract_dataflow_full.py`` /
+``datasets.py:587-692`` / ``dbize*.py`` semantics."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from deepdfa_tpu.config import FeatureConfig
+from deepdfa_tpu.cpg import features as F
+from deepdfa_tpu.cpg.frontend import parse_function
+from deepdfa_tpu.data.materialize import CorpusBuilder, graph_from_cpg, select_cfg_nodes
+from deepdfa_tpu.data.vocab import build_vocab
+
+CODE = """
+int f(int x) {
+    int y = x + 1;
+    char *p = (char*)malloc(10);
+    y += bar(x);
+    if (y > 0) { y--; }
+    return y;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def cpg():
+    return parse_function(CODE)
+
+
+def test_is_def_detects_assignments(cpg):
+    defs = [i for i in cpg.nodes if F.is_def(cpg, i)]
+    codes = sorted(cpg.nodes[i].code for i in defs)
+    assert codes == ["p = (char *)malloc(10)", "y += bar(x)", "y = x + 1", "y--"]
+
+
+def test_definition_subkeys(cpg):
+    by_code = {cpg.nodes[i].code: i for i in cpg.nodes if F.is_def(cpg, i)}
+
+    # y = x + 1: datatype int, literal 1, operator addition
+    fields = F.definition_subkeys(cpg, by_code["y = x + 1"], raise_all=True)
+    d = {}
+    for sk, _n, text in fields:
+        d.setdefault(sk, []).append(text)
+    assert d["datatype"] == ["int"]
+    assert d["literal"] == ["1"]
+    assert "addition" in d["operator"]
+    assert "api" not in d
+
+    # p = (char*)malloc(10): api malloc, operator cast, datatype char *
+    fields = F.definition_subkeys(cpg, by_code["p = (char *)malloc(10)"], raise_all=True)
+    d = {}
+    for sk, _n, text in fields:
+        d.setdefault(sk, []).append(text)
+    assert d["api"] == ["malloc"]
+    assert "cast" in d["operator"]
+    assert d["datatype"] == ["char *"]
+
+    # y += bar(x): api bar
+    fields = F.definition_subkeys(cpg, by_code["y += bar(x)"], raise_all=True)
+    assert any(sk == "api" and text == "bar" for sk, _n, text in fields)
+
+
+def test_clean_datatype():
+    assert F.clean_datatype("const char *") == "char *"
+    assert F.clean_datatype("int [10]") == "int[]"
+    assert F.clean_datatype("unsigned   long\tlong") == "unsigned long long"
+
+
+def test_extract_and_hash(cpg):
+    feats = F.extract_features(cpg, graph_id=7, raise_all=True)
+    assert set(feats.subkey) <= {"api", "datatype", "literal", "operator"}
+    hashes = F.features_to_hashes(feats, ("api", "datatype", "literal", "operator"))
+    assert (hashes.graph_id == 7).all()
+    # one hash row per definition that produced fields
+    assert hashes.node_id.is_unique
+    h = json.loads(hashes.iloc[0]["hash"])
+    assert sorted(h) == ["api", "datatype", "literal", "operator"]
+    assert all(isinstance(v, list) for v in h.values())
+
+
+# ---------------------------------------------------------------------------
+# vocab
+
+
+def _corpus():
+    """Three tiny functions; graphs 0,1 are 'train'."""
+    codes = {
+        0: "int a(int x) { int y = x + 1; y += g(x); return y; }",
+        1: "int b(int x) { int y = x + 2; int z = h(y); return z; }",
+        2: "int c(int x) { float w = x * 3.0f; w -= g(x); return (int)w; }",
+    }
+    return {gid: parse_function(c) for gid, c in codes.items()}
+
+
+def test_vocab_train_split_only():
+    cpgs = _corpus()
+    builder = CorpusBuilder(FeatureConfig(limit_subkeys=100, limit_all=100))
+    hash_df = builder.extract(cpgs, raise_all=True)
+    vocab = build_vocab(hash_df, train_ids=[0, 1], cfg=builder.feature)
+    # 'g'/'h' appear in train; api vocab built from train only
+    assert "g" in vocab.subkey_vocabs["api"]
+    # float datatype only in graph 2 (non-train) → not in vocab
+    assert "float" not in vocab.subkey_vocabs["datatype"]
+    # indices start at 1 (0 reserved for None)
+    assert min(vocab.all_vocab.values()) == 1
+
+    # train hash encodes to >= 2; unseen combined hash (graph 2) → UNKNOWN id 1
+    train_hashes = hash_df[hash_df.graph_id == 0]
+    hid = vocab.feature_id(train_hashes.iloc[0]["hash"])
+    assert hid >= 2
+    g2 = hash_df[hash_df.graph_id == 2]
+    ids = [vocab.feature_id(h) for h in g2["hash"]]
+    assert 1 in ids  # the float-typed def can't be in the train vocab
+    assert vocab.feature_id(None) == 0
+
+
+def test_vocab_limit_one():
+    cpgs = _corpus()
+    builder = CorpusBuilder(FeatureConfig(limit_subkeys=1, limit_all=1))
+    hash_df = builder.extract(cpgs, raise_all=True)
+    vocab = build_vocab(hash_df, [0, 1], builder.feature)
+    assert len(vocab.all_vocab) == 1
+    ids = {vocab.feature_id(h) for h in hash_df["hash"]}
+    assert ids <= {1, 2}  # UNKNOWN or the single kept hash
+
+
+def test_include_unknown_keeps_raw_values():
+    cpgs = _corpus()
+    cfg = FeatureConfig(limit_subkeys=1, limit_all=100, include_unknown=True)
+    builder = CorpusBuilder(cfg)
+    hash_df = builder.extract(cpgs, raise_all=True)
+    vocab = build_vocab(hash_df, [0, 1], cfg)
+    # with include_unknown, combined hashes keep raw subkey values
+    assert not any("UNKNOWN" in h for h in vocab.all_vocab if h)
+
+
+# ---------------------------------------------------------------------------
+# materialisation
+
+
+def test_select_cfg_nodes(cpg):
+    nodes, edges = select_cfg_nodes(cpg)
+    assert nodes and edges
+    keep = set(nodes)
+    assert all(s in keep and d in keep for s, d in edges)
+    # all selected nodes have line numbers
+    assert all(cpg.nodes[n].line is not None for n in nodes)
+
+
+def test_graph_from_cpg_labels_and_direction(cpg):
+    nodes, edges = select_cfg_nodes(cpg)
+    vuln_line = cpg.nodes[nodes[0]].line
+    g = graph_from_cpg(cpg, gid=3, feat_ids={}, vuln_lines={vuln_line})
+    assert g is not None and g.gid == 3
+    assert g.node_feats["_VULN"].sum() >= 1
+    # self-loops appended: last n edges are i→i
+    n = g.n_nodes
+    assert (g.senders[-n:] == np.arange(n)).all()
+    # message direction reversed vs CPG edges: for CPG edge (s,d) there is a
+    # graph edge senders=pos[d] → receivers=pos[s]
+    pos = {nid: i for i, nid in enumerate(nodes)}
+    s0, d0 = edges[0]
+    pairs = set(zip(g.senders.tolist(), g.receivers.tolist()))
+    assert (pos[d0], pos[s0]) in pairs
+
+
+def test_graph_label_broadcast(cpg):
+    g = graph_from_cpg(cpg, gid=1, feat_ids={}, vuln_lines=None, graph_label=1)
+    assert (g.node_feats["_VULN"] == 1).all()
+    with pytest.raises(ValueError):
+        graph_from_cpg(cpg, gid=1, feat_ids={})
+
+
+def test_corpus_builder_end_to_end():
+    cpgs = _corpus()
+    builder = CorpusBuilder(FeatureConfig(limit_subkeys=100, limit_all=100))
+    graphs, vocabs = builder.build(
+        cpgs,
+        train_ids=[0, 1],
+        vuln_lines={0: {1}, 1: set(), 2: set()},
+        raise_all=True,
+    )
+    assert len(graphs) == 3
+    names = {"_ABS_DATAFLOW"} | {f"_ABS_DATAFLOW_{s}" for s in ("api", "datatype", "literal", "operator")}
+    for g in graphs:
+        assert names <= set(g.node_feats)
+        assert "_VULN" in g.node_feats
+    g0 = next(g for g in graphs if g.gid == 0)
+    # graph 0's single-line function: the definition nodes carry nonzero ids
+    assert g0.node_feats["_ABS_DATAFLOW"].max() >= 2
+    # graph 0 has its line-1 statements labeled vulnerable
+    assert g0.node_feats["_VULN"].max() == 1
+
+    # batches + model forward on materialised graphs
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import GGNNConfig
+    from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+    from deepdfa_tpu.models.ggnn import GGNN
+
+    input_dim = builder.feature.input_dim
+    batch = next(GraphBatcher([BucketSpec(5, 128, 256)]).batches(graphs))
+    model = GGNN(cfg=GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2), input_dim=input_dim)
+    jbatch = jax.tree.map(jnp.asarray, batch)
+    params = model.init(jax.random.key(0), jbatch)["params"]
+    logits = model.apply({"params": params}, jbatch)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+# ---------------------------------------------------------------------------
+# dep-add lines
+
+
+def test_add_dependence_edges_data():
+    cpg = F.add_dependence_edges(
+        parse_function(
+            "int f(int x) {\n"
+            "    int y = x + 1;\n"   # line 2: def y
+            "    int z = y * 2;\n"   # line 3: uses y
+            "    return z;\n"
+            "}"
+        )
+    )
+    rd_edges = [(s, d) for s, d, e in cpg.edges if e == "REACHING_DEF"]
+    assert rd_edges, "no data-dependence edges derived"
+    # the def of y (line 2) reaches the statement using y (line 3)
+    lines = {(cpg.nodes[s].line, cpg.nodes[d].line) for s, d in rd_edges}
+    assert (2, 3) in lines
+
+
+def test_add_dependence_edges_control():
+    cpg = F.add_dependence_edges(
+        parse_function(
+            "int f(int x) {\n"
+            "    int y = 0;\n"
+            "    if (x > 0) {\n"     # line 3: branch
+            "        y = 1;\n"       # line 4: control-dependent on line 3
+            "    }\n"
+            "    return y;\n"
+            "}"
+        )
+    )
+    cdg = [(cpg.nodes[s].line, cpg.nodes[d].line) for s, d, e in cpg.edges if e == "CDG"]
+    assert (3, 4) in cdg
+    # return is NOT control-dependent on the branch (always executes)
+    assert (3, 6) not in cdg
+
+
+def test_dep_add_lines():
+    before = F.add_dependence_edges(
+        parse_function(
+            "int f(int x) {\n"
+            "    int y = x;\n"
+            "    int z = y + 1;\n"
+            "    return z;\n"
+            "}"
+        )
+    )
+    after = F.add_dependence_edges(
+        parse_function(
+            "int f(int x) {\n"
+            "    int y = x;\n"
+            "    if (y > 9) {\n"     # line 3 added: uses y, guards z
+            "        y = 9;\n"       # line 4 added
+            "    }\n"
+            "    int z = y + 1;\n"   # line 6 (= before line 3)
+            "    return z;\n"
+            "}"
+        )
+    )
+    out = F.dep_add_lines(before, after, added_lines=[3, 4])
+    before_lines = {n.line for n in before.nodes.values() if n.line is not None}
+    assert set(out) <= before_lines
+    # line 2 (def of y, used by the added guard) is dependent on added lines
+    assert 2 in out
