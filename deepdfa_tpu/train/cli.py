@@ -1,0 +1,372 @@
+"""Training/eval CLI — the ``main_cli.py`` replacement.
+
+Subcommands (parity with ``DDFA/code_gnn/main_cli.py`` +
+``DDFA/scripts/{train,test,run_analyze_dataset}.sh``):
+
+- ``fit``     — train with per-epoch undersample re-draws, per-epoch val,
+  best/last/periodic checkpoints, then restore the best checkpoint and
+  re-validate (``main_cli.py:167-184``).
+- ``test``    — restore a checkpoint and evaluate: overall + positive-only +
+  negative-only metric collections, PR curves → ``pr.csv``/``pr_binned.csv``,
+  classification report + confusion matrix, optional FLOPs/latency profiling
+  (``base_module.py:238-323,348-383``).
+- ``analyze`` — dataset coverage statistics (``--analyze_dataset``,
+  ``main_cli.py:192-313``): feature coverage per split, label balance.
+
+Config: layered YAML/JSON via ``--config a.yaml --config b.yaml`` (later
+wins) + dotted ``--set key.sub=value`` overrides — the LightningCLI layering
+semantics with typed validation (``deepdfa_tpu/config.py``).
+
+Logging: stream + per-run logfile; the logfile is renamed ``*.log.error`` on
+crash (``main_cli.py:322-336``). Per-epoch val F1 and the final F1 are
+appended to ``tuning.jsonl`` — the NNI intermediate/final reporting analogue
+(``base_module.py:346``, ``main_cli.py:184``).
+
+Data: loads materialised shards + ``splits.json`` from
+``processed_dir()/{dsname}/shards[_sample]`` when present, else falls back to
+a deterministic synthetic corpus (hermetic smoke/bench mode — the real
+Big-Vul corpus needs the offline extraction pipeline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from pathlib import Path
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu import utils
+from deepdfa_tpu.config import ExperimentConfig, load_config
+from deepdfa_tpu.data.graphs import BucketSpec, Graph, GraphBatcher, load_shards
+from deepdfa_tpu.data.sampler import epoch_indices, positive_weight
+from deepdfa_tpu.models.ggnn import GGNN
+from deepdfa_tpu.train import metrics as M
+from deepdfa_tpu.train.checkpoint import CheckpointManager
+from deepdfa_tpu.train.loop import Trainer
+
+logger = logging.getLogger("deepdfa_tpu")
+
+__all__ = ["main", "fit", "test", "analyze", "load_corpus", "coverage"]
+
+
+# ---------------------------------------------------------------------------
+# data loading
+
+
+def _synthetic_corpus(cfg: ExperimentConfig) -> dict[str, list[Graph]]:
+    from deepdfa_tpu.data.synthetic import random_dataset
+
+    n = 600 if not cfg.data.sample else 200
+    graphs = random_dataset(n, seed=cfg.data.seed, input_dim=cfg.input_dim)
+    rng = np.random.default_rng(cfg.data.seed)
+    assign = rng.permutation(n)
+    n_val, n_test = int(n * 0.1), int(n * 0.2)
+    val_ids = set(assign[:n_val].tolist())
+    test_ids = set(assign[n_val:n_test].tolist())
+    out: dict[str, list[Graph]] = {"train": [], "val": [], "test": []}
+    for g in graphs:
+        part = "val" if g.gid in val_ids else "test" if g.gid in test_ids else "train"
+        out[part].append(g)
+    return out
+
+
+def load_corpus(cfg: ExperimentConfig) -> dict[str, list[Graph]]:
+    """{split: [Graph]} from materialised shards, or synthetic fallback."""
+    sample_text = "_sample" if cfg.data.sample else ""
+    shard_dir = utils.processed_dir() / cfg.data.dsname / f"shards{sample_text}"
+    splits_file = shard_dir / "splits.json"
+    if shard_dir.exists() and splits_file.exists():
+        graphs = load_shards(shard_dir)
+        splits = {k: set(v) for k, v in json.loads(splits_file.read_text()).items()}
+        out: dict[str, list[Graph]] = {"train": [], "val": [], "test": []}
+        missing = 0
+        for g in graphs:
+            for part in out:
+                if g.gid in splits.get(part, ()):
+                    out[part].append(g)
+                    break
+            else:
+                missing += 1
+        if missing:
+            logger.warning("%d graphs without split assignment dropped", missing)
+        return out
+    logger.warning(
+        "no materialised shards at %s — using the synthetic corpus", shard_dir
+    )
+    return _synthetic_corpus(cfg)
+
+
+def _batcher(cfg: ExperimentConfig) -> GraphBatcher:
+    b = cfg.data.batch
+    return GraphBatcher(
+        [BucketSpec(b.batch_graphs + 1, b.max_nodes, b.max_edges)],
+        drop_oversize=b.drop_oversize,
+    )
+
+
+def _epoch_graphs(train: list[Graph], cfg: ExperimentConfig, epoch: int) -> list[Graph]:
+    labels = np.array([int(g.node_feats["_VULN"].max()) for g in train])
+    idx = epoch_indices(
+        labels,
+        undersample=cfg.data.undersample,
+        oversample=cfg.data.oversample,
+        seed=cfg.data.seed,
+        epoch=epoch,
+    )
+    return [train[i] for i in idx]
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+
+
+def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
+    corpus = load_corpus(cfg)
+    train, val = corpus["train"], corpus["val"]
+    train_labels = np.array([int(g.node_feats["_VULN"].max()) for g in train])
+    pos_weight = positive_weight(train_labels)
+    logger.info(
+        "corpus: train=%d val=%d test=%d pos_weight=%.2f",
+        len(train), len(val), len(corpus["test"]), pos_weight,
+    )
+
+    model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
+    trainer = Trainer(model, cfg, pos_weight=pos_weight)
+    batcher = _batcher(cfg)
+    example = jax.tree.map(jnp.asarray, next(batcher.batches(train[: cfg.data.batch.batch_graphs])))
+    state = trainer.init_state(example)
+    ckpts = CheckpointManager(run_dir / "checkpoints", cfg.checkpoint)
+    tuning_file = run_dir / "tuning.jsonl"
+
+    last_val: dict[str, float] = {}
+    for epoch in range(cfg.optim.max_epochs):
+        epoch_gs = _epoch_graphs(train, cfg, epoch)
+        state, train_m, train_loss = trainer.train_epoch(state, batcher.batches(epoch_gs))
+        val_m, val_loss = trainer.evaluate(state.params, batcher.batches(val))
+        last_val = val_m
+        logger.info(
+            "epoch %d: train_loss=%.4f train_F1=%.4f val_loss=%.4f val_F1=%.4f",
+            epoch, train_loss, train_m["train_F1Score"], val_loss, val_m["val_F1Score"],
+        )
+        ckpts.save(
+            int(state.step), {"params": state.params},
+            metrics={"val_loss": val_loss, "val_F1Score": val_m["val_F1Score"]},
+            epoch=epoch,
+        )
+        with open(tuning_file, "a") as f:
+            f.write(json.dumps({"epoch": epoch, "val_F1Score": val_m["val_F1Score"]}) + "\n")
+
+    # post-fit: restore best checkpoint and re-validate (main_cli.py:175-184)
+    best_step = ckpts.best_step()
+    if best_step is not None:
+        best = ckpts.restore(best_step, template={"params": state.params})
+        final_m, final_loss = trainer.evaluate(best["params"], batcher.batches(val))
+        logger.info(
+            "best ckpt step=%d: val_loss=%.4f val_F1=%.4f",
+            best_step, final_loss, final_m["val_F1Score"],
+        )
+        last_val = final_m
+    with open(tuning_file, "a") as f:
+        f.write(json.dumps({"final": True, "val_F1Score": last_val["val_F1Score"]}) + "\n")
+    (run_dir / "final_metrics.json").write_text(json.dumps(last_val, indent=2))
+    return last_val
+
+
+def test(
+    cfg: ExperimentConfig, run_dir: Path, ckpt_dir: Path | None = None
+) -> dict[str, float]:
+    corpus = load_corpus(cfg)
+    test_graphs = corpus["test"]
+    model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
+    trainer = Trainer(model, cfg)
+    batcher = _batcher(cfg)
+    example = jax.tree.map(jnp.asarray, next(batcher.batches(test_graphs)))
+    state = trainer.init_state(example)
+
+    ckpts = CheckpointManager(ckpt_dir or run_dir / "checkpoints", cfg.checkpoint)
+    if ckpts.latest_step() is not None:
+        restored = (
+            ckpts.restore_best(template={"params": state.params})
+            if ckpts.best_step() is not None
+            else ckpts.restore_latest(template={"params": state.params})
+        )
+        params = restored["params"]
+        logger.info("restored checkpoint")
+    else:
+        params = state.params
+        logger.warning("no checkpoint found — evaluating fresh init")
+
+    overall = M.ConfusionState.zeros()
+    pos = M.ConfusionState.zeros()
+    neg = M.ConfusionState.zeros()
+    all_probs, all_labels = [], []
+    losses, wsums = [], []
+
+    profiler = None
+    flops = None
+    flops_known = False
+    if cfg.profile or cfg.time:
+        from deepdfa_tpu.train.profiling import StepProfiler
+
+        profiler = StepProfiler(run_dir)
+
+    # one jitted step shared with fit-time validation — same label/mask
+    # semantics, one compile
+    eval_step = trainer.eval_step
+
+    for batch in batcher.batches(test_graphs):
+        batch = jax.tree.map(jnp.asarray, batch)
+        n_real = int(np.asarray(batch.graph_mask).sum())
+        if profiler is not None:
+            if cfg.profile and not flops_known:
+                # exact FLOPs of the compiled step, computed once per shape
+                cost = eval_step.lower(params, batch, overall).compile().cost_analysis()
+                flops = float(cost.get("flops", 0.0)) or None if cost else None
+                flops_known = True
+            overall, loss, probs, labels, weights = profiler.step(
+                eval_step, params, batch, overall, batch_size=n_real, flops=flops
+            )
+        else:
+            overall, loss, probs, labels, weights = eval_step(params, batch, overall)
+        pos, neg = M.update_confusion_by_class(pos, neg, probs, labels, weights > 0)
+        losses.append(float(loss))
+        wsums.append(float(np.asarray(weights).sum()))
+        keep = np.asarray(weights) > 0
+        all_probs.append(np.asarray(probs)[keep])
+        all_labels.append(np.asarray(labels)[keep])
+
+    probs = np.concatenate(all_probs)
+    labels = np.concatenate(all_labels)
+    total_w = sum(wsums)
+    results = {"test_loss": (
+        sum(l * w for l, w in zip(losses, wsums)) / total_w if total_w else 0.0
+    )}
+    results |= M.compute_metrics(overall, "test_")
+    results |= M.compute_metrics(pos, "test_pos_")
+    results |= M.compute_metrics(neg, "test_neg_")
+    results |= {f"report_{k}": v for k, v in M.classification_report(probs, labels).items()}
+
+    import pandas as pd
+
+    p, r, t = M.pr_curve(probs, labels.astype(int))
+    pd.DataFrame({"precision": p, "recall": r, "thresholds": t}).to_csv(run_dir / "pr.csv")
+    p, r, t = M.binned_pr_curve(probs, labels.astype(int), bins=100)
+    pd.DataFrame({"precision": p, "recall": r, "thresholds": t}).to_csv(run_dir / "pr_binned.csv")
+    logger.info("confusion matrix:\n%s", M.confusion_matrix(probs, labels))
+    logger.info("test metrics: %s", {k: round(v, 4) for k, v in results.items() if k.startswith("test_")})
+
+    if profiler is not None:
+        from deepdfa_tpu.train.profiling import report
+
+        profiler.flush()
+        prof = report(run_dir)
+        results |= {f"profile_{k}": v for k, v in prof.items()}
+        logger.info("profiling: %s", prof)
+
+    (run_dir / "test_metrics.json").write_text(json.dumps(results, indent=2))
+    return results
+
+
+def coverage(graphs: list[Graph], feat: str = "_ABS_DATAFLOW") -> dict[str, float]:
+    """Feature coverage statistics for one split (``get_coverage``,
+    ``main_cli.py:192-313``): how many nodes are definitions, how many of
+    those fell off the train vocab (UNKNOWN), label balance."""
+    n_nodes = n_defs = n_unknown = n_vul_nodes = n_vul_graphs = 0
+    for g in graphs:
+        ids = g.node_feats[feat]
+        n_nodes += ids.size
+        n_defs += int((ids != 0).sum())
+        n_unknown += int((ids == 1).sum())
+        n_vul_nodes += int(g.node_feats["_VULN"].sum())
+        n_vul_graphs += int(g.node_feats["_VULN"].max() > 0)
+    return {
+        "graphs": len(graphs),
+        "nodes": n_nodes,
+        "pct_def_nodes": n_defs / n_nodes if n_nodes else 0.0,
+        "pct_unknown_defs": n_unknown / n_defs if n_defs else 0.0,
+        "pct_known_defs": (n_defs - n_unknown) / n_defs if n_defs else 0.0,
+        "pct_vul_nodes": n_vul_nodes / n_nodes if n_nodes else 0.0,
+        "pct_vul_graphs": n_vul_graphs / len(graphs) if graphs else 0.0,
+    }
+
+
+def analyze(cfg: ExperimentConfig, run_dir: Path) -> dict[str, dict[str, float]]:
+    corpus = load_corpus(cfg)
+    out = {}
+    for part, graphs in corpus.items():
+        stats = coverage(graphs)
+        logger.info("%s coverage: %s", part, {k: round(v, 4) if isinstance(v, float) else v for k, v in stats.items()})
+        out[part] = stats
+    (run_dir / "coverage.json").write_text(json.dumps(out, indent=2))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry
+
+
+def _parse_overrides(pairs: Sequence[str]) -> dict:
+    out = {}
+    for pair in pairs:
+        key, _, value = pair.partition("=")
+        try:
+            out[key] = json.loads(value)
+        except json.JSONDecodeError:
+            out[key] = value
+    return out
+
+
+def main(argv: Sequence[str] | None = None) -> dict:
+    parser = argparse.ArgumentParser(prog="deepdfa-tpu")
+    parser.add_argument("command", choices=["fit", "test", "analyze"])
+    parser.add_argument("--config", action="append", default=[],
+                        help="layered config files (later files win)")
+    parser.add_argument("--set", action="append", default=[], dest="overrides",
+                        help="dotted overrides, e.g. --set optim.max_epochs=3")
+    parser.add_argument("--run-dir", default=None)
+    parser.add_argument("--ckpt-dir", default=None, help="checkpoint dir for test")
+    args = parser.parse_args(argv)
+
+    cfg = load_config(*args.config, overrides=_parse_overrides(args.overrides))
+    utils.seed_all(cfg.seed)
+
+    run_id = cfg.run_name or utils.get_run_id([args.command])
+    run_dir = Path(args.run_dir) if args.run_dir else utils.get_dir(
+        utils.storage_dir() / "runs" / run_id
+    )
+    run_dir.mkdir(parents=True, exist_ok=True)
+    log_file = run_dir / "run.log"
+    handlers = [logging.StreamHandler(sys.stderr), logging.FileHandler(log_file)]
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        handlers=handlers,
+        force=True,
+    )
+    from deepdfa_tpu.config import to_json
+
+    (run_dir / "config.json").write_text(to_json(cfg))
+    logger.info("run %s: %s devices=%s", run_id, args.command, jax.device_count())
+
+    try:
+        if args.command == "fit":
+            return fit(cfg, run_dir)
+        if args.command == "test":
+            return test(cfg, run_dir, Path(args.ckpt_dir) if args.ckpt_dir else None)
+        return analyze(cfg, run_dir)
+    except Exception:
+        # crash marker parity: rename log to .log.error (main_cli.py:324-336)
+        for h in handlers:
+            h.close()
+        log_file.rename(log_file.with_suffix(".log.error"))
+        raise
+
+
+if __name__ == "__main__":
+    main()

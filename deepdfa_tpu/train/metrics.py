@@ -22,11 +22,16 @@ import numpy as np
 __all__ = [
     "ConfusionState",
     "update_confusion",
+    "update_confusion_by_class",
     "compute_metrics",
     "MeanState",
     "update_mean",
     "pr_curve",
     "binned_pr_curve",
+    "classification_report",
+    "confusion_matrix",
+    "eval_statements",
+    "eval_statements_list",
 ]
 
 
@@ -97,6 +102,100 @@ def update_mean(state: MeanState, value, weight=1.0) -> MeanState:
     value = jnp.asarray(value, jnp.float32)
     weight = jnp.asarray(weight, jnp.float32)
     return MeanState(state.total + value * weight, state.count + weight)
+
+
+def update_confusion_by_class(
+    state_pos: ConfusionState,
+    state_neg: ConfusionState,
+    probs: jnp.ndarray,
+    labels: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    threshold: float = 0.5,
+) -> tuple[ConfusionState, ConfusionState]:
+    """Positive-only / negative-only metric collections (the reference's
+    ``test_metrics_positive`` / ``_negative``, ``base_module.py:50-60``):
+    each sees only the examples whose true label matches."""
+    m = jnp.ones_like(probs) if mask is None else mask.astype(jnp.float32)
+    lab = labels.astype(jnp.float32)
+    pos = update_confusion(state_pos, probs, labels, m * lab, threshold)
+    neg = update_confusion(state_neg, probs, labels, m * (1.0 - lab), threshold)
+    return pos, neg
+
+
+def classification_report(
+    probs: np.ndarray, labels: np.ndarray, macro: bool = True, threshold: float = 0.5
+) -> dict[str, float]:
+    """sklearn-style report distilled to the numbers the reference logs
+    (``train.py:450-459,576-585``): per-class P/R/F1 plus macro or weighted
+    averages (macro for imbalanced Big-Vul, weighted otherwise)."""
+    from sklearn.metrics import precision_recall_fscore_support
+
+    preds = (np.asarray(probs) >= threshold).astype(int)
+    labels = np.asarray(labels).astype(int)
+    p, r, f, s = precision_recall_fscore_support(
+        labels, preds, labels=[0, 1], zero_division=0
+    )
+    avg = "macro" if macro else "weighted"
+    pa, ra, fa, _ = precision_recall_fscore_support(
+        labels, preds, average=avg, zero_division=0
+    )
+    return {
+        "precision_0": float(p[0]), "recall_0": float(r[0]), "f1_0": float(f[0]),
+        "precision_1": float(p[1]), "recall_1": float(r[1]), "f1_1": float(f[1]),
+        f"precision_{avg}": float(pa), f"recall_{avg}": float(ra), f"f1_{avg}": float(fa),
+        "support_0": int(s[0]), "support_1": int(s[1]),
+    }
+
+
+def confusion_matrix(probs: np.ndarray, labels: np.ndarray, threshold: float = 0.5) -> np.ndarray:
+    """2x2 confusion matrix [[tn, fp], [fn, tp]] (``base_module.py:383``)."""
+    preds = (np.asarray(probs) >= threshold).astype(int)
+    labels = np.asarray(labels).astype(int)
+    return np.bincount(labels * 2 + preds, minlength=4).reshape(2, 2)
+
+
+def eval_statements(
+    probs: np.ndarray, labels: np.ndarray, thresh: float = 0.5
+) -> dict[int, int]:
+    """IVDetect top-k statement ranking for ONE function
+    (``helpers/evaluate.py:262-291``): rank statements by vulnerability
+    probability; hit@k = 1 iff a true-vulnerable statement is in the top k.
+    For functions with no vulnerable statement, hit@k = 1 iff nothing is
+    predicted above threshold (a correct all-clear)."""
+    probs = np.asarray(probs, dtype=float)
+    labels = np.asarray(labels, dtype=int)
+    if labels.sum() == 0:
+        clear = int(not (probs > thresh).any())
+        return {k: clear for k in range(1, 11)}
+    order = np.argsort(-probs, kind="stable")
+    ranked = labels[order]
+    return {k: int(ranked[:k].any()) for k in range(1, 11)}
+
+
+def eval_statements_list(
+    items: list[tuple[np.ndarray, np.ndarray]], thresh: float = 0.5, vulonly: bool = False
+) -> dict[int, float]:
+    """Corpus-level top-k hit rates (``evaluate.py:294-322``): mean hit@k over
+    vulnerable functions, optionally multiplied by the all-clear rate over
+    non-vulnerable functions (the reference's combined score)."""
+
+    def rate(subset):
+        if not subset:
+            return {k: 0.0 for k in range(1, 11)}
+        acc = {k: 0 for k in range(1, 11)}
+        for probs, labels in subset:
+            hit = eval_statements(probs, labels, thresh)
+            for k in acc:
+                acc[k] += hit[k]
+        return {k: v / len(subset) for k, v in acc.items()}
+
+    vul = [i for i in items if np.asarray(i[1]).sum() > 0]
+    vul_rate = rate(vul)
+    if vulonly:
+        return vul_rate
+    nonvul = [i for i in items if np.asarray(i[1]).sum() == 0]
+    nonvul_rate = rate(nonvul)
+    return {k: vul_rate[k] * nonvul_rate[k] for k in range(1, 11)}
 
 
 def pr_curve(probs: np.ndarray, labels: np.ndarray):

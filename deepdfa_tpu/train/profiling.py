@@ -1,0 +1,113 @@
+"""FLOPs + latency profiling with the reference's jsonl schema.
+
+The reference profiles with DeepSpeed's ``FlopsProfiler`` (flops/MACs/params
+per test batch → ``profiledata.jsonl``) and CUDA-event wall timing
+(``timedata.jsonl``) — ``base_module.py:240-281`` — then aggregates with
+``scripts/report_profiling.py``. TPU equivalents:
+
+- FLOPs from XLA's compiled-module cost analysis
+  (``jitted.lower(...).compile().cost_analysis()``), measured once per batch
+  shape (compilation is cached; the analysis is exact for the compiled HLO);
+- wall time via host-side monotonic timing around a ``block_until_ready``
+  step (the analogue of event-pair + synchronize);
+- the same jsonl row shapes, so the reference's aggregation arithmetic
+  (gflops / avg ms per example) carries over in :func:`report`.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["flops_of", "StepProfiler", "report"]
+
+
+def flops_of(fn: Callable, *args, **kwargs) -> float | None:
+    """FLOPs of one call of ``fn(*args)`` from XLA cost analysis; None when
+    the backend doesn't report it."""
+    lowered = jax.jit(fn).lower(*args, **kwargs)
+    cost = lowered.compile().cost_analysis()
+    if not cost:
+        return None
+    return float(cost.get("flops", 0.0)) or None
+
+
+class StepProfiler:
+    """Per-batch profiling writer (``profiledata.jsonl`` + ``timedata.jsonl``).
+
+    The reference skips the first batches to avoid warmup skew
+    (``base_module.py:240-248`` profiles batches > 2); we mirror that with
+    ``skip_first`` (also skipping the compile-time-bearing first call).
+    """
+
+    def __init__(self, out_dir: str | Path, skip_first: int = 2):
+        self.dir = Path(out_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.skip_first = skip_first
+        self._n = 0
+        self._profile_rows: list[dict] = []
+        self._time_rows: list[dict] = []
+
+    def step(self, fn: Callable, *args, batch_size: int, flops: float | None = None) -> Any:
+        """Run one profiled step (blocking) and record it. Warmup batches
+        (the first ``skip_first``, which bear compile time) are written with
+        ``warmup: true`` so :func:`report` can exclude them."""
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._n += 1
+        warmup = self._n <= self.skip_first
+        if flops is not None:
+            self._profile_rows.append(
+                {"batch": self._n, "flops": flops, "macs": flops / 2,
+                 "batch_size": batch_size, "warmup": warmup}
+            )
+        self._time_rows.append(
+            {"batch": self._n, "ms": ms, "batch_size": batch_size, "warmup": warmup}
+        )
+        return out
+
+    def flush(self) -> tuple[Path, Path]:
+        pf = self.dir / "profiledata.jsonl"
+        tf = self.dir / "timedata.jsonl"
+        with open(pf, "w") as f:
+            for row in self._profile_rows:
+                f.write(json.dumps(row) + "\n")
+        with open(tf, "w") as f:
+            for row in self._time_rows:
+                f.write(json.dumps(row) + "\n")
+        return pf, tf
+
+
+def report(out_dir: str | Path) -> dict[str, float]:
+    """Aggregate jsonl files the way ``scripts/report_profiling.py`` does:
+    average gflops / gmacs / latency per example."""
+    out_dir = Path(out_dir)
+    stats: dict[str, float] = {}
+
+    def load(path: Path) -> list[dict]:
+        if not path.exists():
+            return []
+        rows = [json.loads(l) for l in path.read_text().splitlines() if l.strip()]
+        steady = [r for r in rows if not r.get("warmup")]
+        # tiny corpora may produce only warmup batches — better skewed
+        # numbers than none
+        return steady or rows
+
+    rows = load(out_dir / "profiledata.jsonl")
+    if rows:
+        n_ex = sum(r["batch_size"] for r in rows)
+        stats["gflops_per_example"] = sum(r["flops"] for r in rows) / n_ex / 1e9
+        stats["gmacs_per_example"] = sum(r["macs"] for r in rows) / n_ex / 1e9
+    rows = load(out_dir / "timedata.jsonl")
+    if rows:
+        n_ex = sum(r["batch_size"] for r in rows)
+        total_ms = sum(r["ms"] for r in rows)
+        stats["ms_per_example"] = total_ms / n_ex
+        stats["examples_per_sec"] = n_ex / (total_ms / 1e3) if total_ms else 0.0
+    return stats

@@ -1,0 +1,142 @@
+"""Checkpoint-policy and encoder-transfer tests (parity:
+``config_default.yaml:20-31``, ``periodic_checkpoint.py``,
+``main_cli.py:136-145,175-184``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepdfa_tpu.config import CheckpointConfig, GGNNConfig
+from deepdfa_tpu.train.checkpoint import (
+    CheckpointManager,
+    encoder_partial_load,
+    freeze_mask,
+    frozen_encoder_optimizer,
+    is_head_key,
+)
+
+
+def _state(value: float):
+    return {
+        "params": {"dense": {"kernel": jnp.full((2, 2), value)}},
+        "step": jnp.asarray(int(value)),
+    }
+
+
+def test_save_last_and_restore(tmp_path):
+    mgr = CheckpointManager(tmp_path, CheckpointConfig(keep=2))
+    assert mgr.save(1, _state(1.0), {"val_loss": 0.5}, epoch=1)
+    assert mgr.save(2, _state(2.0), {"val_loss": 0.4}, epoch=2)
+    restored = mgr.restore_latest()
+    assert float(np.asarray(restored["params"]["dense"]["kernel"])[0, 0]) == 2.0
+    assert mgr.latest_step() == 2
+
+
+def test_best_tracking_min_mode(tmp_path):
+    mgr = CheckpointManager(tmp_path, CheckpointConfig(keep=1))
+    mgr.save(1, _state(1.0), {"val_loss": 0.5}, epoch=1)
+    mgr.save(2, _state(2.0), {"val_loss": 0.9}, epoch=2)  # worse
+    mgr.save(3, _state(3.0), {"val_loss": 0.3}, epoch=3)  # best
+    mgr.save(4, _state(4.0), {"val_loss": 0.8}, epoch=4)
+    assert mgr.best_step() == 3
+    best = mgr.restore_best()
+    assert float(np.asarray(best["params"]["dense"]["kernel"])[0, 0]) == 3.0
+    # retention: best survives even with keep=1
+    assert 3 in mgr.steps and 4 in mgr.steps
+
+
+def test_periodic_retention(tmp_path):
+    cfg = CheckpointConfig(keep=1, periodic_every=2, save_last=True)
+    mgr = CheckpointManager(tmp_path, cfg)
+    for epoch in range(1, 6):
+        mgr.save(epoch, _state(float(epoch)), {"val_loss": 1.0 / epoch}, epoch=epoch)
+    # periodic epochs 2 and 4 survive retention
+    metas = [mgr.meta(s) for s in mgr.steps]
+    periodic = [m["step"] for m in metas if "periodic" in m["reasons"]]
+    assert 2 in periodic and 4 in periodic
+
+
+def test_rescan_existing_directory(tmp_path):
+    mgr = CheckpointManager(tmp_path, CheckpointConfig())
+    mgr.save(1, _state(1.0), {"val_loss": 0.5}, epoch=1)
+    mgr.save(2, _state(2.0), {"val_loss": 0.2}, epoch=2)
+    # a fresh manager over the same dir sees prior checkpoints (resume)
+    mgr2 = CheckpointManager(tmp_path, CheckpointConfig())
+    assert mgr2.best_step() == 2
+    assert mgr2.latest_step() == 2
+
+
+def test_restore_with_template_preserves_dtype(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    state = {"params": {"w": jnp.ones((3,), jnp.bfloat16)}}
+    mgr.save(1, state, {"val_loss": 1.0})
+    out = mgr.restore(1, template={"params": {"w": jnp.zeros((3,), jnp.bfloat16)}})
+    assert out["params"]["w"].dtype == jnp.bfloat16
+
+
+def test_empty_manager(tmp_path):
+    mgr = CheckpointManager(tmp_path)
+    assert mgr.best_step() is None and mgr.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        mgr.restore_latest()
+
+
+# ---------------------------------------------------------------------------
+# encoder transfer
+
+
+def _ggnn_params(seed=0, encoder=False):
+    from deepdfa_tpu.data.synthetic import random_dataset
+    from deepdfa_tpu.data.graphs import GraphBatcher, BucketSpec
+    from deepdfa_tpu.models.ggnn import GGNN
+
+    model = GGNN(
+        cfg=GGNNConfig(hidden_dim=4, n_steps=1, num_output_layers=2, encoder_mode=encoder),
+        input_dim=12,
+    )
+    graphs = random_dataset(4, seed=0, input_dim=12, mean_nodes=6)
+    batch = jax.tree.map(jnp.asarray, next(GraphBatcher([BucketSpec(5, 64, 128)]).batches(graphs)))
+    return model, model.init(jax.random.key(seed), batch)["params"], batch
+
+
+def test_is_head_key_matches_param_tree():
+    _model, params, _ = _ggnn_params()
+    keys = set(params)
+    assert any(is_head_key(k) for k in keys), keys
+    assert {k for k in keys if is_head_key(k)} == {
+        k for k in keys if k == "pooling" or k.startswith("out_")
+    }
+    # encoder keys exist and are not head keys
+    assert any(not is_head_key(k) for k in keys)
+
+
+def test_encoder_partial_load_and_freeze():
+    _m1, trained, _ = _ggnn_params(seed=1)
+    _m2, fresh, _ = _ggnn_params(seed=2)
+    merged = encoder_partial_load(fresh, trained)
+    # encoder weights come from the checkpoint
+    for key in merged:
+        ref = trained if not is_head_key(key) else fresh
+        np.testing.assert_array_equal(
+            np.asarray(jax.tree.leaves(merged[key])[0]),
+            np.asarray(jax.tree.leaves(ref[key])[0]),
+        )
+    # freeze mask: head trainable, encoder frozen
+    mask = freeze_mask(merged)
+    for key, sub in mask.items():
+        for leaf in jax.tree.leaves(sub):
+            assert leaf == is_head_key(key)
+
+    # frozen_encoder_optimizer actually blocks encoder updates
+    tx = frozen_encoder_optimizer(optax.sgd(0.1), merged)
+    opt_state = tx.init(merged)
+    grads = jax.tree.map(jnp.ones_like, merged)
+    updates, _ = tx.update(grads, opt_state, merged)
+    for key, sub in updates.items():
+        for leaf in jax.tree.leaves(sub):
+            if is_head_key(key):
+                assert float(np.abs(np.asarray(leaf)).max()) > 0
+            else:
+                assert float(np.abs(np.asarray(leaf)).max()) == 0

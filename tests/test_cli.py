@@ -1,0 +1,98 @@
+"""CLI tests: fit/test/analyze run end-to-end on a tiny synthetic corpus,
+config layering works, crash renames the log (``main_cli.py`` parity)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.train import cli
+
+
+SMALL = [
+    "--set", "optim.max_epochs=2",
+    "--set", "model.hidden_dim=4",
+    "--set", "model.n_steps=1",
+    "--set", "model.num_output_layers=2",
+    "--set", "data.sample=true",
+    "--set", "data.feature.limit_all=30",
+    "--set", "data.feature.limit_subkeys=30",
+    "--set", "data.batch.batch_graphs=64",
+    "--set", "data.batch.max_nodes=4096",
+    "--set", "data.batch.max_edges=8192",
+]
+
+
+@pytest.fixture()
+def storage(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    return tmp_path
+
+
+def test_fit_then_test_and_profile(storage, tmp_path):
+    run_dir = tmp_path / "run"
+    out = cli.main(["fit", "--run-dir", str(run_dir), *SMALL])
+    assert np.isfinite(out["val_F1Score"])
+    assert (run_dir / "checkpoints").exists()
+    assert (run_dir / "final_metrics.json").exists()
+    assert (run_dir / "config.json").exists()
+    # tuning.jsonl has per-epoch + final rows (NNI-analogue)
+    rows = [json.loads(l) for l in (run_dir / "tuning.jsonl").read_text().splitlines()]
+    assert sum(1 for r in rows if r.get("final")) == 1
+    assert sum(1 for r in rows if "epoch" in r) == 2
+
+    res = cli.main([
+        "test", "--run-dir", str(run_dir), "--ckpt-dir", str(run_dir / "checkpoints"),
+        *SMALL, "--set", "time=true",
+    ])
+    assert "test_F1Score" in res and "test_pos_Recall" in res and "test_neg_Accuracy" in res
+    assert "report_f1_macro" in res
+    assert (run_dir / "pr.csv").exists() and (run_dir / "pr_binned.csv").exists()
+    assert (run_dir / "timedata.jsonl").exists()
+    assert res["profile_ms_per_example"] > 0
+
+
+def test_analyze_coverage(storage, tmp_path):
+    run_dir = tmp_path / "run"
+    out = cli.main(["analyze", "--run-dir", str(run_dir), *SMALL])
+    assert set(out) == {"train", "val", "test"}
+    for stats in out.values():
+        assert 0 <= stats["pct_def_nodes"] <= 1
+        assert stats["graphs"] > 0
+    assert (run_dir / "coverage.json").exists()
+
+
+def test_config_layering(tmp_path, storage):
+    a = tmp_path / "a.yaml"
+    b = tmp_path / "b.yaml"
+    a.write_text("optim:\n  lr: 0.01\n  max_epochs: 9\n")
+    b.write_text("optim:\n  lr: 0.5\n")
+    from deepdfa_tpu.config import load_config
+
+    cfg = load_config(a, b, overrides={"optim.max_epochs": 1})
+    assert cfg.optim.lr == 0.5          # later file wins
+    assert cfg.optim.max_epochs == 1    # CLI override wins over both
+
+
+def test_golden_configs_load():
+    from deepdfa_tpu.config import load_config
+
+    cfg = load_config("configs/default.yaml", "configs/bigvul.yaml", "configs/ggnn.yaml")
+    assert cfg.model.hidden_dim == 32 and cfg.model.n_steps == 5
+    assert cfg.data.undersample == "v1.0"
+    assert cfg.data.batch.batch_graphs == 256
+    assert cfg.input_dim == 1002
+    assert cfg.checkpoint.periodic_every == 25
+
+
+def test_crash_renames_log(storage, tmp_path, monkeypatch):
+    run_dir = tmp_path / "run"
+
+    def boom(cfg, rd):
+        raise RuntimeError("injected")
+
+    monkeypatch.setattr(cli, "fit", boom)
+    with pytest.raises(RuntimeError):
+        cli.main(["fit", "--run-dir", str(run_dir), *SMALL])
+    assert (run_dir / "run.log.error").exists()
+    assert not (run_dir / "run.log").exists()

@@ -1,0 +1,155 @@
+"""Union-op and extended-metric tests (parity: ``clipper.py`` inline tests,
+``evaluate.py:262-322`` ranking protocol, ``base_module.py:50-60`` per-class
+collections)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepdfa_tpu.ops.union import (
+    relu_union,
+    segment_union_relu,
+    segment_union_simple,
+    simple_union,
+)
+from deepdfa_tpu.train.metrics import (
+    ConfusionState,
+    classification_report,
+    compute_metrics,
+    confusion_matrix,
+    eval_statements,
+    eval_statements_list,
+    update_confusion_by_class,
+)
+
+
+# ---------------------------------------------------------------------------
+# union ops
+
+
+def test_union_binary_truth_table():
+    # reference test_union (clipper.py:93-107)
+    a = jnp.array([1.0, 0.0, 1.0, 0.0])
+    b = jnp.array([0.0, 0.0, 1.0, 1.0])
+    expected = jnp.array([1.0, 0.0, 1.0, 1.0])
+    np.testing.assert_allclose(simple_union(a, b), expected)
+    np.testing.assert_allclose(relu_union(a, b), expected)
+
+
+def test_relu_union_smoothness():
+    # reference test_smoothness (clipper.py:28-47): relu_union = a+b if
+    # a+b < 1 else 1
+    a = jnp.linspace(-2, 2, 101)[:, None]
+    b = jnp.linspace(-2, 2, 101)[None, :]
+    y = relu_union(a, b)
+    expected = jnp.where(a + b < 1, a + b, 1.0)
+    np.testing.assert_allclose(y, expected, atol=1e-6)
+
+
+def test_unions_differentiable():
+    g = jax.grad(lambda a: simple_union(a, jnp.float32(0.3)))(jnp.float32(0.5))
+    assert np.isfinite(float(g))
+    g = jax.grad(lambda a: relu_union(a, jnp.float32(0.3)))(jnp.float32(0.5))
+    assert np.isfinite(float(g))
+
+
+def _fold(union_fn, h, msgs):
+    out = h
+    for m in msgs:
+        out = union_fn(out, m)
+    return out
+
+
+@pytest.mark.parametrize("seg_fn,ref_fn", [
+    (segment_union_simple, simple_union),
+    (segment_union_relu, relu_union),
+])
+def test_segment_union_matches_sequential_fold(seg_fn, ref_fn):
+    """Closed-form segment aggregation == the reference's sequential mailbox
+    fold (clipper.py:50-77), for [0,1] bit-vectors."""
+    rng = np.random.default_rng(0)
+    n_nodes, n_bits = 5, 7
+    h = jnp.asarray(rng.random((n_nodes, n_bits)).astype(np.float32))
+    # edges: node 0,1,2 -> 3; node 2 -> 4; self-msg conventions excluded
+    senders = jnp.array([0, 1, 2, 2], dtype=jnp.int32)
+    receivers = jnp.array([3, 3, 3, 4], dtype=jnp.int32)
+    out = seg_fn(h, h, senders, receivers)
+
+    expected = np.array(h)
+    expected[3] = np.asarray(_fold(ref_fn, h[3], [h[0], h[1], h[2]]))
+    expected[4] = np.asarray(_fold(ref_fn, h[4], [h[2]]))
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_segment_union_exact_zeros_and_ones():
+    h = jnp.array([[0.0, 1.0], [1.0, 0.0], [0.0, 0.0]])
+    senders = jnp.array([0, 1], dtype=jnp.int32)
+    receivers = jnp.array([2, 2], dtype=jnp.int32)
+    out = segment_union_simple(h, h, senders, receivers)
+    np.testing.assert_allclose(np.asarray(out[2]), [1.0, 1.0])
+    out = segment_union_relu(h, h, senders, receivers)
+    np.testing.assert_allclose(np.asarray(out[2]), [1.0, 1.0])
+
+
+# ---------------------------------------------------------------------------
+# metrics
+
+
+def test_per_class_collections():
+    probs = jnp.array([0.9, 0.2, 0.8, 0.4])
+    labels = jnp.array([1.0, 1.0, 0.0, 0.0])
+    pos, neg = update_confusion_by_class(
+        ConfusionState.zeros(), ConfusionState.zeros(), probs, labels
+    )
+    mpos = compute_metrics(pos, "pos_")
+    mneg = compute_metrics(neg, "neg_")
+    # positives: one caught, one missed → recall 0.5
+    assert mpos["pos_Recall"] == pytest.approx(0.5)
+    # negatives: one false positive → accuracy 0.5
+    assert mneg["neg_Accuracy"] == pytest.approx(0.5)
+
+
+def test_classification_report_macro():
+    probs = np.array([0.9, 0.2, 0.8, 0.4, 0.6])
+    labels = np.array([1, 1, 0, 0, 1])
+    rep = classification_report(probs, labels, macro=True)
+    from sklearn.metrics import precision_recall_fscore_support
+
+    p, r, f, _ = precision_recall_fscore_support(
+        labels, probs >= 0.5, average="macro", zero_division=0
+    )
+    assert rep["f1_macro"] == pytest.approx(f)
+    assert rep["support_1"] == 3
+
+
+def test_confusion_matrix():
+    probs = np.array([0.9, 0.2, 0.8, 0.4])
+    labels = np.array([1, 1, 0, 0])
+    cm = confusion_matrix(probs, labels)
+    np.testing.assert_array_equal(cm, [[1, 1], [1, 1]])
+
+
+def test_eval_statements_vulnerable():
+    probs = np.array([0.1, 0.9, 0.3, 0.8])
+    labels = np.array([0, 0, 1, 0])
+    hits = eval_statements(probs, labels)
+    # vulnerable statement ranks 3rd
+    assert hits[1] == 0 and hits[2] == 0 and hits[3] == 1 and hits[10] == 1
+
+
+def test_eval_statements_all_clear():
+    # no vulnerable lines: hit iff nothing above threshold
+    assert eval_statements(np.array([0.1, 0.2]), np.array([0, 0]))[1] == 1
+    assert eval_statements(np.array([0.1, 0.9]), np.array([0, 0]))[1] == 0
+
+
+def test_eval_statements_list_combined():
+    item_vul = (np.array([0.9, 0.1]), np.array([1, 0]))     # hit@1
+    item_vul2 = (np.array([0.9, 0.1]), np.array([0, 1]))    # miss@1, hit@2
+    item_clear = (np.array([0.1, 0.2]), np.array([0, 0]))   # correct all-clear
+    out = eval_statements_list([item_vul, item_vul2, item_clear])
+    assert out[1] == pytest.approx(0.5 * 1.0)
+    assert out[2] == pytest.approx(1.0)
+    vul_only = eval_statements_list([item_vul, item_vul2, item_clear], vulonly=True)
+    assert vul_only[1] == pytest.approx(0.5)
