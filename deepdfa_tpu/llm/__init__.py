@@ -1,0 +1,15 @@
+"""LLM layer: TPU-native replacement of the reference's MSIVD subsystem
+(``MSIVD/msivd/`` — CodeLlama + DDFA-GGNN fusion for vulnerability detection).
+
+Where the reference leans on CUDA-only machinery — bitsandbytes 4-bit NF4
+quantization (``train.py:873-885``), HF accelerate ``device_map`` layer
+placement (``train.py:883``), ``torch.nn.DataParallel`` (``train.py:936``) —
+this package uses bf16 weights GSPMD-sharded over a named mesh (tp/fsdp for
+weights, dp for batch, sp + ring attention for long sequences).
+"""
+
+from deepdfa_tpu.llm.llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaModel,
+    LlamaForCausalLM,
+)

@@ -1,0 +1,121 @@
+"""HF → Flax checkpoint conversion for LLaMA-family models.
+
+The reference consumes CodeLlama weights straight from HF hub with torch +
+bitsandbytes (``MSIVD/msivd/train.py:871-885``). On TPU the weights must land
+as a JAX pytree matching ``deepdfa_tpu/llm/llama.py``'s param layout. This
+module does the rename/transpose, streaming from either a torch
+``state_dict`` (in memory) or a local HF checkpoint dir (``*.safetensors`` /
+``pytorch_model*.bin``) — there is no network access in this environment, so
+conversion is strictly from local files.
+
+Mapping (HF name -> ours; Dense kernels are ``W.T``):
+
+    model.embed_tokens.weight                    -> model/embed_tokens/embedding
+    model.layers.{i}.input_layernorm.weight      -> model/layers_{i}/input_layernorm/weight
+    model.layers.{i}.self_attn.{q,k,v,o}_proj    -> model/layers_{i}/self_attn/{q,k,v,o}_proj/kernel (T)
+    model.layers.{i}.post_attention_layernorm    -> model/layers_{i}/post_attention_layernorm/weight
+    model.layers.{i}.mlp.{gate,up,down}_proj     -> model/layers_{i}/mlp/{gate,up,down}_proj/kernel (T)
+    model.norm.weight                            -> model/norm/weight
+    lm_head.weight                               -> lm_head/kernel (T)
+
+``LlamaModel`` (no LM head) uses the same tree minus the ``model/`` prefix and
+``lm_head`` — pass ``bare=True``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from deepdfa_tpu.llm.llama import LlamaConfig
+
+__all__ = ["convert_state_dict", "load_hf_checkpoint", "load_hf_config"]
+
+
+def _assign(tree: dict, path: list[str], value: np.ndarray) -> None:
+    node = tree
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+def convert_state_dict(
+    state_dict: dict, dtype=np.float32, bare: bool = False
+) -> dict:
+    """torch/numpy HF llama ``state_dict`` -> Flax params tree.
+
+    ``bare=True`` targets :class:`LlamaModel` (drops the ``model`` wrapper and
+    the LM head); otherwise :class:`LlamaForCausalLM`.
+    """
+    params: dict = {}
+    for name, tensor in state_dict.items():
+        arr = np.asarray(
+            tensor.detach().cpu().float().numpy()
+            if hasattr(tensor, "detach")
+            else tensor,
+            dtype=np.float32,
+        )
+        parts = name.split(".")
+        if parts[-1] == "weight":
+            parts = parts[:-1]
+        if parts[0] == "model":
+            parts = parts[1:]
+        prefix = [] if bare else ["model"]
+        if parts[0] == "lm_head":
+            if bare:
+                continue
+            _assign(params, ["lm_head", "kernel"], arr.T.astype(dtype))
+            continue
+        if parts[0] == "embed_tokens":
+            _assign(params, prefix + ["embed_tokens", "embedding"], arr.astype(dtype))
+            continue
+        if parts[0] == "norm":
+            _assign(params, prefix + ["norm", "weight"], arr.astype(dtype))
+            continue
+        if parts[0] == "layers":
+            i = parts[1]
+            rest = parts[2:]
+            base = prefix + [f"layers_{i}"] + rest[:-1] if len(rest) > 1 else prefix + [f"layers_{i}"]
+            leaf = rest[-1]
+            if leaf.endswith("_proj"):
+                _assign(params, base + [leaf, "kernel"], arr.T.astype(dtype))
+            elif leaf.endswith("layernorm"):
+                _assign(params, base + [leaf, "weight"], arr.astype(dtype))
+            else:  # rotary_emb.inv_freq and other buffers: recomputed, skip
+                continue
+            continue
+        # anything else (rotary buffers, score heads we don't use): skip
+    return params
+
+
+def load_hf_config(ckpt_dir: str | Path) -> LlamaConfig:
+    with open(Path(ckpt_dir) / "config.json") as f:
+        return LlamaConfig.from_hf_dict(json.load(f))
+
+
+def load_hf_checkpoint(
+    ckpt_dir: str | Path, dtype=np.float32, bare: bool = False
+) -> dict:
+    """Convert a local HF checkpoint directory (safetensors preferred,
+    torch .bin fallback) into a Flax params tree."""
+    ckpt_dir = Path(ckpt_dir)
+    state: dict = {}
+    st_files = sorted(ckpt_dir.glob("*.safetensors"))
+    if st_files:
+        from safetensors.numpy import load_file
+
+        for f in st_files:
+            state.update(load_file(str(f)))
+    else:
+        import torch
+
+        bin_files = sorted(ckpt_dir.glob("pytorch_model*.bin")) or sorted(
+            ckpt_dir.glob("*.pt")
+        )
+        if not bin_files:
+            raise FileNotFoundError(f"no weights found under {ckpt_dir}")
+        for f in bin_files:
+            state.update(torch.load(f, map_location="cpu", weights_only=True))
+    return convert_state_dict(state, dtype=dtype, bare=bare)

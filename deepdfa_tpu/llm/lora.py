@@ -1,0 +1,109 @@
+"""LoRA adapters for the LLM layer.
+
+Replaces HF peft (``MSIVD/msivd/hf_inference.py:86-107``,
+``train.py:863-869``): the reference fine-tunes CodeLlama with LoRA on
+``q_proj``/``v_proj`` and merges adapters at inference via
+``PeftModel.from_pretrained(...).merge_and_unload()``. Here the adapter is a
+first-class Flax submodule (``lora_q``/``lora_v`` inside ``Attention``) so:
+
+- the *only* trainable LLM-side params are the adapters (select them with
+  :func:`lora_mask` and feed ``optax.masked`` / zero-out gradients);
+- merging is a pure tree transform (:func:`merge_lora`), no model surgery;
+- adapters checkpoint separately (the reference never saves LLM weights,
+  ``train.py:389-392`` — parity: save only the LoRA/GNN/head trees).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LoRAAdapter", "lora_mask", "merge_lora", "split_lora"]
+
+
+class LoRAAdapter(nn.Module):
+    """x @ A @ B * (alpha / rank); A ~ N(0, 1/rank), B = 0 (peft init), so the
+    adapter starts as an exact no-op."""
+
+    features: int
+    rank: int
+    alpha: float = 16.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        a = self.param(
+            "lora_a",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(self.rank**-0.5), ("embed", "norm")
+            ),
+            (x.shape[-1], self.rank),
+        )
+        b = self.param(
+            "lora_b",
+            nn.with_logical_partitioning(nn.initializers.zeros, ("norm", "heads")),
+            (self.rank, self.features),
+        )
+        scale = self.alpha / self.rank
+        y = (x.astype(self.dtype) @ a.astype(self.dtype)) @ b.astype(self.dtype)
+        return y * scale
+
+
+def _is_lora_path(path: tuple) -> bool:
+    return any(getattr(k, "key", str(k)).startswith("lora") for k in path)
+
+
+def lora_mask(params) -> Any:
+    """Pytree of bools: True on LoRA params (trainable), False elsewhere.
+    Use with ``optax.masked`` or as a freeze mask complement."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: _is_lora_path(path), params
+    )
+
+
+def split_lora(params) -> tuple[Any, Any]:
+    """(lora_only, base_only) trees with non-matching leaves replaced by None —
+    the checkpointable adapter artifact (reference analogue: LoRA dir saved by
+    peft, the base model never written)."""
+    lora = jax.tree_util.tree_map_with_path(
+        lambda p, v: v if _is_lora_path(p) else None, params
+    )
+    base = jax.tree_util.tree_map_with_path(
+        lambda p, v: None if _is_lora_path(p) else v, params
+    )
+    return lora, base
+
+
+def merge_lora(params, alpha: float = 16.0) -> Any:
+    """Fold every ``lora_{q,v}`` adapter into its sibling ``{q,v}_proj.kernel``
+    (peft ``merge_and_unload`` analogue) and drop the adapter params. The
+    rank is read off ``lora_a``'s shape; ``alpha`` must match the config the
+    adapters were trained with. Accepts boxed (``LogicallyPartitioned``) or
+    plain param trees; returns a plain tree."""
+    params = nn.meta.unbox(params)
+
+    def merge_attn(attn: dict) -> dict:
+        attn = dict(attn)
+        for name, proj in (("lora_q", "q_proj"), ("lora_v", "v_proj")):
+            if name in attn:
+                ad = attn.pop(name)
+                a, b = ad["lora_a"], ad["lora_b"]
+                scale = alpha / a.shape[1]
+                kernel = attn[proj]["kernel"]
+                delta = (a.astype(jnp.float32) @ b.astype(jnp.float32)) * scale
+                attn[proj] = dict(
+                    attn[proj], kernel=(kernel.astype(jnp.float32) + delta).astype(kernel.dtype)
+                )
+        return attn
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            if "q_proj" in tree:  # an attention block
+                return merge_attn({k: walk(v) for k, v in tree.items()})
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+
+    return walk(params)

@@ -22,7 +22,6 @@ distributed-array layout).
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import shutil
 from pathlib import Path
@@ -104,8 +103,7 @@ class CheckpointManager:
         path = self._path(step)
         if path.exists():
             shutil.rmtree(path)
-        payload = jax.tree.map(lambda x: x, state)  # shallow copy
-        self._ckptr.save(path / "state", payload)
+        self._ckptr.save(path / "state", state)
         meta = dict(step=int(step), epoch=epoch, metrics=metrics, reasons=reasons)
         (path / "meta.json").write_text(json.dumps(meta))
         self._saved.append(meta)

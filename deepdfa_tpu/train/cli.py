@@ -48,7 +48,7 @@ from deepdfa_tpu.data.sampler import epoch_indices, positive_weight
 from deepdfa_tpu.models.ggnn import GGNN
 from deepdfa_tpu.train import metrics as M
 from deepdfa_tpu.train.checkpoint import CheckpointManager
-from deepdfa_tpu.train.loop import Trainer
+from deepdfa_tpu.train.loop import Trainer, _weighted_mean
 
 logger = logging.getLogger("deepdfa_tpu")
 
@@ -110,8 +110,9 @@ def _batcher(cfg: ExperimentConfig) -> GraphBatcher:
     )
 
 
-def _epoch_graphs(train: list[Graph], cfg: ExperimentConfig, epoch: int) -> list[Graph]:
-    labels = np.array([int(g.node_feats["_VULN"].max()) for g in train])
+def _epoch_graphs(
+    train: list[Graph], labels: np.ndarray, cfg: ExperimentConfig, epoch: int
+) -> list[Graph]:
     idx = epoch_indices(
         labels,
         undersample=cfg.data.undersample,
@@ -146,7 +147,7 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
 
     last_val: dict[str, float] = {}
     for epoch in range(cfg.optim.max_epochs):
-        epoch_gs = _epoch_graphs(train, cfg, epoch)
+        epoch_gs = _epoch_graphs(train, train_labels, cfg, epoch)
         state, train_m, train_loss = trainer.train_epoch(state, batcher.batches(epoch_gs))
         val_m, val_loss = trainer.evaluate(state.params, batcher.batches(val))
         last_val = val_m
@@ -243,10 +244,7 @@ def test(
 
     probs = np.concatenate(all_probs)
     labels = np.concatenate(all_labels)
-    total_w = sum(wsums)
-    results = {"test_loss": (
-        sum(l * w for l, w in zip(losses, wsums)) / total_w if total_w else 0.0
-    )}
+    results = {"test_loss": _weighted_mean(losses, wsums)}
     results |= M.compute_metrics(overall, "test_")
     results |= M.compute_metrics(pos, "test_pos_")
     results |= M.compute_metrics(neg, "test_neg_")

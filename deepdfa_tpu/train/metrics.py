@@ -179,9 +179,9 @@ def eval_statements_list(
     vulnerable functions, optionally multiplied by the all-clear rate over
     non-vulnerable functions (the reference's combined score)."""
 
-    def rate(subset):
+    def rate(subset, empty: float):
         if not subset:
-            return {k: 0.0 for k in range(1, 11)}
+            return {k: empty for k in range(1, 11)}
         acc = {k: 0 for k in range(1, 11)}
         for probs, labels in subset:
             hit = eval_statements(probs, labels, thresh)
@@ -190,11 +190,15 @@ def eval_statements_list(
         return {k: v / len(subset) for k, v in acc.items()}
 
     vul = [i for i in items if np.asarray(i[1]).sum() > 0]
-    vul_rate = rate(vul)
+    vul_rate = rate(vul, 0.0)
     if vulonly:
         return vul_rate
+    # An absent class is the multiplicative identity: a corpus with no
+    # non-vulnerable functions shouldn't zero out a perfect vul ranking.
     nonvul = [i for i in items if np.asarray(i[1]).sum() == 0]
-    nonvul_rate = rate(nonvul)
+    nonvul_rate = rate(nonvul, 1.0)
+    if not vul:
+        return nonvul_rate
     return {k: vul_rate[k] * nonvul_rate[k] for k in range(1, 11)}
 
 

@@ -58,3 +58,17 @@ def test_pr_curves_shapes():
     assert len(p) == len(r) == len(t)
     p, r, t = binned_pr_curve(probs, labels, bins=1)
     assert len(p) == 2 and t[-1] == 1.0
+
+
+def test_eval_statements_list_single_class_identity():
+    """A corpus with only one class present must not zero out the combined
+    top-k score (empty class = multiplicative identity)."""
+    from deepdfa_tpu.train.metrics import eval_statements_list
+    import numpy as np
+
+    perfect_vul = (np.array([0.9, 0.1, 0.2]), np.array([1, 0, 0]))
+    out = eval_statements_list([perfect_vul])
+    assert out[1] == 1.0
+    perfect_clear = (np.array([0.1, 0.2]), np.array([0, 0]))
+    out2 = eval_statements_list([perfect_clear])
+    assert out2[1] == 1.0
