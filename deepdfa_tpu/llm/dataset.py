@@ -1,0 +1,271 @@
+"""Text dataset + graph index-join for LLM fusion training.
+
+Re-design of MSIVD's ``TextDataset`` / ``convert_examples_to_features``
+(``MSIVD/msivd/train.py:71-208``) and the graph join contract
+(``train.py:311-320`` + ``DDFA/sastvd/linevd/dataset.py:63-76``):
+
+- every example is ``(input_ids[block_size], label, index)`` — the **index** is
+  the dataset id used to join the function's CPG graph at batch time
+  (load-bearing for fusion; ``train.py:166-177``);
+- tokenization to a fixed ``block_size`` with truncation and padding, pad
+  token = eos (``train.py:196-208``);
+- Devign-style whitespace normalisation (``train.py:128-139``);
+- Devign 80/10/10 sequential split (``train.py:102-115``).
+
+TPU-first differences from the reference:
+
+- the reference *drops* examples whose graph is missing mid-batch
+  (``train.py:311-320``) — a dynamic shape. Here :class:`GraphJoin` keeps the
+  batch shape static: missing-graph examples get an empty placeholder graph
+  and a ``False`` entry in the example mask, so they contribute nothing to
+  loss/metrics but the compiled step never re-specialises. The miss count is
+  still tracked (parity with ``num_missing`` / ``missing_ids.txt``).
+- batches are emitted as fixed-shape numpy structs ready for ``jit``: the tail
+  batch is padded up with masked rows rather than being smaller.
+
+Tokenization: any HF-style callable tokenizer works (CodeLlama's in
+production). Tests and hermetic smoke runs use :class:`HashTokenizer`, which
+needs no downloaded vocab file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, NamedTuple, Protocol, Sequence
+
+import numpy as np
+
+from deepdfa_tpu.data.graphs import BatchedGraphs, Graph, batch_np
+from deepdfa_tpu.data.tokenise import tokenise
+
+__all__ = [
+    "normalize_whitespace",
+    "HashTokenizer",
+    "encode_functions",
+    "TextExamples",
+    "TextBatch",
+    "devign_split",
+    "GraphJoin",
+    "JoinedBatch",
+]
+
+
+def normalize_whitespace(code: str) -> str:
+    """Devign ``zonk`` parity (``train.py:128-139``): strip each line,
+    collapse runs of spaces/tabs, drop blank lines."""
+    import re
+
+    lines = [re.sub(r"[\t ]+", " ", l.strip()) for l in code.splitlines() if l.strip()]
+    return "\n".join(lines)
+
+
+class Tokenizer(Protocol):
+    eos_token_id: int
+
+    def encode_block(
+        self, text: str, block_size: int
+    ) -> tuple[np.ndarray, np.ndarray]: ...
+
+
+class HashTokenizer:
+    """Hermetic subtoken tokenizer: ids are stable hashes of IVDetect subtokens
+    into ``[n_special, vocab_size)``. No external vocab file, so tests and
+    smoke runs need no network. Special ids follow the Llama convention the
+    fusion contract assumes: bos=1 prepended, eos used as pad."""
+
+    def __init__(self, vocab_size: int = 320, bos_token_id: int = 1, eos_token_id: int = 2):
+        if vocab_size < 8:
+            raise ValueError("vocab_size too small")
+        self.vocab_size = vocab_size
+        self.bos_token_id = bos_token_id
+        self.eos_token_id = eos_token_id
+        self._floor = max(bos_token_id, eos_token_id) + 1
+
+    def _id(self, token: str) -> int:
+        import hashlib
+
+        h = int(hashlib.sha1(token.encode()).hexdigest(), 16)
+        return self._floor + h % (self.vocab_size - self._floor)
+
+    def encode_block(self, text: str, block_size: int) -> tuple[np.ndarray, np.ndarray]:
+        toks = tokenise(text).split()
+        ids = [self.bos_token_id] + [self._id(t) for t in toks]
+        return _fit_block(np.array(ids, np.int32), block_size, self.eos_token_id)
+
+
+def _fit_block(
+    ids: np.ndarray, block_size: int, pad_id: int, pad_left: bool = True
+) -> tuple[np.ndarray, np.ndarray]:
+    """(ids, pad_mask): truncate/pad to ``block_size``; mask True = real token.
+
+    Left padding is the framework-wide convention (pads at early positions, so
+    the last position is always the last real token — what the classifier
+    pools and what the decode cache assumes). The pad mask is explicit
+    because pad==eos makes pads indistinguishable from content by value —
+    the reference's ``attention_mask = input_ids.ne(1)`` (``model.py:50``)
+    guessed from values and got it wrong (1 is Llama's *bos*); we don't
+    replicate that."""
+    n_real = min(ids.shape[0], block_size)
+    ids = ids[:block_size]
+    mask = np.ones(block_size, bool)
+    if ids.shape[0] < block_size:
+        pad = np.full(block_size - ids.shape[0], pad_id, np.int32)
+        ids = np.concatenate([pad, ids] if pad_left else [ids, pad])
+        if pad_left:
+            mask[: block_size - n_real] = False
+        else:
+            mask[n_real:] = False
+    return ids.astype(np.int32), mask
+
+
+class TextExamples(NamedTuple):
+    """Column-major example store (the ``InputFeatures`` list, tensorised)."""
+
+    input_ids: np.ndarray  # [n, block_size] int32
+    labels: np.ndarray  # [n] int32
+    indices: np.ndarray  # [n] int64 dataset ids (the graph-join key)
+    pad_mask: np.ndarray  # [n, block_size] bool — True = real token
+
+    def __len__(self) -> int:
+        return int(self.input_ids.shape[0])
+
+
+class TextBatch(NamedTuple):
+    """Fixed-shape batch; ``mask`` rows are real examples."""
+
+    input_ids: np.ndarray  # [b, block_size]
+    labels: np.ndarray  # [b]
+    indices: np.ndarray  # [b]
+    mask: np.ndarray  # [b] bool
+    pad_mask: np.ndarray  # [b, block_size] bool — True = real token
+
+
+def encode_functions(
+    funcs: Sequence[str],
+    labels: Sequence[int],
+    tokenizer,
+    block_size: int,
+    indices: Sequence[int] | None = None,
+    normalize: bool = False,
+) -> TextExamples:
+    """``convert_examples_to_features`` over a whole table
+    (``train.py:166-208``). ``tokenizer`` is either a :class:`Tokenizer`
+    (``encode_block``) or an HF tokenizer (called with
+    ``padding="max_length"``/``truncation`` exactly like the reference)."""
+    if indices is None:
+        indices = np.arange(len(funcs))
+    rows, masks = [], []
+    for func in funcs:
+        text = normalize_whitespace(str(func)) if normalize else str(func)
+        if hasattr(tokenizer, "encode_block"):
+            ids, mask = tokenizer.encode_block(text, block_size)
+        else:  # HF tokenizer — force the framework-wide left-pad convention
+            tokenizer.pad_token = tokenizer.eos_token
+            tokenizer.padding_side = "left"
+            out = tokenizer(
+                text, padding="max_length", truncation=True, max_length=block_size
+            )
+            ids = np.asarray(out["input_ids"], np.int32)
+            mask = np.asarray(out["attention_mask"], bool)
+        rows.append(ids)
+        masks.append(mask)
+    return TextExamples(
+        input_ids=np.stack(rows) if rows else np.zeros((0, block_size), np.int32),
+        labels=np.asarray(labels, np.int32),
+        indices=np.asarray(indices, np.int64),
+        pad_mask=np.stack(masks) if masks else np.zeros((0, block_size), bool),
+    )
+
+
+def devign_split(n: int) -> dict[str, np.ndarray]:
+    """Sequential 80/10/10 index split (``train.py:102-115`` —
+    ``train_test_split(shuffle=False)`` twice)."""
+    i80, i90 = int(n * 0.8), int(n * 0.8) + int(n * 0.2 * 0.5)
+    idx = np.arange(n)
+    return {"train": idx[:i80], "eval": idx[i80:i90], "test": idx[i90:]}
+
+
+def text_batches(
+    examples: TextExamples,
+    batch_size: int,
+    shuffle: bool = False,
+    seed: int = 0,
+    pad_id: int = 0,
+) -> Iterator[TextBatch]:
+    """Fixed-shape batches; the tail batch is padded with masked rows (the
+    reference just emits a smaller final batch — dynamic shape, fine for
+    torch, recompilation for XLA)."""
+    order = np.arange(len(examples))
+    if shuffle:
+        np.random.default_rng(seed).shuffle(order)
+    for start in range(0, len(order), batch_size):
+        take = order[start : start + batch_size]
+        b = take.shape[0]
+        block = examples.input_ids.shape[1]
+        ids = np.full((batch_size, block), pad_id, np.int32)
+        labels = np.zeros(batch_size, np.int32)
+        indices = np.full(batch_size, -1, np.int64)
+        pad_mask = np.zeros((batch_size, block), bool)
+        ids[:b] = examples.input_ids[take]
+        labels[:b] = examples.labels[take]
+        indices[:b] = examples.indices[take]
+        pad_mask[:b] = examples.pad_mask[take]
+        mask = np.arange(batch_size) < b
+        yield TextBatch(ids, labels, indices, mask, pad_mask)
+
+
+class JoinedBatch(NamedTuple):
+    text: TextBatch
+    graphs: BatchedGraphs
+    # mask — example is real AND its graph was found; what the loss sees.
+    mask: np.ndarray  # [b] bool
+
+
+@dataclasses.dataclass
+class GraphJoin:
+    """Id-keyed graph lookup for fusion batches.
+
+    Parity with ``BigVulDatasetLineVD.get_indices`` (``dataset.py:63-76``) +
+    the drop-missing logic at ``train.py:311-320``, reshaped for static
+    shapes: example *i* of the batch owns graph slot *i*; misses become empty
+    graphs with ``mask=False``. ``num_missing`` accumulates like the
+    reference's counter."""
+
+    graphs: dict[int, Graph]
+    max_nodes: int = 4096
+    max_edges: int = 8192
+    num_missing: int = 0
+
+    @classmethod
+    def from_list(cls, graphs: Sequence[Graph], **kw) -> "GraphJoin":
+        return cls(graphs={g.gid: g for g in graphs}, **kw)
+
+    def _placeholder(self) -> Graph:
+        any_g = next(iter(self.graphs.values()))
+        feats = {
+            k: np.zeros((0,) + v.shape[1:], v.dtype)
+            for k, v in any_g.node_feats.items()
+        }
+        return Graph(
+            senders=np.zeros(0, np.int32),
+            receivers=np.zeros(0, np.int32),
+            node_feats=feats,
+            gid=-1,
+        )
+
+    def join(self, batch: TextBatch) -> JoinedBatch:
+        picked: list[Graph] = []
+        found = np.zeros(batch.indices.shape[0], bool)
+        placeholder = self._placeholder()
+        for i, idx in enumerate(batch.indices):
+            g = self.graphs.get(int(idx)) if batch.mask[i] else None
+            if g is not None:
+                picked.append(g)
+                found[i] = True
+            else:
+                picked.append(placeholder)
+                if batch.mask[i]:
+                    self.num_missing += 1
+        b = len(picked)
+        graphs = batch_np(picked, b + 1, self.max_nodes, self.max_edges)
+        return JoinedBatch(text=batch, graphs=graphs, mask=batch.mask & found)

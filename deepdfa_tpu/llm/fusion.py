@@ -1,0 +1,147 @@
+"""LLM ⊕ GGNN fusion heads — the trainable part of joint training.
+
+Flax re-design of ``MSIVD/msivd/model.py``:
+
+- :class:`ClassificationHead` — ``model.py:11-29``: take the first-token
+  state (the ``<s>``/[CLS] slot), concat the pooled graph embedding, then
+  ``dropout → dense(hidden) → tanh → dropout → out_proj(2)``.
+- :class:`FusionModel` — the ``GNNModel`` wrapper (``model.py:62-89``): runs
+  the GGNN in ``encoder_mode`` over the joined graph batch and classifies the
+  concatenation. Returns 2-way logits; loss/softmax live in
+  :func:`fusion_loss` so the same forward serves train and inference.
+- The frozen-LLM forward (``LLMModel.forward``, ``model.py:42-59``) is *not* a
+  module here: the joint step calls ``LlamaModel`` directly (its final-norm
+  hidden states are exactly ``hidden_states[-1]``) with no gradient flowing —
+  see ``deepdfa_tpu/llm/joint.py``.
+
+TPU notes: every example owns graph slot *i* of the batch
+(``GraphJoin.join``), so aligning graph embeddings with examples is a static
+slice, not a gather. Masked examples (padding / missing graph) still flow
+through the forward — masking happens in the loss, keeping shapes static.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from deepdfa_tpu.config import GGNNConfig
+from deepdfa_tpu.data.graphs import BatchedGraphs
+from deepdfa_tpu.models.ggnn import GGNN
+
+__all__ = ["ClassificationHead", "FusionModel", "fusion_loss"]
+
+
+def pool_tokens(
+    features: jnp.ndarray, token_mask: jnp.ndarray | None, pool: str
+) -> jnp.ndarray:
+    """Select the per-example summary token from ``[b, s, h]`` hidden states.
+
+    ``pool="last"`` (default): the last *real* token — under a causal LM this
+    is the only position that has attended to the whole function, and with
+    the framework's left-padding it is simply position ``s-1``; ``token_mask``
+    generalises to right padding. This replaces the reference's
+    ``features[:, 0, :]`` "CLS" read (``model.py:21``) — under a *causal*
+    decoder position 0 attends only to itself, so that slot is a constant
+    vector for every input (a CodeBERT-ism that defeats the LLM branch);
+    ``pool="first"`` keeps it available for strict parity comparisons."""
+    if pool == "first":
+        return features[:, 0, :]
+    if pool != "last":
+        raise ValueError(f"unknown pool {pool!r}")
+    if token_mask is None:
+        return features[:, -1, :]
+    s = features.shape[1]
+    # index of last True per row; all-False rows fall back to s-1 (masked out
+    # of the loss anyway).
+    rev = jnp.flip(token_mask.astype(jnp.int32), axis=1)
+    last = s - 1 - jnp.argmax(rev, axis=1)
+    return jnp.take_along_axis(features, last[:, None, None], axis=1)[:, 0, :]
+
+
+class ClassificationHead(nn.Module):
+    """``model.py:11-29`` in Flax. ``dropout_rate`` mirrors the LLM config's
+    ``attention_dropout`` (the reference reuses it for the head)."""
+
+    hidden_size: int
+    dropout_rate: float = 0.0
+    pool: str = "last"  # "last" (corrected) | "first" (reference parity)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        features: jnp.ndarray,  # [b, s, h] LLM final hidden states
+        flowgnn_embed: jnp.ndarray | None,  # [b, d] or None (no_flowgnn mode)
+        deterministic: bool = True,
+        token_mask: jnp.ndarray | None = None,  # [b, s] True = real token
+    ) -> jnp.ndarray:
+        x = pool_tokens(features, token_mask, self.pool)
+        if flowgnn_embed is not None:
+            x = jnp.concatenate([x, flowgnn_embed.astype(x.dtype)], axis=-1)
+        x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        x = nn.Dense(self.hidden_size, dtype=self.dtype, name="dense")(x)
+        x = jnp.tanh(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        return nn.Dense(2, dtype=self.dtype, name="out_proj")(x).astype(jnp.float32)
+
+
+class FusionModel(nn.Module):
+    """GGNN encoder + classification head (``GNNModel``, ``model.py:62-89``).
+
+    ``gnn_cfg`` is forced into encoder mode; pass ``use_gnn=False`` for the
+    reference's ``--no_flowgnn`` presets (LLM-only head)."""
+
+    gnn_cfg: GGNNConfig
+    input_dim: int
+    llm_hidden_size: int
+    use_gnn: bool = True
+    dropout_rate: float = 0.0
+    pool: str = "last"
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        if self.use_gnn:
+            import dataclasses
+
+            cfg = dataclasses.replace(self.gnn_cfg, encoder_mode=True, label_style="graph")
+            self.flowgnn_encoder = GGNN(cfg=cfg, input_dim=self.input_dim)
+        self.classifier = ClassificationHead(
+            hidden_size=self.llm_hidden_size,
+            dropout_rate=self.dropout_rate,
+            pool=self.pool,
+            dtype=self.dtype,
+        )
+
+    def __call__(
+        self,
+        llm_hidden_states: jnp.ndarray,  # [b, s, h]
+        graphs: BatchedGraphs | None,
+        deterministic: bool = True,
+        token_mask: jnp.ndarray | None = None,  # [b, s] True = real token
+    ) -> jnp.ndarray:
+        embed = None
+        if self.use_gnn:
+            pooled = self.flowgnn_encoder(graphs)  # [max_graphs, out_dim]
+            b = llm_hidden_states.shape[0]
+            embed = pooled[:b]  # slot i belongs to example i (GraphJoin contract)
+        return self.classifier(
+            llm_hidden_states, embed, deterministic=deterministic, token_mask=token_mask
+        )
+
+
+def fusion_loss(
+    logits: jnp.ndarray,  # [b, 2]
+    labels: jnp.ndarray,  # [b] int
+    mask: jnp.ndarray,  # [b] bool — real example AND graph found
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(mean CE loss over real examples, softmax probs). The reference's
+    ``CrossEntropyLoss`` + softmax (``model.py:82-88``); masking replaces its
+    drop-missing-rows dynamic batching."""
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    w = mask.astype(jnp.float32)
+    loss = jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
+    return loss, nn.softmax(logits, axis=-1)

@@ -1,0 +1,351 @@
+"""Joint LLM + GGNN training — the MSIVD training loop, rebuilt for TPU.
+
+Covers ``MSIVD/msivd/train.py:211-585`` (``train``/``evaluate``/``test``):
+
+- **frozen LLM forward** feeding final hidden states into the trainable
+  fusion model (``train.py:324-331``); only fusion params (GGNN + head) get
+  gradients — the LLM params enter the jitted step as a constant input, so no
+  backward pass is ever built through the decoder stack (the TPU analogue of
+  ``self.encoder.eval()`` + optimizer over ``gnn_model`` params only).
+- AdamW with **no-decay param groups** (bias / norm scales,
+  ``train.py:242-260``) via an ``optax.masked`` weight-decay mask.
+- **cosine schedule with linear warmup**, ``warmup = max_steps // 50``
+  (``train.py:238-266``).
+- grad clip ``max_grad_norm`` (``:339``) and **gradient accumulation** via
+  ``optax.MultiSteps`` (``:335-360``).
+- eval cadence: denser during the first epoch (``first_eval_steps=5`` →
+  first eval after 1/5 of an epoch), then every 1/``eval_steps`` of an epoch
+  (``train.py:37-38,236-238,366-386``).
+- per-epoch checkpoint of the fusion params only — the LLM weights are never
+  saved (``train.py:389-392``; LoRA adapters checkpoint separately, see
+  ``deepdfa_tpu/llm/lora.py``).
+- eval/test: threshold ``P(vul) > best_threshold``, classification report
+  with macro avg for Big-Vul / weighted otherwise (``train.py:445-459,
+  571-585``).
+
+The whole step — LLM forward + fusion forward/backward/update — is ONE
+compiled function; batches are static-shape (``TextBatch`` + ``GraphJoin``),
+so it compiles once. For sharded LLMs pass ``llm_params`` already placed with
+``mesh_shardings`` — GSPMD partitions the step; the fusion params are tiny and
+stay replicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Callable, Iterable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deepdfa_tpu.llm.dataset import GraphJoin, JoinedBatch, TextExamples, text_batches
+from deepdfa_tpu.llm.fusion import FusionModel, fusion_loss
+from deepdfa_tpu.llm.llama import LlamaModel
+from deepdfa_tpu.train.metrics import classification_report
+
+__all__ = [
+    "JointConfig",
+    "JointState",
+    "weight_decay_mask",
+    "cosine_warmup_schedule",
+    "eval_points",
+    "make_joint_steps",
+    "JointTrainer",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class JointConfig:
+    """Golden values = the reference argparse defaults (``train.py:588-801``)
+    and module constants (``train.py:37-38``)."""
+
+    block_size: int = 256
+    train_batch_size: int = 4
+    eval_batch_size: int = 4
+    learning_rate: float = 5e-5
+    weight_decay: float = 0.0
+    adam_epsilon: float = 1e-8
+    max_grad_norm: float = 1.0
+    gradient_accumulation_steps: int = 1
+    epochs: int = 1
+    best_threshold: float = 0.5
+    eval_steps: int = 2  # evals per epoch after the first
+    first_eval_steps: int = 5  # evals per first epoch
+    seed: int = 42
+    # "bigvul" → macro avg (imbalanced); anything else → weighted avg
+    dataset_style: str = "bigvul"
+    use_gnn: bool = True  # False = --no_flowgnn presets
+
+    @property
+    def report_avg(self) -> str:
+        return "macro" if "bigvul" in self.dataset_style else "weighted"
+
+
+class JointState(NamedTuple):
+    params: Any  # fusion params (GGNN + head) — the ONLY trained tree
+    opt_state: Any
+    rng: jax.Array
+    step: jnp.ndarray
+
+
+def weight_decay_mask(params: Any) -> Any:
+    """True = apply weight decay. The reference excludes ``bias`` and
+    ``LayerNorm.weight`` (``train.py:242-260``); in our Flax trees that is any
+    leaf named ``bias`` and any RMSNorm/LayerNorm ``weight``/``scale``."""
+
+    def mask_path(path: tuple, _leaf) -> bool:
+        keys = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+        if keys and keys[-1] in ("bias", "scale"):
+            return False
+        if keys and keys[-1] == "weight" and any("norm" in str(k).lower() for k in keys[:-1]):
+            return False
+        return True
+
+    return jax.tree_util.tree_map_with_path(mask_path, params)
+
+
+def cosine_warmup_schedule(lr: float, warmup_steps: int, total_steps: int):
+    """HF ``get_cosine_schedule_with_warmup`` parity: linear 0→lr over
+    ``warmup_steps``, cosine lr→0 over the rest."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=lr,
+        warmup_steps=max(warmup_steps, 1),
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=0.0,
+    )
+
+
+def joint_optimizer(cfg: JointConfig, steps_per_epoch: int, params: Any):
+    """clip → AdamW(no-decay mask) → cosine-warmup, wrapped in MultiSteps for
+    gradient accumulation (micro-step semantics identical to ``train.py``:
+    update every ``gradient_accumulation_steps`` batches)."""
+    opt_steps = (cfg.epochs * steps_per_epoch) // cfg.gradient_accumulation_steps
+    warmup = opt_steps // 50  # train.py:238 "args.warmup_steps = max_steps // 50"
+    schedule = cosine_warmup_schedule(cfg.learning_rate, warmup, opt_steps)
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adamw(
+            schedule,
+            eps=cfg.adam_epsilon,
+            weight_decay=cfg.weight_decay,
+            mask=weight_decay_mask(params),
+        ),
+    )
+    if cfg.gradient_accumulation_steps > 1:
+        tx = optax.MultiSteps(tx, cfg.gradient_accumulation_steps)
+    return tx
+
+
+def eval_points(steps_per_epoch: int, epoch: int, cfg: JointConfig) -> set[int]:
+    """Step indices (within an epoch) after which to run eval. First epoch is
+    denser (``first_eval_steps``), later epochs use ``eval_steps``
+    (``train.py:236-238,366-386``)."""
+    per = cfg.first_eval_steps if epoch == 0 else cfg.eval_steps
+    stride = max(steps_per_epoch // per, 1)
+    return {s for s in range(stride - 1, steps_per_epoch, stride)}
+
+
+def make_joint_steps(
+    llm: LlamaModel,
+    fusion: FusionModel,
+    tx: optax.GradientTransformation,
+) -> tuple[Callable, Callable]:
+    """(train_step, eval_step), both jitted. ``llm_params`` is an input, not a
+    capture, so sharded placements propagate and the tree is donated-free."""
+
+    def hidden_states(llm_params, batch: JoinedBatch):
+        ids = jnp.asarray(batch.text.input_ids)
+        # Explicit pad mask from the dataset (TextBatch.pad_mask): pads share
+        # the eos id, so value-sniffing can't find them — the reference's
+        # ``attention_mask = input_ids.ne(1)`` (model.py:50) masks *bos*
+        # instead of pads; we carry the truth from tokenization time. RoPE is
+        # relative, so arange positions over a left-padded row preserve all
+        # real-token distances (a uniform shift).
+        return llm.apply(
+            {"params": llm_params}, ids, jnp.asarray(batch.text.pad_mask)
+        )
+
+    def loss_fn(params, llm_params, batch: JoinedBatch, rng):
+        hidden = hidden_states(llm_params, batch)
+        logits = fusion.apply(
+            {"params": params},
+            hidden,
+            batch.graphs if fusion.use_gnn else None,
+            deterministic=False,
+            token_mask=jnp.asarray(batch.text.pad_mask),
+            rngs={"dropout": rng},
+        )
+        labels = jnp.asarray(batch.text.labels)
+        mask = jnp.asarray(batch.mask)
+        loss, probs = fusion_loss(logits, labels, mask)
+        return loss, probs
+
+    @jax.jit
+    def train_step(state: JointState, llm_params, batch: JoinedBatch):
+        rng, sub = jax.random.split(state.rng)
+        (loss, probs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, llm_params, batch, sub
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return JointState(params, opt_state, rng, state.step + 1), loss, probs
+
+    @jax.jit
+    def eval_step(params, llm_params, batch: JoinedBatch):
+        hidden = hidden_states(llm_params, batch)
+        logits = fusion.apply(
+            {"params": params},
+            hidden,
+            batch.graphs if fusion.use_gnn else None,
+            deterministic=True,
+            token_mask=jnp.asarray(batch.text.pad_mask),
+        )
+        labels = jnp.asarray(batch.text.labels)
+        mask = jnp.asarray(batch.mask)
+        loss, probs = fusion_loss(logits, labels, mask)
+        return loss, probs
+
+    return train_step, eval_step
+
+
+@dataclasses.dataclass
+class JointTrainer:
+    """The ``train``/``evaluate``/``test`` driver (``train.py:211-585``)."""
+
+    llm: LlamaModel
+    llm_params: Any
+    fusion: FusionModel
+    cfg: JointConfig
+    join: GraphJoin | None  # None = no_flowgnn mode
+    run_dir: Path | None = None
+
+    def __post_init__(self):
+        self._steps: tuple[Callable, Callable] | None = None
+        self.num_missing = 0
+        self.history: list[dict] = []
+
+    def _joined(self, batch) -> JoinedBatch:
+        if self.join is not None:
+            return self.join.join(batch)
+        return JoinedBatch(text=batch, graphs=None, mask=batch.mask)
+
+    def _build(self, steps_per_epoch: int, example: JoinedBatch) -> JointState:
+        rng = jax.random.key(self.cfg.seed)
+        rng, init_rng, drop_rng = jax.random.split(rng, 3)
+        hidden = self.llm.apply(
+            {"params": self.llm_params},
+            jnp.asarray(example.text.input_ids),
+            jnp.asarray(example.text.pad_mask),
+        )
+        params = self.fusion.init(
+            {"params": init_rng, "dropout": drop_rng},
+            hidden,
+            example.graphs if self.fusion.use_gnn else None,
+            deterministic=True,
+            token_mask=jnp.asarray(example.text.pad_mask),
+        )["params"]
+        self.tx = joint_optimizer(self.cfg, steps_per_epoch, params)
+        self._steps = make_joint_steps(self.llm, self.fusion, self.tx)
+        return JointState(params, self.tx.init(params), rng, jnp.zeros((), jnp.int32))
+
+    def train(
+        self,
+        train_examples: TextExamples,
+        eval_examples: TextExamples,
+        state: JointState | None = None,
+    ) -> JointState:
+        cfg = self.cfg
+        n_batches = -(-len(train_examples) // cfg.train_batch_size)
+        for epoch in range(cfg.epochs):
+            batches = text_batches(
+                train_examples,
+                cfg.train_batch_size,
+                shuffle=True,  # RandomSampler (train.py:227)
+                seed=cfg.seed + epoch,
+            )
+            points = eval_points(n_batches, epoch, cfg)
+            tr_loss, tr_num = 0.0, 0
+            for step, tb in enumerate(batches):
+                jb = self._joined(tb)
+                if state is None:
+                    state = self._build(n_batches, jb)
+                train_step, _ = self._steps
+                state, loss, _probs = train_step(state, self.llm_params, jb)
+                tr_loss += float(loss)
+                tr_num += 1
+                if step in points:
+                    self.history.append(
+                        {"epoch": epoch, "step": step, **self.evaluate(state.params, eval_examples)}
+                    )
+            self.history.append(
+                {"epoch": epoch, "train_loss": tr_loss / max(tr_num, 1)}
+            )
+            if self.run_dir is not None:
+                self.save(state, f"epoch_{epoch}")
+        if self.join is not None:
+            self.num_missing = self.join.num_missing
+        return state
+
+    def _run_eval(
+        self, params, examples: TextExamples
+    ) -> tuple[float, np.ndarray, np.ndarray]:
+        losses, probs_all, labels_all = [], [], []
+        for tb in text_batches(examples, self.cfg.eval_batch_size):
+            jb = self._joined(tb)
+            if self._steps is None:  # standalone eval (test-only runs)
+                self._build(1, jb)
+            _, eval_step = self._steps
+            loss, probs = eval_step(params, self.llm_params, jb)
+            losses.append(float(loss))
+            keep = np.asarray(jb.mask)
+            probs_all.append(np.asarray(probs)[keep])
+            labels_all.append(np.asarray(tb.labels)[keep])
+        return (
+            float(np.mean(losses)) if losses else 0.0,
+            np.concatenate(probs_all) if probs_all else np.zeros((0, 2)),
+            np.concatenate(labels_all) if labels_all else np.zeros(0, np.int32),
+        )
+
+    def evaluate(self, params, examples: TextExamples) -> dict[str, float]:
+        """``evaluate`` parity (``train.py:396-465``): mean loss + report."""
+        loss, probs, labels = self._run_eval(params, examples)
+        report = classification_report(
+            probs[:, 1] if probs.size else probs.reshape(0),
+            labels,
+            macro=self.cfg.report_avg == "macro",
+            threshold=self.cfg.best_threshold,
+        )
+        return {"eval_loss": loss, **{f"eval_{k}": v for k, v in report.items()}}
+
+    def test(self, params, examples: TextExamples) -> dict[str, float]:
+        """``test`` parity (``train.py:467-585``) minus profiling (that lives
+        in ``deepdfa_tpu/train/profiling.py`` and wraps any step fn)."""
+        loss, probs, labels = self._run_eval(params, examples)
+        report = classification_report(
+            probs[:, 1] if probs.size else probs.reshape(0),
+            labels,
+            macro=self.cfg.report_avg == "macro",
+            threshold=self.cfg.best_threshold,
+        )
+        return {"test_loss": loss, **{f"test_{k}": v for k, v in report.items()}}
+
+    def save(self, state: JointState, name: str) -> Path:
+        """Fusion params only (``train.py:389-392`` saves ``gnn_model``'s
+        state_dict; the frozen LLM is never written)."""
+        import orbax.checkpoint as ocp
+
+        path = (Path(self.run_dir) / name).absolute()
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, state.params, force=True)
+        ckptr.wait_until_finished()
+        return path
+
+    def load(self, template_params: Any, name: str) -> Any:
+        import orbax.checkpoint as ocp
+
+        path = (Path(self.run_dir) / name).absolute()
+        return ocp.StandardCheckpointer().restore(path, template_params)

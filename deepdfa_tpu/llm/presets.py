@@ -1,0 +1,98 @@
+"""Joint-training launch presets.
+
+The five MSIVD launch scripts (``MSIVD/msivd/scripts/*.sh``) as structured
+configs. ``finetuned`` marks presets that start from a LoRA-finetuned model
+(the reference's ``--finetuned_path`` / ``PeftInference`` load path,
+``train.py:863-869`` — here: convert HF weights, apply LoRA adapters, see
+``deepdfa_tpu/llm/{convert,lora}.py``). Mesh suggestions are TPU-side design
+(no reference equivalent — it used ``device_map="balanced"``): 7B fits one
+v4-8 slice with fsdp; 13B long-block presets shard seq over ``sp`` with ring
+attention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from deepdfa_tpu.config import MeshConfig
+from deepdfa_tpu.llm.joint import JointConfig
+from deepdfa_tpu.llm.llama import LlamaConfig, codellama_7b, codellama_13b
+
+__all__ = ["JointPreset", "PRESETS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class JointPreset:
+    name: str
+    llm: LlamaConfig
+    joint: JointConfig
+    finetuned: bool  # load LoRA-finetuned weights first (--finetuned_path)
+    mesh: MeshConfig
+    dataset: str  # reference data family the preset targets
+
+
+PRESETS: dict[str, JointPreset] = {
+    p.name: p
+    for p in [
+        # bigvul_ft_bigvul.sh — CodeLlama-7B finetuned, Big-Vul
+        JointPreset(
+            name="bigvul_ft_bigvul",
+            llm=codellama_7b(),
+            joint=JointConfig(
+                block_size=256, epochs=5, train_batch_size=4, eval_batch_size=4,
+                learning_rate=1e-4, dataset_style="bigvul",
+            ),
+            finetuned=True,
+            mesh=MeshConfig(dp=-1, fsdp=1, tp=1, sp=1),
+            dataset="bigvul",
+        ),
+        # pretrained_bigvul.sh — 13B pretrained, Big-Vul
+        JointPreset(
+            name="pretrained_bigvul",
+            llm=codellama_13b(),
+            joint=JointConfig(
+                block_size=350, epochs=1, train_batch_size=8, eval_batch_size=8,
+                learning_rate=1e-4, dataset_style="bigvul",
+            ),
+            finetuned=False,
+            mesh=MeshConfig(dp=-1, fsdp=2, tp=1, sp=1),
+            dataset="bigvul",
+        ),
+        # pb_ft_pb.sh — 13B + LoRA, PreciseBugs, long blocks
+        JointPreset(
+            name="pb_ft_pb",
+            llm=codellama_13b(lora_rank=16, attn_impl="ring"),
+            joint=JointConfig(
+                block_size=2048, epochs=1, train_batch_size=4, eval_batch_size=4,
+                learning_rate=1e-6, dataset_style="precisebugs",
+            ),
+            finetuned=True,
+            mesh=MeshConfig(dp=1, fsdp=2, tp=1, sp=-1),
+            dataset="precisebugs",
+        ),
+        # pb_ft_pb_noexpl.sh — 13B-Instruct, no GNN
+        JointPreset(
+            name="pb_ft_pb_noexpl",
+            llm=codellama_13b(),
+            joint=JointConfig(
+                block_size=1024, epochs=3, train_batch_size=6, eval_batch_size=6,
+                learning_rate=1e-6, dataset_style="precisebugs", use_gnn=False,
+            ),
+            finetuned=True,
+            mesh=MeshConfig(dp=-1, fsdp=2, tp=1, sp=1),
+            dataset="precisebugs",
+        ),
+        # pretrained_pb.sh — 13B pretrained, no GNN
+        JointPreset(
+            name="pretrained_pb",
+            llm=codellama_13b(),
+            joint=JointConfig(
+                block_size=1024, epochs=5, train_batch_size=4, eval_batch_size=4,
+                learning_rate=1e-5, dataset_style="precisebugs", use_gnn=False,
+            ),
+            finetuned=False,
+            mesh=MeshConfig(dp=-1, fsdp=2, tp=1, sp=1),
+            dataset="precisebugs",
+        ),
+    ]
+}

@@ -74,6 +74,11 @@ def full_attention(
     if kv_mask is not None:
         scores = jnp.where(kv_mask[:, None, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
+    if kv_mask is not None:
+        # Fully-masked query rows (all-padding examples) would softmax to
+        # uniform over _NEG_INF scores; return zeros for them instead.
+        row_valid = jnp.any(scores > _NEG_INF / 2, axis=-1)  # [b, h, q]
+        probs = jnp.where(row_valid[..., None], probs, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
 
@@ -120,13 +125,16 @@ def ring_attention(
         mask = jnp.ones((s_loc, s_loc), dtype=bool)
         if causal:
             mask = k_pos[None, :] <= q_pos[:, None]
-        scores = jnp.where(mask[None, None], scores, _NEG_INF)
-        scores = jnp.where(m_blk[:, None, None, :], scores, _NEG_INF)
+        allowed = mask[None, None] & m_blk[:, None, None, :]  # [b, 1|h, q, k]
+        scores = jnp.where(allowed, scores, _NEG_INF)
 
-        # online-softmax merge (flash recurrence), fp32
+        # online-softmax merge (flash recurrence), fp32. ``p`` is zeroed on
+        # disallowed keys explicitly: with a finite _NEG_INF, a fully-masked
+        # row has m_new == _NEG_INF and exp(scores - m_new) == 1, which would
+        # otherwise count masked keys into l and defeat the l>0 guard below.
         m_new = jnp.maximum(m, scores.max(axis=-1))  # [b, h, q]
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new[..., None])  # [b, h, q, k]
+        p = jnp.exp(scores - m_new[..., None]) * allowed  # [b, h, q, k]
         l_new = l * alpha + p.sum(axis=-1)
         pv = jnp.einsum(
             "bhqk,bkhd->bqhd",

@@ -71,3 +71,20 @@ def test_ring_bf16_inputs():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
     )
+
+
+def test_fully_masked_rows_emit_zeros():
+    """Regression: with a finite _NEG_INF sentinel, a fully-masked query row
+    used to get p=exp(0)=1 on every masked key (l>0), returning ~mean(V)
+    instead of zeros — in both the ring recurrence and full_attention."""
+    mesh = local_mesh(4, dp=2, sp=2)
+    q, k, v = _qkv(s=16)
+    kv_mask = np.ones((2, 16), dtype=bool)
+    kv_mask[0, :] = False  # example 0: every position masked
+    kv_mask = jnp.asarray(kv_mask)
+    out_ring = ring_attention_sharded(q, k, v, mesh, causal=True, kv_mask=kv_mask)
+    out_full = full_attention(q, k, v, causal=True, kv_mask=kv_mask)
+    for out in (np.asarray(out_ring), np.asarray(out_full)):
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out[0], 0.0)
+        assert np.abs(out[1]).sum() > 0  # the live example is untouched
