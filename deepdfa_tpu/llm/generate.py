@@ -1,0 +1,119 @@
+"""Batch text generation with the fixed-size KV cache.
+
+Parity surface: the reference's ``hf_inference`` helper
+(``MSIVD/msivd/hf_inference.py:129-162``) — batch generation over padded
+prompts via HF ``model.generate`` (sampling on by default, stop at eos, pads
+stripped, only the newly generated suffix returned). TPU-native design:
+
+- ONE ``lax.scan`` over ``prompt_len + max_new_tokens - 1`` single-token
+  decode steps: prompt positions teacher-force the next token from the
+  prompt, generation positions feed back the sampled token — no separate
+  prefill graph, no dynamic shapes, compiles once per (batch, length).
+- left-padded prompts (the framework convention, ``llm/dataset.py``) make
+  positions uniform across the batch, which is what the decode cache assumes
+  (``llama.py _decode_attend``); pad slots are masked out of the cache via
+  the per-step validity mask.
+- rows that emitted eos keep stepping (SPMD — no early exit) but their
+  subsequent tokens are overwritten with eos, matching HF's finished-row
+  padding behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.llm.llama import LlamaForCausalLM
+
+__all__ = ["GenerateConfig", "generate"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerateConfig:
+    """Defaults mirror ``hf_inference`` (``hf_inference.py:129-131``):
+    ``max_new_tokens=512, do_sample=True``."""
+
+    max_new_tokens: int = 512
+    do_sample: bool = True
+    temperature: float = 0.8
+    top_k: int = 0  # 0 = full distribution
+    eos_token_id: int = 2
+
+
+def _sample(logits: jnp.ndarray, cfg: GenerateConfig, rng: jax.Array) -> jnp.ndarray:
+    if not cfg.do_sample or cfg.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / cfg.temperature
+    if cfg.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -cfg.top_k][..., None]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(
+    model: LlamaForCausalLM,
+    params: Any,
+    input_ids: np.ndarray | jnp.ndarray,  # [b, s] left-padded prompts
+    pad_mask: np.ndarray | jnp.ndarray,  # [b, s] True = real prompt token
+    cfg: GenerateConfig = GenerateConfig(),
+    rng: jax.Array | None = None,
+) -> np.ndarray:
+    """Return ONLY the generated suffix ``[b, max_new_tokens]`` (the reference
+    decodes ``outputs[:, prompt_len:]``, ``hf_inference.py:152-154``),
+    eos-padded after each row finishes."""
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    pad_mask = jnp.asarray(pad_mask, bool)
+    b, s = input_ids.shape
+    total = s + cfg.max_new_tokens - 1
+    if total + 1 > model.cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt {s} + max_new_tokens {cfg.max_new_tokens} exceeds "
+            f"max_position_embeddings {model.cfg.max_position_embeddings}"
+        )
+    if rng is None:
+        rng = jax.random.key(0)
+
+    # Zero KV cache from shapes only — init() would materialise a throwaway
+    # copy of the full params (~28 GB for 7B) just to discard them.
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros((b, 1), jnp.int32), decode=True)
+    )["cache"]
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+    def step(carry, t):
+        cache, tok, rng, done = carry
+        in_prompt = t < s
+        # teacher-force from the prompt while t < s, else feed the sample
+        prompt_tok = jax.lax.dynamic_slice_in_dim(input_ids, jnp.minimum(t, s - 1), 1, 1)
+        cur = jnp.where(in_prompt, prompt_tok[:, 0], tok)
+        valid = jnp.where(
+            in_prompt,
+            jax.lax.dynamic_slice_in_dim(pad_mask, jnp.minimum(t, s - 1), 1, 1)[:, 0],
+            True,
+        )
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache},
+            cur[:, None],
+            attn_mask=valid[:, None],
+            positions=jnp.broadcast_to(t, (b, 1)).astype(jnp.int32),
+            decode=True,
+            mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits[:, 0, :], cfg, sub).astype(jnp.int32)
+        # emit only at generation positions (t >= s-1 predicts token s+...)
+        emitting = t >= s - 1
+        out_tok = jnp.where(done, cfg.eos_token_id, nxt)
+        done = done | (emitting & (nxt == cfg.eos_token_id))
+        return (vars_out["cache"], out_tok, rng, done), jnp.where(
+            emitting, out_tok, cfg.eos_token_id
+        )
+
+    carry0 = (cache, jnp.zeros(b, jnp.int32), rng, jnp.zeros(b, bool))
+    (_, _, _, _), toks = jax.lax.scan(step, carry0, jnp.arange(total))
+    # steps s-1 .. total-1 produced the generated tokens
+    return np.asarray(toks[s - 1 :].T)

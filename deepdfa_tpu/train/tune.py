@@ -1,0 +1,122 @@
+"""Hyperparameter tuning — the NNI-hooks replacement.
+
+The reference wires NNI in three places: experiment-param injection with
+feat-string rewriting (``DDFA/code_gnn/main_cli.py:110-121``), per-epoch
+intermediate F1 reporting (``base_module.py:346``) and final F1 reporting
+(``main_cli.py:184``). The TPU build replaces the external NNI service with a
+self-contained random-search driver over the typed config:
+
+- a **search space** maps dotted config keys to value lists
+  (``{"model.hidden_dim": [32, 64], "optim.lr": [1e-3, 3e-4]}``) — dotted
+  keys go straight through :func:`deepdfa_tpu.config.load_config` overrides,
+  replacing NNI's feat-string surgery with structured overrides;
+- each trial runs ``cli.fit`` in-process; the per-epoch ``tuning.jsonl`` the
+  CLI already writes *is* the intermediate-report stream, and the trial's
+  returned ``val_F1Score`` is the final report;
+- trials append to ``trials.jsonl``; :func:`best_trial` selects the winner
+  (objective = final val F1, parity with the NNI objective).
+
+If the real ``nni`` package is importable (it is not in this image), trial
+results are additionally forwarded to it — gated, never required.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import logging
+from pathlib import Path
+from typing import Any, Iterator, Mapping, Sequence
+
+import numpy as np
+
+logger = logging.getLogger("deepdfa_tpu")
+
+__all__ = ["Trial", "sample_space", "grid_space", "run_trials", "best_trial"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Trial:
+    trial_id: int
+    overrides: dict[str, Any]
+    metrics: dict[str, float]
+    error: str | None = None  # set when the trial raised; objective is -inf
+
+    @property
+    def objective(self) -> float:
+        if self.error is not None:
+            return float("-inf")
+        return self.metrics.get("val_F1Score", float("-inf"))
+
+
+def sample_space(
+    space: Mapping[str, Sequence[Any]], n_trials: int, seed: int = 0
+) -> Iterator[dict[str, Any]]:
+    """Random search: draw each key independently per trial."""
+    rng = np.random.default_rng(seed)
+    for _ in range(n_trials):
+        yield {k: v[int(rng.integers(len(v)))] for k, v in space.items()}
+
+
+def grid_space(space: Mapping[str, Sequence[Any]]) -> Iterator[dict[str, Any]]:
+    """Exhaustive grid search."""
+    keys = list(space)
+    for combo in itertools.product(*(space[k] for k in keys)):
+        yield dict(zip(keys, combo))
+
+
+def run_trials(
+    candidates: Iterator[dict[str, Any]],
+    out_dir: str | Path,
+    configs: Sequence[str] = (),
+    base_overrides: Mapping[str, Any] | None = None,
+) -> list[Trial]:
+    """Run one ``fit`` per candidate override-set; log every trial to
+    ``trials.jsonl``. Failures are recorded (objective -inf), not raised —
+    a bad hyperparameter draw must not kill the sweep."""
+    from deepdfa_tpu.config import load_config
+    from deepdfa_tpu.train import cli
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    trials_file = out_dir / "trials.jsonl"
+    trials: list[Trial] = []
+    for i, overrides in enumerate(candidates):
+        merged = {**(base_overrides or {}), **overrides}
+        run_dir = out_dir / f"trial_{i}"
+        run_dir.mkdir(parents=True, exist_ok=True)
+        error = None
+        metrics: dict = {}
+        try:
+            cfg = load_config(*configs, overrides=merged)
+            metrics = cli.fit(cfg, run_dir)
+        except Exception as exc:  # noqa: BLE001 — sweep survives bad draws
+            logger.warning("trial %d failed: %s", i, exc)
+            error = str(exc)
+        trial = Trial(
+            i,
+            dict(merged),
+            {k: v for k, v in metrics.items() if isinstance(v, float)},
+            error=error,
+        )
+        trials.append(trial)
+        with open(trials_file, "a") as f:
+            f.write(json.dumps({"trial_id": i, "overrides": trial.overrides,
+                                "metrics": trial.metrics, "error": trial.error}) + "\n")
+        _forward_to_nni(trial)
+    return trials
+
+
+def _forward_to_nni(trial: Trial) -> None:
+    try:
+        import nni  # noqa: F401 — not in this image; external clusters only
+    except ImportError:
+        return
+    nni.report_final_result(trial.objective)
+
+
+def best_trial(trials: Sequence[Trial]) -> Trial:
+    if not trials:
+        raise ValueError("no trials")
+    return max(trials, key=lambda t: t.objective)

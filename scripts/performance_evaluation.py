@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""End-to-end performance evaluation — the reference protocol, no GPU needed.
+
+Parity with ``scripts/performance_evaluation.sh`` / ``_cpu.sh`` (3 timed
+train+test runs; the reference shells into Docker and flips
+``--trainer.gpus``): here each run is ``fit`` then ``test`` (with
+profiling on) through the public CLI on whatever accelerator JAX finds —
+TPU when present, CPU otherwise. Emits ``performance_evaluation.json`` with
+per-run wall times, test F1 and profiled throughput, plus the aggregate.
+
+Usage: python scripts/performance_evaluation.py [--runs 3] [--out DIR]
+       [--config cfg.yaml ...] [--set k=v ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--runs", type=int, default=3)  # 3-run protocol
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--config", action="append", default=[])
+    parser.add_argument("--set", action="append", default=[], dest="overrides")
+    args = parser.parse_args(argv)
+
+    from deepdfa_tpu import utils
+    from deepdfa_tpu.train import cli
+
+    out_dir = Path(args.out) if args.out else utils.storage_dir() / "perf_eval"
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    # Keep the default protocol fast enough to run in the bench loop: the
+    # sample-scale corpus and a short fit unless a config overrides it.
+    base_overrides = [
+        "data.sample=true",
+        "optim.max_epochs=3",
+        "profile=true",
+        "time=true",
+    ] + args.overrides
+
+    runs = []
+    for i in range(args.runs):
+        run_dir = out_dir / f"run_{i}"
+        t0 = time.monotonic()
+        cli.main(
+            ["fit", "--run-dir", str(run_dir)]
+            + [x for c in args.config for x in ("--config", c)]
+            + [x for o in base_overrides for x in ("--set", o)]
+        )
+        fit_s = time.monotonic() - t0
+        t1 = time.monotonic()
+        results = cli.main(
+            ["test", "--run-dir", str(run_dir)]
+            + [x for c in args.config for x in ("--config", c)]
+            + [x for o in base_overrides for x in ("--set", o)]
+        )
+        test_s = time.monotonic() - t1
+        runs.append(
+            {
+                "run": i,
+                "fit_seconds": round(fit_s, 2),
+                "test_seconds": round(test_s, 2),
+                "test_F1Score": results.get("test_F1Score"),
+                "profile_examples_per_sec": results.get("profile_examples_per_sec"),
+                "profile_gflops_per_example": results.get("profile_gflops_per_example"),
+            }
+        )
+        print(json.dumps(runs[-1]))
+
+    f1s = [r["test_F1Score"] for r in runs if r["test_F1Score"] is not None]
+    agg = {
+        "runs": runs,
+        "mean_fit_seconds": sum(r["fit_seconds"] for r in runs) / len(runs),
+        "mean_test_seconds": sum(r["test_seconds"] for r in runs) / len(runs),
+        # None (not 0.0) when a run produced no F1 — don't deflate the mean
+        "mean_test_F1Score": sum(f1s) / len(f1s) if len(f1s) == len(runs) else None,
+    }
+    (out_dir / "performance_evaluation.json").write_text(json.dumps(agg, indent=2))
+    print(json.dumps({k: v for k, v in agg.items() if k != "runs"}))
+    return agg
+
+
+if __name__ == "__main__":
+    main()

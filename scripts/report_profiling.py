@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Aggregate profiling jsonl into per-example stats.
+
+Parity with the reference's ``scripts/report_profiling.py:1-66`` (gflops /
+gmacs / avg ms per example over ``profiledata.jsonl`` + ``timedata.jsonl``);
+the aggregation itself lives in ``deepdfa_tpu.train.profiling.report``.
+
+Usage: python scripts/report_profiling.py RUN_DIR [RUN_DIR ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> None:
+    from deepdfa_tpu.train.profiling import report
+
+    for run_dir in argv or sys.argv[1:]:
+        stats = report(run_dir)
+        print(json.dumps({"run_dir": str(run_dir), **stats}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""HPO tuner + performance-evaluation script (ops parity, SURVEY.md §5)."""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def test_sample_and_grid_spaces():
+    from deepdfa_tpu.train.tune import grid_space, sample_space
+
+    space = {"model.hidden_dim": [8, 16], "optim.lr": [1e-2, 1e-3]}
+    grid = list(grid_space(space))
+    assert len(grid) == 4
+    assert {tuple(sorted(g.items())) for g in grid} == {
+        tuple(sorted({"model.hidden_dim": h, "optim.lr": lr}.items()))
+        for h in (8, 16)
+        for lr in (1e-2, 1e-3)
+    }
+    draws = list(sample_space(space, 5, seed=1))
+    assert len(draws) == 5
+    assert all(d["model.hidden_dim"] in (8, 16) for d in draws)
+    # deterministic per seed
+    assert draws == list(sample_space(space, 5, seed=1))
+
+
+def test_run_trials_and_best(tmp_path, monkeypatch):
+    """Sweep over the synthetic corpus with tiny fits; bad draws survive."""
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import importlib
+
+    from deepdfa_tpu import utils
+
+    importlib.reload(utils)
+
+    from deepdfa_tpu.train.tune import best_trial, run_trials
+
+    base = {
+        "data.sample": True,
+        "optim.max_epochs": 1,
+        "model.hidden_dim": 8,
+        "model.n_steps": 1,
+        "data.batch.batch_graphs": 64,
+        "data.batch.max_nodes": 8192,
+        "data.batch.max_edges": 16384,
+    }
+    candidates = [
+        {"optim.lr": 1e-3},
+        {"optim.lr": "not-a-number"},  # bad draw: must be recorded, not raised
+    ]
+    trials = run_trials(iter(candidates), tmp_path / "sweep", base_overrides=base)
+    assert len(trials) == 2
+    assert trials[0].objective > float("-inf")
+    assert trials[1].objective == float("-inf")
+    assert trials[1].error  # the failure reason is preserved
+    best = best_trial(trials)
+    assert best.trial_id == 0
+    lines = (tmp_path / "sweep" / "trials.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[0])["overrides"]["optim.lr"] == 1e-3
+    assert json.loads(lines[1])["error"]  # failures are distinguishable post-hoc
+
+
+def test_performance_evaluation_script(tmp_path, monkeypatch):
+    """The 3-run protocol end-to-end (shrunk to 1 run) — emits aggregate JSON
+    with wall times and F1."""
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import importlib
+
+    from deepdfa_tpu import utils
+
+    importlib.reload(utils)
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+    import performance_evaluation
+
+    agg = performance_evaluation.main(
+        [
+            "--runs", "1",
+            "--out", str(tmp_path / "perf"),
+            "--set", "optim.max_epochs=1",
+            "--set", "model.hidden_dim=8",
+            "--set", "model.n_steps=1",
+            "--set", "data.batch.batch_graphs=64",
+            "--set", "data.batch.max_nodes=8192",
+            "--set", "data.batch.max_edges=16384",
+        ]
+    )
+    assert len(agg["runs"]) == 1
+    r = agg["runs"][0]
+    assert r["fit_seconds"] > 0 and r["test_seconds"] > 0
+    assert np.isfinite(r["test_F1Score"])
+    assert r["profile_examples_per_sec"] and r["profile_examples_per_sec"] > 0
+    saved = json.loads((tmp_path / "perf" / "performance_evaluation.json").read_text())
+    assert saved["mean_test_F1Score"] == agg["mean_test_F1Score"]
