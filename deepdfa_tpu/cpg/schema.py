@@ -160,3 +160,62 @@ class CPG:
         for _, _, e in self.edges:
             kinds[e] += 1
         return f"CPG({len(self.nodes)} nodes, {dict(kinds)})"
+
+
+# ---------------------------------------------------------------------------
+# edge-type subgraph selection + k-hop neighbourhoods
+
+# gtype → edge types, parity with the reference's ``rdg``
+# (``DDFA/sastvd/helpers/joern.py:419-441``). "cfg" is the golden config
+# (``configs/config_bigvul.yaml``); REACHING_DEF/CDG come from Joern or from
+# ``features.add_dependence_edges`` on natively-extracted graphs.
+RDG_ETYPES: dict[str, tuple[str, ...]] = {
+    "reftype": ("EVAL_TYPE", "REF"),
+    "ast": ("AST",),
+    "pdg": ("REACHING_DEF", "CDG"),
+    "cfgcdg": ("CFG", "CDG"),
+    "cfg": ("CFG",),
+    "all": ("REACHING_DEF", "CDG", "AST", "EVAL_TYPE", "REF"),
+    "dataflow": ("CFG", "AST"),
+}
+
+
+def rdg(cpg: "CPG", gtype: str) -> list[tuple[int, int]]:
+    """Deduped (src, dst) edge list of the ``gtype`` subgraph."""
+    etypes = RDG_ETYPES.get(gtype)
+    if etypes is None:
+        raise ValueError(f"unknown gtype {gtype!r}; known: {sorted(RDG_ETYPES)}")
+    return sorted({(s, d) for s, d, e in cpg.edges if e in etypes})
+
+
+def khop_neighbours(
+    cpg: "CPG",
+    node_ids: list[int],
+    hop: int = 1,
+    gtype: str = "all",
+    intermediate: bool = True,
+) -> dict[int, list[int]]:
+    """Neighbours within ``hop`` steps (undirected), via sparse matrix powers
+    (parity: ``joern.py:372-416``). ``intermediate=True`` unions hops 1..k;
+    otherwise only exactly-k-step neighbours are returned."""
+    from scipy import sparse
+
+    edges = rdg(cpg, gtype)
+    ids = sorted(cpg.nodes)
+    id2adj = {nid: i for i, nid in enumerate(ids)}
+    n = len(ids)
+    rows, cols = [], []
+    for s, d in edges:
+        rows += [id2adj[s], id2adj[d]]
+        cols += [id2adj[d], id2adj[s]]
+    coo = sparse.coo_matrix(
+        (np.ones(len(rows)), (rows, cols)), shape=(n, n)
+    ).tocsr()
+    out: dict[int, list[int]] = {nid: [] for nid in node_ids}
+    hops = range(1, hop + 1) if intermediate else [hop]
+    for h in hops:
+        csr = coo**h
+        for nid in node_ids:
+            row = csr[id2adj[nid]].toarray()[0].nonzero()[0]
+            out[nid] += [ids[i] for i in row]
+    return out

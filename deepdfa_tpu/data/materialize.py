@@ -37,15 +37,17 @@ from deepdfa_tpu.data.vocab import Vocabulary, build_vocab
 __all__ = ["select_cfg_nodes", "graph_from_cpg", "CorpusBuilder"]
 
 
-def select_cfg_nodes(cpg: CPG) -> tuple[list[int], list[tuple[int, int]]]:
-    """(ordered node ids, CFG edge list) after the reference's selection:
-    nodes need a line number, edges are deduped CFG edges between kept nodes,
-    lone nodes are dropped."""
+def select_cfg_nodes(
+    cpg: CPG, gtype: str = "cfg"
+) -> tuple[list[int], list[tuple[int, int]]]:
+    """(ordered node ids, edge list) after the reference's selection: nodes
+    need a line number, edges are the deduped ``gtype`` subgraph
+    (``rdg``, golden config = cfg) between kept nodes, lone nodes dropped."""
+    from deepdfa_tpu.cpg.schema import rdg
+
     with_line = [i for i, n in cpg.nodes.items() if n.line is not None]
     keep = set(with_line)
-    edges = sorted(
-        {(s, d) for s, d, e in cpg.edges if e == "CFG" and s in keep and d in keep}
-    )
+    edges = [(s, d) for s, d in rdg(cpg, gtype) if s in keep and d in keep]
     connected = {s for s, _ in edges} | {d for _, d in edges}
     nodes = [i for i in with_line if i in connected]
     return nodes, edges
@@ -57,15 +59,16 @@ def graph_from_cpg(
     feat_ids: Mapping[str, Mapping[int, int]],
     vuln_lines: set[int] | None = None,
     graph_label: int | None = None,
+    gtype: str = "cfg",
 ) -> Graph | None:
     """Build one training graph. ``feat_ids`` maps feature name →
     {node_id: int id}. Exactly one of ``vuln_lines`` (per-line labels,
     Big-Vul) / ``graph_label`` (broadcast, Devign) must be given.
 
-    Returns None when no CFG structure survives selection (the reference
+    Returns None when no graph structure survives selection (the reference
     drops such graphs at load time, ``linevd/dataset.py:40-45``).
     """
-    nodes, edges = select_cfg_nodes(cpg)
+    nodes, edges = select_cfg_nodes(cpg, gtype)
     if not nodes:
         return None
     pos = {nid: i for i, nid in enumerate(nodes)}

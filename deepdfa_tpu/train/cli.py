@@ -127,6 +127,16 @@ def _epoch_graphs(
 # subcommands
 
 
+def _tb_writer(run_dir: Path):
+    """TensorBoard scalars (``MyTensorBoardLogger`` parity, ``my_tb.py:5-8``);
+    optional — the jsonl/json artifacts are the primary record."""
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+    except ImportError:
+        return None
+    return SummaryWriter(log_dir=str(run_dir / "tb"))
+
+
 def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
     corpus = load_corpus(cfg)
     train, val = corpus["train"], corpus["val"]
@@ -144,6 +154,7 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
     state = trainer.init_state(example)
     ckpts = CheckpointManager(run_dir / "checkpoints", cfg.checkpoint)
     tuning_file = run_dir / "tuning.jsonl"
+    tb = _tb_writer(run_dir)
 
     last_val: dict[str, float] = {}
     for epoch in range(cfg.optim.max_epochs):
@@ -155,6 +166,10 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
             "epoch %d: train_loss=%.4f train_F1=%.4f val_loss=%.4f val_F1=%.4f",
             epoch, train_loss, train_m["train_F1Score"], val_loss, val_m["val_F1Score"],
         )
+        if tb is not None:
+            for k, v in {"train_loss": train_loss, "val_loss": val_loss,
+                         **train_m, **val_m}.items():
+                tb.add_scalar(k, v, epoch)
         ckpts.save(
             int(state.step), {"params": state.params},
             metrics={"val_loss": val_loss, "val_F1Score": val_m["val_F1Score"]},
@@ -176,6 +191,8 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
     with open(tuning_file, "a") as f:
         f.write(json.dumps({"final": True, "val_F1Score": last_val["val_F1Score"]}) + "\n")
     (run_dir / "final_metrics.json").write_text(json.dumps(last_val, indent=2))
+    if tb is not None:
+        tb.close()
     return last_val
 
 

@@ -153,3 +153,58 @@ done:
         seen.add(n)
         stack.extend(cpg.successors(n, "CFG"))
     assert mret in seen
+
+
+def test_rdg_gtype_selection():
+    """rdg parity (joern.py:419-441): gtype → edge-type families."""
+    from deepdfa_tpu.cpg.frontend import parse_source as extract_cpg
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.schema import RDG_ETYPES, rdg
+
+    cpg = extract_cpg("int f(int x) { int y = x + 1; if (y > 2) y = 0; return y; }")
+    cpg = add_dependence_edges(cpg)
+    cfg_edges = rdg(cpg, "cfg")
+    assert cfg_edges and all(
+        (s, d, "CFG") in set(cpg.edges) for s, d in cfg_edges
+    )
+    pdg_edges = set(rdg(cpg, "pdg"))
+    allowed = {(s, d) for s, d, e in cpg.edges if e in ("REACHING_DEF", "CDG")}
+    assert pdg_edges and pdg_edges <= allowed
+    assert set(rdg(cpg, "cfgcdg")) >= set(cfg_edges)
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown gtype"):
+        rdg(cpg, "nope")
+    assert set(RDG_ETYPES) == {"reftype", "ast", "pdg", "cfgcdg", "cfg", "all", "dataflow"}
+
+
+def test_khop_neighbours():
+    """1-hop = direct undirected neighbours; 2-hop ⊇ via matrix powers
+    (joern.py:372-416)."""
+    from deepdfa_tpu.cpg.frontend import parse_source as extract_cpg
+    from deepdfa_tpu.cpg.schema import khop_neighbours, rdg
+
+    cpg = extract_cpg("int f(int x) { int y = x; y = y + 1; return y; }")
+    edges = rdg(cpg, "ast")
+    s, d = edges[0]
+    one = khop_neighbours(cpg, [s], hop=1, gtype="ast")
+    assert d in one[s]
+    two = khop_neighbours(cpg, [s], hop=2, gtype="ast")
+    assert set(one[s]) <= set(two[s])
+    exact2 = khop_neighbours(cpg, [s], hop=2, gtype="ast", intermediate=False)
+    assert set(exact2[s]) <= set(two[s])
+
+
+def test_materialize_gtype_variants():
+    """graph_from_cpg materialises non-cfg gtypes too (datamodule gtype knob)."""
+    from deepdfa_tpu.cpg.frontend import parse_source as extract_cpg
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.data.materialize import graph_from_cpg
+
+    cpg = add_dependence_edges(
+        extract_cpg("int f(int x) { int y = x + 1; if (y > 2) y = 0; return y; }")
+    )
+    for gtype in ("cfg", "cfgcdg", "pdg"):
+        g = graph_from_cpg(cpg, 0, {}, vuln_lines={1}, gtype=gtype)
+        if g is not None:
+            assert g.n_edges >= g.n_nodes  # self-loops added
