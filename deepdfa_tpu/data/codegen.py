@@ -1,0 +1,93 @@
+"""Synthetic C source corpus with known vulnerable lines.
+
+The reference's test fixture is a 200-function sample of the downloaded
+Big-Vul CSV (``sastvd/scripts/sample_MSR_data.py``); this environment has no
+network, so the hermetic analogue is *generated* C: template-based functions
+where the vulnerable variants contain a classic memory-safety defect on a
+known line (unbounded ``strcpy``/``memcpy``/index write), and the fixed
+variants bound it. Unlike :mod:`deepdfa_tpu.data.synthetic` (random graphs),
+this feeds the REAL pipeline — native frontend → reaching-defs → abstract
+dataflow → vocab → shards — so end-to-end runs exercise every stage on
+actual source text.
+
+Output schema matches the ingestion contract (``ingest.bigvul``): columns
+``id, before, after, vul, removed, added``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+__all__ = ["generate_function", "demo_corpus"]
+
+
+def _names(rng: np.random.Generator, n: int) -> list[str]:
+    pool = ["acc", "buf", "cnt", "idx", "len", "out", "ptr", "sum", "tmp", "val"]
+    picks = rng.choice(len(pool), size=n, replace=False)
+    return [pool[i] + str(int(rng.integers(0, 100))) for i in picks]
+
+
+def generate_function(fid: int, vul: bool, rng: np.random.Generator) -> dict:
+    """One (before, after) pair. Vulnerable: the ``before`` body copies into a
+    fixed buffer without a bound; the ``after`` adds the bound — so ``removed``
+    (the vul lines) and ``added`` mirror a real security patch's diff."""
+    a, b, c = _names(rng, 3)
+    k1, k2 = int(rng.integers(1, 9)), int(rng.integers(16, 64))
+    filler_pool = [
+        f"    int {a} = {c}[0] + {k1};",
+        f"    int {b} = {a} * {k1};",
+        f"    if ({a} > {k1}) {{ {b} = {a} - 1; }}",
+        f"    for (int i = 0; i < {k1}; i++) {{ {b} += i; }}",
+    ]
+    n_filler = int(rng.integers(1, len(filler_pool) + 1))
+    filler = [filler_pool[i] for i in sorted(rng.choice(len(filler_pool), n_filler, replace=False))]
+
+    head = f"int f{fid}(char *{c}, int n)"
+    # The defect must be visible to *abstract dataflow*: features come from
+    # definitions only (assignments), so the vulnerable copy bound is an
+    # unchecked strlen-derived def, the fixed one a clamped arithmetic def —
+    # distinct (api, operator) subkeys, like real taint-vs-sanitized code.
+    vul_lines = [
+        f"    int cap{fid} = strlen({c});",
+        f"    memcpy(dst{fid}, {c}, cap{fid});",
+    ]
+    safe_lines = [
+        f"    int cap{fid} = (n < {k2}) ? n : {k2} - 1;",
+        f"    memcpy(dst{fid}, {c}, cap{fid});",
+    ]
+    decl = f"    char dst{fid}[{k2}];"
+
+    def render(mid: list[str]) -> str:
+        return "\n".join([head, "{", decl, *filler, *mid, f"    return n + {k1};", "}"])
+
+    before = render(vul_lines if vul else safe_lines)
+    after = render(safe_lines)
+    if vul:
+        # the unchecked-bound def line in `before` (1-based: header, "{",
+        # decl, fillers, then the strlen def)
+        removed = [3 + len(filler) + 1]
+        added = [3 + len(filler) + 1]  # the clamped def replaces it in `after`
+    else:
+        removed, added = [], []
+    return {
+        "id": fid,
+        "before": before,
+        "after": after,
+        "vul": int(vul),
+        "removed": removed,
+        "added": added,
+    }
+
+
+def demo_corpus(n: int = 200, vul_ratio: float = 0.5, seed: int = 0) -> pd.DataFrame:
+    """Balanced-ish labeled corpus (the sample CSV analogue: 100 vul +
+    100 non-vul in the reference's sample mode)."""
+    rng = np.random.default_rng(seed)
+    rows = [
+        generate_function(fid, bool(rng.random() < vul_ratio), rng)
+        for fid in range(n)
+    ]
+    df = pd.DataFrame(rows)
+    df["dataset"] = "demo"
+    return df

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Offline preprocessing: raw corpus → training-ready graph shards.
+
+The ``DDFA/scripts/preprocess.sh`` pipeline (prepare → getgraphs → dbize →
+abstract_dataflow → absdf) as one resumable driver, JVM-free:
+
+1. **ingest** — Big-Vul/Devign CSVs via :mod:`deepdfa_tpu.data.ingest`
+   (requires the downloaded corpus on disk), or ``--dataset demo`` for the
+   generated-C corpus (:mod:`deepdfa_tpu.data.codegen`, hermetic).
+2. **extract** — native C frontend per function (parallel ``dfmp`` over
+   workers, parity with the SLURM-sharded Joern stage of
+   ``run_getgraphs.sh``); failures land in ``failed_frontend.txt`` and are
+   skipped, mirroring ``failed_joern.txt``.
+3. **label** — vulnerable lines = removed ∪ dependent-added
+   (``evaluate.py:194-218``); Devign-style corpora broadcast the graph label.
+4. **materialize** — abstract-dataflow features → train-split vocab →
+   encoded graphs → ``.npz`` shards + ``splits.json`` + ``vocab.json``
+   under ``processed_dir()/{dsname}/shards[_sample]``, where the training
+   CLI picks them up.
+
+Idempotent: an existing shard dir is left alone unless ``--overwrite``
+(stage-resume parity with ``getgraphs.py:47-54``).
+
+Usage: python scripts/preprocess.py --dataset demo [--n 200] [--sample]
+       python scripts/preprocess.py --dataset bigvul [--sample] [--overwrite]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _extract_one(item: dict) -> tuple[int, object, str | None]:
+    """(id, CPG|None, error) — module-level so process pools can pickle it."""
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+
+    fid, code = item["id"], item["before"]
+    try:
+        return fid, add_dependence_edges(parse_source(code)), None
+    except Exception as exc:  # noqa: BLE001 — failure-file protocol
+        return fid, None, f"{fid}\t{type(exc).__name__}: {exc}"
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset", default="demo", help="demo | bigvul | devign")
+    parser.add_argument("--n", type=int, default=200, help="demo corpus size")
+    parser.add_argument("--sample", action="store_true")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--workers", type=int, default=6)
+    parser.add_argument("--overwrite", action="store_true")
+    parser.add_argument("--limit-all", type=int, default=1000)
+    parser.add_argument("--limit-subkeys", type=int, default=1000)
+    args = parser.parse_args(argv)
+
+    import numpy as np
+
+    from deepdfa_tpu import utils
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.cpg.features import dep_add_lines
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.graphs import save_shards
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    suffix = "_sample" if args.sample else ""
+    out_dir = utils.processed_dir() / args.dataset / f"shards{suffix}"
+    if (out_dir / "splits.json").exists() and not args.overwrite:
+        print(json.dumps({"status": "exists", "out": str(out_dir)}))
+        return {"status": "exists", "out": str(out_dir)}
+
+    # 1. ingest
+    if args.dataset == "demo":
+        from deepdfa_tpu.data.codegen import demo_corpus
+
+        df = demo_corpus(args.n if not args.sample else min(args.n, 60), seed=args.seed)
+        graph_level = False
+    else:
+        from deepdfa_tpu.data import ingest
+
+        df = ingest.ds(args.dataset, sample=args.sample)
+        graph_level = args.dataset == "devign"
+
+    # 2. extract CPGs (parallel, with the failure-file protocol)
+    records = df.to_dict("records")
+    results = utils.dfmp(df, _extract_one, workers=args.workers, desc="extract")
+    cpgs, failures = {}, []
+    for fid, cpg, err in results:
+        if cpg is not None and len(cpg):
+            cpgs[fid] = cpg
+        if err is not None:
+            failures.append(err)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    if failures:
+        (out_dir / "failed_frontend.txt").write_text("\n".join(failures) + "\n")
+
+    # 3. labels: removed ∪ dep-add for line-level corpora
+    row_of = {r["id"]: r for r in records}
+    vuln_lines = graph_labels = None
+    if graph_level:
+        graph_labels = {fid: int(row_of[fid].get("vul", 0)) for fid in cpgs}
+    else:
+        vuln_lines = {}
+        for fid, cpg in cpgs.items():
+            row = row_of[fid]
+            lines = set(row.get("removed") or [])
+            added = list(row.get("added") or [])
+            if added and row.get("after"):
+                try:
+                    after_cpg = parse_source(row["after"])
+                    lines |= set(dep_add_lines(cpg, after_cpg, added))
+                except Exception:  # noqa: BLE001 — label fallback: removed only
+                    pass
+            vuln_lines[fid] = lines
+
+    # 4. split (random 70/10/20 unless the ingest table carries one)
+    rng = np.random.default_rng(args.seed)
+    ids = sorted(cpgs)
+    perm = rng.permutation(len(ids))
+    n_val, n_test = int(len(ids) * 0.1), int(len(ids) * 0.2)
+    splits = {
+        "val": [ids[i] for i in perm[:n_val]],
+        "test": [ids[i] for i in perm[n_val : n_val + n_test]],
+        "train": [ids[i] for i in perm[n_val + n_test :]],
+    }
+
+    # 5. materialize
+    builder = CorpusBuilder(
+        FeatureConfig(limit_all=args.limit_all, limit_subkeys=args.limit_subkeys)
+    )
+    graphs, vocabs = builder.build(
+        cpgs, splits["train"], vuln_lines=vuln_lines, graph_labels=graph_labels
+    )
+    n_shards = save_shards(graphs, out_dir)
+    (out_dir / "splits.json").write_text(json.dumps(splits))
+    (out_dir / "vocab.json").write_text(
+        json.dumps({name: voc.all_vocab for name, voc in vocabs.items()})
+    )
+    summary = {
+        "status": "ok",
+        "out": str(out_dir),
+        "functions": len(records),
+        "cpgs": len(cpgs),
+        "graphs": len(graphs),
+        "failed": len(failures),
+        "shards": n_shards,
+        "vul_graphs": int(sum(g.node_feats["_VULN"].max() > 0 for g in graphs)),
+    }
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()
