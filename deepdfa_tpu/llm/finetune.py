@@ -1,0 +1,162 @@
+"""LoRA fine-tuning of the LLM — the stage that produces adapter checkpoints.
+
+The reference *consumes* LoRA-finetuned CodeLlama checkpoints
+(``--finetuned_path``, ``MSIVD/msivd/train.py:863-869``; applied via peft,
+``hf_inference.py:86-107``) — the stage that creates them (multitask
+explanation tuning) predates this snapshot. This module owns that stage
+natively:
+
+- causal-LM loss (next-token CE) over the real tokens only (pad-masked);
+- ONLY the LoRA adapters train: :func:`deepdfa_tpu.llm.lora.lora_mask` routes
+  every other param through ``optax.set_to_zero`` — the optimizer state for
+  frozen params is empty, matching peft's memory profile;
+- AdamW + linear-warmup cosine schedule + global-norm clip (the same
+  schedule family as the joint stage);
+- adapters checkpoint alone (``split_lora``) — base weights are never
+  written, parity with peft adapter dirs.
+
+The full step jits once (static shapes from ``TextExamples``); with a
+sharded base model, pass params placed by ``mesh_shardings`` and GSPMD
+partitions the backward pass the same as the forward.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deepdfa_tpu.llm.dataset import TextExamples, text_batches
+from deepdfa_tpu.llm.joint import cosine_warmup_schedule
+from deepdfa_tpu.llm.llama import LlamaForCausalLM
+from deepdfa_tpu.llm.lora import lora_mask, split_lora
+
+__all__ = ["FinetuneConfig", "FinetuneState", "lora_optimizer", "make_lm_steps", "LoraFinetuner"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FinetuneConfig:
+    learning_rate: float = 1e-4
+    weight_decay: float = 0.0
+    max_grad_norm: float = 1.0
+    epochs: int = 1
+    batch_size: int = 4
+    warmup_frac: float = 0.02  # same // 50 family as the joint stage
+    seed: int = 0
+
+
+class FinetuneState(NamedTuple):
+    params: Any  # FULL param tree (base frozen + adapters training)
+    opt_state: Any
+    rng: jax.Array
+    step: jnp.ndarray
+
+
+def lora_optimizer(
+    cfg: FinetuneConfig, params: Any, total_steps: int
+) -> optax.GradientTransformation:
+    """clip → AdamW on LoRA leaves only; every other leaf is zeroed so the
+    base model never moves and its optimizer state is empty."""
+    warmup = max(int(total_steps * cfg.warmup_frac), 1)
+    schedule = cosine_warmup_schedule(cfg.learning_rate, warmup, total_steps)
+    inner = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adamw(schedule, weight_decay=cfg.weight_decay),
+    )
+    labels = jax.tree.map(lambda is_lora: "lora" if is_lora else "frozen", lora_mask(params))
+    return optax.multi_transform({"lora": inner, "frozen": optax.set_to_zero()}, labels)
+
+
+def lm_loss(
+    logits: jnp.ndarray,  # [b, s, v]
+    input_ids: jnp.ndarray,  # [b, s]
+    pad_mask: jnp.ndarray,  # [b, s] True = real token
+) -> jnp.ndarray:
+    """Next-token CE over positions whose *target* is a real token."""
+    targets = input_ids[:, 1:]
+    w = pad_mask[:, 1:].astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
+    return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def make_lm_steps(
+    model: LlamaForCausalLM, tx: optax.GradientTransformation
+) -> tuple[Callable, Callable]:
+    def loss_fn(params, ids, mask):
+        logits = model.apply({"params": params}, ids, mask)
+        return lm_loss(logits, ids, mask)
+
+    @jax.jit
+    def train_step(state: FinetuneState, ids, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, ids, mask)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return FinetuneState(params, opt_state, state.rng, state.step + 1), loss
+
+    eval_step = jax.jit(loss_fn)
+    return train_step, eval_step
+
+
+@dataclasses.dataclass
+class LoraFinetuner:
+    model: LlamaForCausalLM
+    cfg: FinetuneConfig
+    run_dir: Path | None = None
+
+    def train(self, params: Any, examples: TextExamples) -> tuple[Any, list[float]]:
+        """Returns (params with tuned adapters, per-epoch mean losses)."""
+        cfg = self.cfg
+        n_batches = -(-len(examples) // cfg.batch_size)
+        tx = lora_optimizer(cfg, params, total_steps=cfg.epochs * n_batches)
+        train_step, _ = make_lm_steps(self.model, tx)
+        state = FinetuneState(
+            params, tx.init(params), jax.random.key(cfg.seed), jnp.zeros((), jnp.int32)
+        )
+        epoch_losses: list[float] = []
+        for epoch in range(cfg.epochs):
+            losses = []
+            for tb in text_batches(
+                examples, cfg.batch_size, shuffle=True, seed=cfg.seed + epoch
+            ):
+                state, loss = train_step(
+                    state, jnp.asarray(tb.input_ids), jnp.asarray(tb.pad_mask)
+                )
+                losses.append(float(loss))
+            epoch_losses.append(float(np.mean(losses)))
+            if self.run_dir is not None:
+                self.save_adapters(state.params, f"adapters_epoch_{epoch}")
+        return state.params, epoch_losses
+
+    def save_adapters(self, params: Any, name: str) -> Path:
+        """Adapters only (peft-dir parity: the base model is never written)."""
+        import orbax.checkpoint as ocp
+
+        adapters, _ = split_lora(params)
+        path = (Path(self.run_dir) / name).absolute()
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, adapters, force=True)
+        ckptr.wait_until_finished()
+        return path
+
+    def load_adapters(self, params: Any, name: str) -> Any:
+        """Graft saved adapters onto a (fresh or base) param tree."""
+        import orbax.checkpoint as ocp
+
+        template, _base = split_lora(params)
+        path = (Path(self.run_dir) / name).absolute()
+        adapters = ocp.StandardCheckpointer().restore(path, template)
+
+        def pick(path, p):
+            node = adapters
+            for k in path:
+                if not isinstance(node, dict) or k.key not in node:
+                    return p
+                node = node[k.key]
+            return p if node is None else node
+
+        return jax.tree_util.tree_map_with_path(pick, params)
