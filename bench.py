@@ -31,7 +31,8 @@ def build_batches(n_batches: int, input_dim: int, batch_graphs: int = 256):
     from deepdfa_tpu.data.synthetic import random_dataset
 
     bc = BatchConfig()
-    bucket = BucketSpec(batch_graphs + 1, bc.max_nodes, bc.max_edges)
+    scale = max(batch_graphs // bc.batch_graphs, 1)  # keep node/edge headroom
+    bucket = BucketSpec(batch_graphs + 1, bc.max_nodes * scale, bc.max_edges * scale)
     graphs = random_dataset(n_batches * batch_graphs, seed=0, input_dim=input_dim)
     batcher = GraphBatcher([bucket])
     batches = []
@@ -45,7 +46,12 @@ def build_batches(n_batches: int, input_dim: int, batch_graphs: int = 256):
     return batches
 
 
-def bench_jax(batches, steps: int, train: bool):
+def bench_jax(batches, steps: int, train: bool, dtype: str = "bfloat16"):
+    """bf16 compute by default — the TPU-idiomatic precision (MXU-native;
+    training still converges, see tests/test_preprocess.py's pipeline at
+    model.dtype=bfloat16). The reference runs fp32 on GPU."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -56,6 +62,7 @@ def bench_jax(batches, steps: int, train: bool):
     from deepdfa_tpu.train.metrics import ConfusionState
 
     cfg = ExperimentConfig()
+    cfg = dataclasses.replace(cfg, model=dataclasses.replace(cfg.model, dtype=dtype))
     model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
     dev_batches = [jax.tree.map(jnp.asarray, b) for b in batches]
     trainer = Trainer(model=model, cfg=cfg, pos_weight=15.0)
@@ -139,6 +146,14 @@ def main():
     infer_gps = bench_jax(batches, args.steps, train=False)
     train_gps = bench_jax(batches, max(args.steps // 2, 5), train=True)
 
+    # Peak throughput at batch 1024: same model, larger static batch —
+    # amortises per-dispatch host↔device latency (big on tunneled TPUs).
+    try:
+        peak_batches = build_batches(2, FeatureConfig().input_dim, batch_graphs=1024)
+        peak_gps = bench_jax(peak_batches, args.steps, train=False)
+    except RuntimeError:
+        peak_gps = None
+
     if args.skip_baseline:
         base_gps = None
     else:
@@ -150,7 +165,9 @@ def main():
         "unit": "graphs/sec",
         "vs_baseline": round(infer_gps / base_gps, 2) if base_gps else None,
         "backend": backend,
+        "dtype": "bfloat16",
         "train_graphs_per_sec": round(train_gps, 1),
+        "peak_batch1024_graphs_per_sec": round(peak_gps, 1) if peak_gps else None,
         "baseline": "torch-cpu same-semantics GGNN (compat/torch_ref.py)",
         "baseline_graphs_per_sec": round(base_gps, 1) if base_gps else None,
         "config": "hidden32_steps5_concat4_batch256",
