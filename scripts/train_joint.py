@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Joint LLM+GNN training CLI — the ``MSIVD/msivd/train.py`` command surface.
+
+Maps the reference's main flags (``train.py:588-801``) onto the TPU joint
+trainer. Two weight sources:
+
+- ``--hf-checkpoint DIR``: convert a local HF CodeLlama checkpoint
+  (safetensors/bin) and tokenize with ``transformers`` — the production
+  path (no network: the directory must already be on disk).
+- default: a tiny hermetic model + hash tokenizer over the generated demo
+  corpus — the smoke path proving the full joint loop end-to-end.
+
+Graphs come from the materialized shards of ``scripts/preprocess.py`` for
+the same dataset (the index-join key is the function id in both).
+
+Usage:
+  python scripts/preprocess.py --dataset demo --n 200
+  python scripts/train_joint.py --dataset demo --do_train --do_test --epochs 2
+  python scripts/train_joint.py --preset bigvul_ft_bigvul --hf-checkpoint /path ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset", default="demo")
+    parser.add_argument("--preset", default=None, help="one of llm.presets.PRESETS")
+    parser.add_argument("--hf-checkpoint", default=None, help="local HF model dir")
+    parser.add_argument("--do_train", action="store_true")
+    parser.add_argument("--do_test", action="store_true")
+    parser.add_argument("--epochs", type=int, default=None)
+    parser.add_argument("--block_size", type=int, default=None)
+    parser.add_argument("--train_batch_size", type=int, default=None)
+    parser.add_argument("--eval_batch_size", type=int, default=None)
+    parser.add_argument("--learning_rate", type=float, default=None)
+    parser.add_argument("--no_flowgnn", action="store_true")
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--output_dir", default=None)
+    parser.add_argument("--sample", action="store_true")
+    args = parser.parse_args(argv)
+
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from deepdfa_tpu import utils
+    from deepdfa_tpu.config import GGNNConfig
+    from deepdfa_tpu.data.graphs import load_shards
+    from deepdfa_tpu.llm.dataset import GraphJoin, HashTokenizer, encode_functions
+    from deepdfa_tpu.llm.fusion import FusionModel
+    from deepdfa_tpu.llm.joint import JointConfig, JointTrainer
+    from deepdfa_tpu.llm.llama import LlamaModel, tiny_llama
+
+    # --- joint config: preset base, CLI overrides on top
+    if args.preset:
+        from deepdfa_tpu.llm.presets import PRESETS
+
+        preset = PRESETS[args.preset]
+        jcfg, llm_cfg = preset.joint, preset.llm
+    else:
+        jcfg, llm_cfg = JointConfig(), tiny_llama(vocab_size=2048)
+    updates = {
+        k: v
+        for k, v in {
+            "epochs": args.epochs,
+            "block_size": args.block_size,
+            "train_batch_size": args.train_batch_size,
+            "eval_batch_size": args.eval_batch_size,
+            "learning_rate": args.learning_rate,
+            "seed": args.seed,
+            "dataset_style": args.dataset,
+        }.items()
+        if v is not None
+    }
+    if args.no_flowgnn:
+        updates["use_gnn"] = False
+    jcfg = dataclasses.replace(jcfg, **updates)
+
+    # --- corpus: functions + labels from the demo generator or ingest table
+    if args.dataset == "demo":
+        from deepdfa_tpu.data.codegen import demo_corpus
+
+        df = demo_corpus(60 if args.sample else 200, seed=0)
+        funcs, labels, ids = df.before.tolist(), df.vul.tolist(), df.id.tolist()
+    else:
+        from deepdfa_tpu.data import ingest
+
+        df = ingest.ds(args.dataset, sample=args.sample)
+        funcs, labels, ids = df.before.tolist(), df.vul.tolist(), df.id.tolist()
+
+    # --- model + tokenizer
+    if args.hf_checkpoint:
+        from transformers import AutoTokenizer
+
+        from deepdfa_tpu.llm.convert import load_hf_checkpoint, load_hf_config
+
+        llm_cfg = load_hf_config(args.hf_checkpoint)
+        tokenizer = AutoTokenizer.from_pretrained(args.hf_checkpoint)
+        llm = LlamaModel(llm_cfg)
+        llm_params = load_hf_checkpoint(args.hf_checkpoint, llm_cfg)["model"]
+    else:
+        tokenizer = HashTokenizer(vocab_size=llm_cfg.vocab_size)
+        llm = LlamaModel(llm_cfg)
+        llm_params = llm.init(
+            jax.random.key(0), np.zeros((2, jcfg.block_size), np.int32)
+        )["params"]
+
+    examples = encode_functions(funcs, labels, tokenizer, jcfg.block_size, indices=ids)
+    n = len(examples)
+    rng = np.random.default_rng(jcfg.seed)
+    perm = rng.permutation(n)
+    cut_val, cut_test = int(n * 0.8), int(n * 0.9)
+    pick = lambda sl: type(examples)(*(np.asarray(a)[perm[sl]] for a in examples))
+    train_ex, eval_ex, test_ex = (
+        pick(slice(0, cut_val)),
+        pick(slice(cut_val, cut_test)),
+        pick(slice(cut_test, None)),
+    )
+
+    # --- graphs from the preprocess shards (index-join by function id)
+    join = None
+    if jcfg.use_gnn:
+        suffix = "_sample" if args.sample else ""
+        shard_dir = utils.processed_dir() / args.dataset / f"shards{suffix}"
+        if not shard_dir.exists():
+            raise SystemExit(
+                f"no shards at {shard_dir} — run scripts/preprocess.py "
+                f"--dataset {args.dataset} first (or pass --no_flowgnn)"
+            )
+        join = GraphJoin.from_list(load_shards(shard_dir))
+
+    input_dim = 1002  # FeatureConfig default (limit_all 1000 + 2)
+    fusion = FusionModel(
+        gnn_cfg=GGNNConfig(),
+        input_dim=input_dim,
+        llm_hidden_size=llm_cfg.hidden_size,
+        use_gnn=jcfg.use_gnn,
+        dropout_rate=0.1,
+    )
+    run_dir = Path(args.output_dir) if args.output_dir else utils.get_dir(
+        utils.storage_dir() / "joint_runs" / utils.get_run_id()
+    )
+    trainer = JointTrainer(
+        llm=llm, llm_params=llm_params, fusion=fusion, cfg=jcfg,
+        join=join, run_dir=run_dir,
+    )
+
+    out: dict = {"run_dir": str(run_dir), "n_train": len(train_ex)}
+    state = None
+    if args.do_train:
+        state = trainer.train(train_ex, eval_ex)
+        out["history"] = trainer.history[-3:]
+        out["num_missing"] = trainer.num_missing
+    if args.do_test:
+        params = state.params if state is not None else None
+        if params is None:
+            raise SystemExit("--do_test without --do_train needs a checkpoint (todo)")
+        out |= trainer.test(params, test_ex)
+    print(json.dumps(out, default=float))
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -83,3 +83,30 @@ def test_preprocess_to_training(tmp_path, monkeypatch):
     assert np.isfinite(metrics["val_F1Score"])
     tuning = (run_dir / "tuning.jsonl").read_text().strip().splitlines()
     assert json.loads(tuning[-1])["final"] is True
+
+
+def test_train_joint_cli(tmp_path, monkeypatch):
+    """scripts/train_joint.py: preprocess shards -> joint train/test through
+    the command surface (hermetic tiny model + hash tokenizer)."""
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import preprocess
+    import train_joint
+
+    preprocess.main(["--dataset", "demo", "--sample", "--workers", "1"])
+    out = train_joint.main(
+        [
+            "--dataset", "demo", "--sample", "--do_train", "--do_test",
+            "--epochs", "1", "--block_size", "24",
+            "--train_batch_size", "4", "--eval_batch_size", "4",
+        ]
+    )
+    assert out["num_missing"] == 0
+    assert "test_f1_weighted" in out and np.isfinite(out["test_loss"])
+    # no_flowgnn mode runs without shards
+    out2 = train_joint.main(
+        [
+            "--dataset", "demo", "--sample", "--do_train", "--no_flowgnn",
+            "--epochs", "1", "--block_size", "24",
+        ]
+    )
+    assert "history" in out2
