@@ -91,12 +91,11 @@ def main(argv=None) -> dict:
         from deepdfa_tpu.data.codegen import demo_corpus
 
         df = demo_corpus(60 if args.sample else 200, seed=0)
-        funcs, labels, ids = df.before.tolist(), df.vul.tolist(), df.id.tolist()
     else:
         from deepdfa_tpu.data import ingest
 
         df = ingest.ds(args.dataset, sample=args.sample)
-        funcs, labels, ids = df.before.tolist(), df.vul.tolist(), df.id.tolist()
+    funcs, labels, ids = df.before.tolist(), df.vul.tolist(), df.id.tolist()
 
     # --- model + tokenizer
     if args.hf_checkpoint:
@@ -104,10 +103,20 @@ def main(argv=None) -> dict:
 
         from deepdfa_tpu.llm.convert import load_hf_checkpoint, load_hf_config
 
-        llm_cfg = load_hf_config(args.hf_checkpoint)
+        # architecture shapes come from the HF config.json; TPU-side knobs
+        # (lora_rank, attn_impl, dtype) stay with the preset/defaults —
+        # from_hf_dict would silently zero them otherwise
+        hf_cfg = load_hf_config(args.hf_checkpoint)
+        llm_cfg = dataclasses.replace(
+            hf_cfg,
+            lora_rank=llm_cfg.lora_rank,
+            lora_alpha=llm_cfg.lora_alpha,
+            attn_impl=llm_cfg.attn_impl,
+            dtype=llm_cfg.dtype,
+        )
         tokenizer = AutoTokenizer.from_pretrained(args.hf_checkpoint)
         llm = LlamaModel(llm_cfg)
-        llm_params = load_hf_checkpoint(args.hf_checkpoint, llm_cfg)["model"]
+        llm_params = load_hf_checkpoint(args.hf_checkpoint)["model"]
     else:
         tokenizer = HashTokenizer(vocab_size=llm_cfg.vocab_size)
         llm = LlamaModel(llm_cfg)
@@ -139,7 +148,9 @@ def main(argv=None) -> dict:
             )
         join = GraphJoin.from_list(load_shards(shard_dir))
 
-    input_dim = 1002  # FeatureConfig default (limit_all 1000 + 2)
+    from deepdfa_tpu.config import FeatureConfig
+
+    input_dim = FeatureConfig().input_dim  # must match the preprocess vocab
     fusion = FusionModel(
         gnn_cfg=GGNNConfig(),
         input_dim=input_dim,

@@ -28,7 +28,8 @@ def setup(tmp_path_factory):
         FinetuneConfig(epochs=3, batch_size=4, learning_rate=5e-3),
         run_dir=tmp_path_factory.mktemp("ft"),
     )
-    return model, params, tuner, examples
+    tuned, losses = tuner.train(params, examples)  # once, shared by all tests
+    return model, params, tuner, examples, tuned, losses
 
 
 def test_lm_loss_masks_padding():
@@ -43,9 +44,7 @@ def test_lm_loss_masks_padding():
 
 
 def test_only_lora_params_move(setup):
-    model, params, tuner, examples = setup
-    tuned, losses = tuner.train(params, examples)
-    tuner._tuned = tuned  # share with the checkpoint test
+    model, params, tuner, examples, tuned, losses = setup
     assert losses[-1] < losses[0]  # memorisable corpus
     mask = lora_mask(params)
 
@@ -74,8 +73,7 @@ def test_only_lora_params_move(setup):
 
 
 def test_adapter_checkpoint_roundtrip(setup):
-    model, params, tuner, examples = setup
-    tuned = tuner._tuned
+    model, params, tuner, examples, tuned, _losses = setup
     # graft saved adapters onto FRESH params: LLM outputs must match tuned
     grafted = tuner.load_adapters(params, "adapters_epoch_2")
     out_tuned = model.apply({"params": tuned}, examples.input_ids[:2])
@@ -89,7 +87,7 @@ def test_adapter_checkpoint_roundtrip(setup):
 
 
 def test_frozen_opt_state_is_empty(setup):
-    model, params, tuner, examples = setup
+    model, params, tuner, examples, _tuned, _losses = setup
     tx = lora_optimizer(FinetuneConfig(), params, total_steps=10)
     opt_state = tx.init(params)
     # adam moments exist only for lora leaves: total optimizer leaves far
