@@ -181,6 +181,42 @@ def apply_rope(
     return out.astype(x.dtype)
 
 
+def _flash_attention(q, k, v, attn_mask):
+    """Pallas flash-attention path (``attn_impl="flash"``): blockwise
+    softmax in VMEM via the stock TPU kernel — the single-chip hot-op
+    companion to the ``sp``-sharded ring path (TPU only; the CPU test mesh
+    uses "full"/"ring"). Layout in: [b, s, h, d]; kernel wants [b, h, s, d].
+    Padding rides segment ids: pads get segment 0, real tokens 1, and the
+    kernel masks cross-segment attention — same effect as ``kv_mask``."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        SegmentIds,
+        flash_attention,
+    )
+
+    s = q.shape[1]
+    if s % 128 != 0:  # kernel block constraint; short/ragged seqs take XLA
+        return full_attention(q, k, v, causal=True, kv_mask=attn_mask)
+    h = q.shape[2]
+    k = _rep_kv(k, h // k.shape[2])
+    v = _rep_kv(v, h // v.shape[2])
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    segment_ids = None
+    if attn_mask is not None:
+        seg = attn_mask.astype(jnp.int32)
+        segment_ids = SegmentIds(q=seg, kv=seg)
+    out = flash_attention(
+        qt, kt, vt,
+        segment_ids=segment_ids,
+        causal=True,
+        sm_scale=q.shape[-1] ** -0.5,
+    )
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _rep_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    return x if n_rep == 1 else jnp.repeat(x, n_rep, axis=2)
+
+
 class Attention(nn.Module):
     cfg: LlamaConfig
     mesh: Mesh | None = None
@@ -231,6 +267,8 @@ class Attention(nn.Module):
             out = ring_attention_sharded(
                 q, k, v, self.mesh, causal=True, kv_mask=attn_mask
             )
+        elif cfg.attn_impl == "flash":
+            out = _flash_attention(q, k, v, attn_mask)
         else:
             out = full_attention(q, k, v, causal=True, kv_mask=attn_mask)
         return o_proj(out.reshape(b, s, h * d))

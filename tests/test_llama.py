@@ -205,3 +205,31 @@ def test_decode_cache_respects_left_padding():
         outs.append(np.asarray(logits)[:, 0])
     got = np.stack(outs, axis=1)
     np.testing.assert_allclose(got[mask], np.asarray(ref)[mask], atol=1e-4)
+
+
+def test_flash_attention_path():
+    """attn_impl="flash" (Pallas kernel): parity with full attention on TPU;
+    on the CPU test mesh the short-seq guard routes to full attention, so
+    here we only check the fallback keeps numerics identical."""
+    cfg_full = tiny_llama()
+    cfg_flash = tiny_llama(attn_impl="flash")
+    ids = jnp.asarray(np.random.default_rng(9).integers(3, CFG.vocab_size, (2, 16)))
+    model_full = LlamaModel(cfg_full)
+    params = model_full.init(jax.random.key(0), ids)["params"]
+    ref = model_full.apply({"params": params}, ids)
+    out = LlamaModel(cfg_flash).apply({"params": params}, ids)
+    # seq 16 < 128 -> guard takes the XLA path: bit-identical
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    if jax.default_backend() == "tpu":  # real kernel parity (seq % 128 == 0)
+        ids512 = jnp.asarray(
+            np.random.default_rng(10).integers(3, CFG.vocab_size, (2, 512))
+        )
+        mask = np.ones((2, 512), bool)
+        mask[0, :100] = False
+        ref = np.asarray(model_full.apply({"params": params}, ids512, jnp.asarray(mask)))
+        out = np.asarray(
+            LlamaModel(cfg_flash).apply({"params": params}, ids512, jnp.asarray(mask))
+        )
+        scale = np.abs(ref[mask]).max()
+        assert np.abs(out - ref)[mask].max() / scale < 0.02
