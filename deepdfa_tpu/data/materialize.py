@@ -60,6 +60,7 @@ def graph_from_cpg(
     vuln_lines: set[int] | None = None,
     graph_label: int | None = None,
     gtype: str = "cfg",
+    dataflow_labels: bool = False,
 ) -> Graph | None:
     """Build one training graph. ``feat_ids`` maps feature name →
     {node_id: int id}. Exactly one of ``vuln_lines`` (per-line labels,
@@ -90,6 +91,22 @@ def graph_from_cpg(
     feats: dict[str, np.ndarray] = {"_VULN": vuln}
     for name, ids in feat_ids.items():
         feats[name] = np.array([ids.get(n, 0) for n in nodes], dtype=np.int32)
+
+    if dataflow_labels:
+        # Per-node reaching-definitions solution bits, the DFA-learning
+        # targets (label_style=dataflow_solution_{in,out}). The reference's
+        # hooks expect [|V|] 0/1 ndata (``main_cli.py:250-254``) but this
+        # snapshot never materialises them — our solver does: 1 iff the
+        # node's IN (resp. OUT) set is non-empty.
+        from deepdfa_tpu.cpg.dataflow import ReachingDefinitions
+
+        in_sets, out_sets = ReachingDefinitions(cpg).solve()
+        feats["_DF_IN"] = np.array(
+            [1 if in_sets.get(n) else 0 for n in nodes], dtype=np.int32
+        )
+        feats["_DF_OUT"] = np.array(
+            [1 if out_sets.get(n) else 0 for n in nodes], dtype=np.int32
+        )
 
     g = Graph(senders=senders, receivers=receivers, node_feats=feats, gid=gid)
     return g.with_self_loops()
@@ -142,6 +159,7 @@ class CorpusBuilder:
         vuln_lines: Mapping[int, set[int]] | None = None,
         graph_labels: Mapping[int, int] | None = None,
         raise_all: bool = False,
+        dataflow_labels: bool = False,
     ) -> tuple[list[Graph], dict[str, Vocabulary]]:
         """Full pipeline; returns (graphs, vocabs). Graphs with no CFG are
         dropped (counted by comparing lengths)."""
@@ -164,6 +182,7 @@ class CorpusBuilder:
                 feat_ids,
                 vuln_lines=set(vuln_lines[gid]) if vuln_lines is not None else None,
                 graph_label=graph_labels[gid] if graph_labels is not None else None,
+                dataflow_labels=dataflow_labels,
             )
             if g is not None:
                 graphs.append(g)

@@ -58,6 +58,8 @@ def main(argv=None) -> dict:
     parser.add_argument("--overwrite", action="store_true")
     parser.add_argument("--limit-all", type=int, default=1000)
     parser.add_argument("--limit-subkeys", type=int, default=1000)
+    parser.add_argument("--dataflow-labels", action="store_true",
+                        help="attach _DF_IN/_DF_OUT solver-solution node labels")
     args = parser.parse_args(argv)
 
     import numpy as np
@@ -135,7 +137,8 @@ def main(argv=None) -> dict:
         FeatureConfig(limit_all=args.limit_all, limit_subkeys=args.limit_subkeys)
     )
     graphs, vocabs = builder.build(
-        cpgs, splits["train"], vuln_lines=vuln_lines, graph_labels=graph_labels
+        cpgs, splits["train"], vuln_lines=vuln_lines, graph_labels=graph_labels,
+        dataflow_labels=args.dataflow_labels,
     )
     n_shards = save_shards(graphs, out_dir)
     (out_dir / "splits.json").write_text(json.dumps(splits))

@@ -110,3 +110,45 @@ def test_train_joint_cli(tmp_path, monkeypatch):
         ]
     )
     assert "history" in out2
+
+
+def test_dataflow_label_training(tmp_path, monkeypatch):
+    """The 'learn the DFA' loop: solver-solution labels materialise and the
+    GGNN trains on label_style=dataflow_solution_out (the reference snapshot
+    carries only dormant hooks for this — no label producer)."""
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import preprocess
+
+    summary = preprocess.main(
+        ["--dataset", "demo", "--n", "40", "--workers", "1", "--dataflow-labels"]
+    )
+    assert summary["status"] == "ok"
+
+    from deepdfa_tpu.config import load_config
+    from deepdfa_tpu.data.graphs import load_shards
+    from deepdfa_tpu.train import cli
+
+    graphs = load_shards(summary["out"])
+    g = graphs[0]
+    assert set(g.node_feats) >= {"_DF_IN", "_DF_OUT"}
+    assert set(np.unique(g.node_feats["_DF_OUT"])) <= {0, 1}
+    # defs generate: any graph with definitions has nonzero OUT bits
+    assert any(gr.node_feats["_DF_OUT"].max() > 0 for gr in graphs)
+
+    cfg = load_config(
+        overrides={
+            "data.dsname": "demo",
+            "data.undersample": None,
+            "model.label_style": "dataflow_solution_out",
+            "optim.max_epochs": 2,
+            "model.hidden_dim": 8,
+            "model.n_steps": 2,
+            "data.batch.batch_graphs": 64,
+            "data.batch.max_nodes": 4096,
+            "data.batch.max_edges": 8192,
+        }
+    )
+    run_dir = tmp_path / "dfrun"
+    run_dir.mkdir()
+    metrics = cli.fit(cfg, run_dir)
+    assert np.isfinite(metrics["val_F1Score"])
