@@ -97,6 +97,9 @@ class GGNNConfig:
     label_style: str = "graph"  # graph | node | dataflow_solution_in | dataflow_solution_out
     concat_all_absdf: bool = True
     encoder_mode: bool = False
+    # message aggregation: sum (DGL parity) | union_simple | union_relu
+    # (the differentiable DFA-lattice aggregators, ``clipper.py:50-77``)
+    aggregation: str = "sum"
     dtype: str = "float32"  # compute dtype; bfloat16 for TPU speed runs
 
     @property

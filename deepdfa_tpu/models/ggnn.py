@@ -56,14 +56,22 @@ class GRUCell(nn.Module):
 
 
 class GatedGraphConv(nn.Module):
-    """n_steps of (linear → gather(senders) → segment_sum(receivers) → GRU).
+    """n_steps of (linear → gather(senders) → aggregate(receivers) → GRU).
 
     Self-loop edges are expected in the data (added at materialisation time,
     parity with ``dbize_graphs.py:26``).
+
+    ``aggregation``: ``"sum"`` (DGL ``GatedGraphConv`` parity) or the
+    differentiable set unions ``"union_simple"``/``"union_relu"`` — the
+    "learn the DFA lattice" aggregators (``clipper.py:50-77``; mailbox fold
+    replaced by closed-form segment ops, ``ops/union.py``). Union
+    aggregation treats messages as soft membership bits, matching the
+    reaching-definitions meet operator ∪.
     """
 
     out_feats: int
     n_steps: int
+    aggregation: str = "sum"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -78,11 +86,29 @@ class GatedGraphConv(nn.Module):
             h = jnp.concatenate([h, pad], axis=-1)
         edge_linear = nn.Dense(self.out_feats, dtype=self.dtype, name="edge_linear")
         gru = GRUCell(self.out_feats, dtype=self.dtype, name="gru")
+        if self.aggregation not in ("sum", "union_simple", "union_relu"):
+            raise ValueError(f"unknown aggregation {self.aggregation!r}")
+        if self.aggregation != "sum":
+            from deepdfa_tpu.ops.union import segment_union_relu, segment_union_simple
+
+            union = (
+                segment_union_simple
+                if self.aggregation == "union_simple"
+                else segment_union_relu
+            )
         # Python loop, unrolled by trace: n_steps is small (5) and static;
         # unrolling lets XLA pipeline the matmuls instead of a lax.scan barrier.
         for _ in range(self.n_steps):
             msg_src = edge_linear(h)
-            agg = segment_sum(gather(msg_src, senders), receivers, n_nodes)
+            if self.aggregation == "sum":
+                agg = segment_sum(gather(msg_src, senders), receivers, n_nodes)
+            else:
+                # union space is [0,1] soft membership; zero own-state makes
+                # the fold a pure mailbox union (the reference's DGL reduce
+                # aggregates incoming messages only — self-loops already
+                # carry the node's own message)
+                msgs = nn.sigmoid(msg_src)
+                agg = union(jnp.zeros_like(h), msgs, senders, receivers)
             h = gru(agg, h)
         return h
 
@@ -136,7 +162,10 @@ class GGNN(nn.Module):
             )
             hidden_dim = cfg.hidden_dim
         self.ggnn = GatedGraphConv(
-            out_feats=hidden_dim, n_steps=cfg.n_steps, dtype=self.compute_dtype
+            out_feats=hidden_dim,
+            n_steps=cfg.n_steps,
+            aggregation=cfg.aggregation,
+            dtype=self.compute_dtype,
         )
         out_in = embed_dim + hidden_dim
         if cfg.label_style == "graph":
