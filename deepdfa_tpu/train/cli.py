@@ -225,6 +225,9 @@ def test(
     neg = M.ConfusionState.zeros()
     all_probs, all_labels = [], []
     losses, wsums = [], []
+    # node-style runs additionally rank statements per function (IVDetect
+    # top-k protocol, ``helpers/evaluate.py:262-322``)
+    statement_items: list[tuple[np.ndarray, np.ndarray]] = []
 
     profiler = None
     flops = None
@@ -258,6 +261,13 @@ def test(
         keep = np.asarray(weights) > 0
         all_probs.append(np.asarray(probs)[keep])
         all_labels.append(np.asarray(labels)[keep])
+        if cfg.model.label_style == "node":
+            gidx = np.asarray(batch.node_gidx)
+            p_np, l_np, k_np = np.asarray(probs), np.asarray(labels), keep
+            for gi in range(int(np.asarray(batch.graph_mask).sum())):
+                sel = (gidx == gi) & k_np
+                if sel.any():
+                    statement_items.append((p_np[sel], l_np[sel].astype(int)))
 
     probs = np.concatenate(all_probs)
     labels = np.concatenate(all_labels)
@@ -266,6 +276,11 @@ def test(
     results |= M.compute_metrics(pos, "test_pos_")
     results |= M.compute_metrics(neg, "test_neg_")
     results |= {f"report_{k}": v for k, v in M.classification_report(probs, labels).items()}
+    if statement_items:
+        topk = M.eval_statements_list(statement_items)
+        results |= {f"statement_hit@{k}": v for k, v in topk.items()}
+        logger.info("statement top-k hit rates: %s",
+                    {k: round(v, 4) for k, v in topk.items()})
 
     import pandas as pd
 

@@ -96,3 +96,14 @@ def test_crash_renames_log(storage, tmp_path, monkeypatch):
         cli.main(["fit", "--run-dir", str(run_dir), *SMALL])
     assert (run_dir / "run.log.error").exists()
     assert not (run_dir / "run.log").exists()
+
+
+def test_node_style_statement_ranking(storage, tmp_path):
+    """label_style=node test runs emit IVDetect top-k statement hit rates."""
+    run_dir = tmp_path / "noderun"
+    run_dir.mkdir()
+    overrides = [*SMALL, "--set", "model.label_style=node"]
+    cli.main(["fit", "--run-dir", str(run_dir), *overrides])
+    out = cli.main(["test", "--run-dir", str(run_dir), *overrides])
+    assert "statement_hit@1" in out and "statement_hit@10" in out
+    assert 0.0 <= out["statement_hit@1"] <= out["statement_hit@10"] <= 1.0
