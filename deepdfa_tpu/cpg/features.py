@@ -270,7 +270,7 @@ def add_dependence_edges(cpg: CPG) -> CPG:
     from deepdfa_tpu.cpg.dataflow import ReachingDefinitions
 
     rd = ReachingDefinitions(cpg)
-    in_sets, _ = rd.solve()
+    in_sets, out_sets = rd.solve()
     new_edges: list[tuple[int, int, str]] = list(cpg.edges)
 
     # --- data dependence. Definitions are matched *textually* (the solver's
@@ -341,4 +341,8 @@ def add_dependence_edges(cpg: CPG) -> CPG:
         if e not in seen:
             seen.add(e)
             deduped.append(e)
-    return CPG(list(cpg.nodes.values()), deduped)
+    out = CPG(list(cpg.nodes.values()), deduped)
+    # cache the fixpoint so downstream label materialisation
+    # (graph_from_cpg(dataflow_labels=True)) doesn't re-solve the same CPG
+    out.rd_solution = (in_sets, out_sets)
+    return out

@@ -97,10 +97,15 @@ def graph_from_cpg(
         # targets (label_style=dataflow_solution_{in,out}). The reference's
         # hooks expect [|V|] 0/1 ndata (``main_cli.py:250-254``) but this
         # snapshot never materialises them — our solver does: 1 iff the
-        # node's IN (resp. OUT) set is non-empty.
-        from deepdfa_tpu.cpg.dataflow import ReachingDefinitions
+        # node's IN (resp. OUT) set is non-empty. ``add_dependence_edges``
+        # caches its fixpoint on the CPG; only un-augmented graphs re-solve.
+        cached = getattr(cpg, "rd_solution", None)
+        if cached is not None:
+            in_sets, out_sets = cached
+        else:
+            from deepdfa_tpu.cpg.dataflow import ReachingDefinitions
 
-        in_sets, out_sets = ReachingDefinitions(cpg).solve()
+            in_sets, out_sets = ReachingDefinitions(cpg).solve()
         feats["_DF_IN"] = np.array(
             [1 if in_sets.get(n) else 0 for n in nodes], dtype=np.int32
         )

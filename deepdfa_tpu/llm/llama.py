@@ -196,9 +196,11 @@ def _flash_attention(q, k, v, attn_mask):
     s = q.shape[1]
     if s % 128 != 0:  # kernel block constraint; short/ragged seqs take XLA
         return full_attention(q, k, v, causal=True, kv_mask=attn_mask)
+    from deepdfa_tpu.ops.ring_attention import _repeat_kv
+
     h = q.shape[2]
-    k = _rep_kv(k, h // k.shape[2])
-    v = _rep_kv(v, h // v.shape[2])
+    k = _repeat_kv(k, h // k.shape[2])
+    v = _repeat_kv(v, h // v.shape[2])
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     segment_ids = None
     if attn_mask is not None:
@@ -211,10 +213,6 @@ def _flash_attention(q, k, v, attn_mask):
         sm_scale=q.shape[-1] ** -0.5,
     )
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
-
-
-def _rep_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
-    return x if n_rep == 1 else jnp.repeat(x, n_rep, axis=2)
 
 
 class Attention(nn.Module):

@@ -103,12 +103,14 @@ class GatedGraphConv(nn.Module):
             if self.aggregation == "sum":
                 agg = segment_sum(gather(msg_src, senders), receivers, n_nodes)
             else:
-                # union space is [0,1] soft membership; zero own-state makes
-                # the fold a pure mailbox union (the reference's DGL reduce
-                # aggregates incoming messages only — self-loops already
-                # carry the node's own message)
+                # union space is [0,1] soft membership: messages AND the
+                # node's own state map through sigmoid (the reference fold
+                # starts from ``nodes.data["h"]``, clipper.py:70-73, with h
+                # living in bit space in its experiments; sigmoid keeps the
+                # union algebra valid for our unconstrained GRU state and
+                # matches exactly at saturation)
                 msgs = nn.sigmoid(msg_src)
-                agg = union(jnp.zeros_like(h), msgs, senders, receivers)
+                agg = union(nn.sigmoid(h), msgs, senders, receivers)
             h = gru(agg, h)
         return h
 

@@ -264,7 +264,7 @@ def test(
         if cfg.model.label_style == "node":
             gidx = np.asarray(batch.node_gidx)
             p_np, l_np, k_np = np.asarray(probs), np.asarray(labels), keep
-            for gi in range(int(np.asarray(batch.graph_mask).sum())):
+            for gi in range(n_real):
                 sel = (gidx == gi) & k_np
                 if sel.any():
                     statement_items.append((p_np[sel], l_np[sel].astype(int)))
