@@ -267,10 +267,20 @@ def add_dependence_edges(cpg: CPG) -> CPG:
 
     Returns a new CPG sharing node objects; existing edges are preserved.
     """
-    from deepdfa_tpu.cpg.dataflow import ReachingDefinitions
+    from deepdfa_tpu.cpg.dataflow import ReachingDefinitions, solve_bitvec, solve_native
 
     rd = ReachingDefinitions(cpg)
-    in_sets, out_sets = rd.solve()
+    # Throughput path: C++ worklist (falls back to the NumPy bit-matrix
+    # solver if the native lib can't build); both are parity-tested against
+    # the Python set solver. They return def-node ids — map back to
+    # VariableDefinitions for the var-name matching below.
+    try:
+        in_ids, out_ids = solve_native(rd)
+    except Exception:  # noqa: BLE001 — toolchain-less hosts
+        in_ids, out_ids = solve_bitvec(rd)
+    def_by_node = {d.node: d for defs in rd.gen.values() for d in defs}
+    in_sets = {n: {def_by_node[i] for i in s} for n, s in in_ids.items()}
+    out_sets = {n: {def_by_node[i] for i in s} for n, s in out_ids.items()}
     new_edges: list[tuple[int, int, str]] = list(cpg.edges)
 
     # --- data dependence. Definitions are matched *textually* (the solver's
