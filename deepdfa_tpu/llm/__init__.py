@@ -13,3 +13,19 @@ from deepdfa_tpu.llm.llama import (  # noqa: F401
     LlamaModel,
     LlamaForCausalLM,
 )
+
+__all__ = [
+    "LlamaConfig",
+    "LlamaModel",
+    "LlamaForCausalLM",
+    # submodules (imported lazily by callers):
+    # convert  — HF checkpoint conversion
+    # lora     — adapters, mask/split/merge
+    # finetune — LoRA causal-LM tuning stage
+    # quant    — int8 weight storage
+    # dataset  — text examples + graph index-join
+    # fusion   — classification heads over LLM ⊕ GGNN
+    # joint    — frozen-LLM joint trainer
+    # generate — batch decoding
+    # presets  — the five launch configurations
+]
