@@ -197,6 +197,10 @@ class ExperimentConfig:
     run_name: str | None = None
     profile: bool = False
     time: bool = False
+    # capture a jax.profiler device trace during test (view with
+    # tensorboard/xprof) — the TPU analogue of the reference's torch CUDA
+    # event + DeepSpeed profiling pair (SURVEY.md §5)
+    trace: bool = False
 
     @property
     def input_dim(self) -> int:

@@ -110,10 +110,12 @@ def weight_decay_mask(params: Any) -> Any:
 def cosine_warmup_schedule(lr: float, warmup_steps: int, total_steps: int):
     """HF ``get_cosine_schedule_with_warmup`` parity: linear 0→lr over
     ``warmup_steps``, cosine lr→0 over the rest."""
+    warmup_steps = max(warmup_steps, 1)
     return optax.warmup_cosine_decay_schedule(
         init_value=0.0,
         peak_value=lr,
-        warmup_steps=max(warmup_steps, 1),
+        warmup_steps=warmup_steps,
+        # decay_steps includes warmup; the cosine segment must be non-empty
         decay_steps=max(total_steps, warmup_steps + 1),
         end_value=0.0,
     )

@@ -241,6 +241,8 @@ def test(
     # semantics, one compile
     eval_step = trainer.eval_step
 
+    if cfg.trace:
+        jax.profiler.start_trace(str(run_dir / "trace"))
     for batch in batcher.batches(test_graphs):
         batch = jax.tree.map(jnp.asarray, batch)
         n_real = int(np.asarray(batch.graph_mask).sum())
@@ -268,6 +270,10 @@ def test(
                 sel = (gidx == gi) & k_np
                 if sel.any():
                     statement_items.append((p_np[sel], l_np[sel].astype(int)))
+
+    if cfg.trace:
+        jax.profiler.stop_trace()
+        logger.info("device trace written to %s", run_dir / "trace")
 
     probs = np.concatenate(all_probs)
     labels = np.concatenate(all_labels)

@@ -37,15 +37,41 @@ sys.path.insert(0, str(REPO))
 
 
 def _extract_one(item: dict) -> tuple[int, object, str | None]:
-    """(id, CPG|None, error) — module-level so process pools can pickle it."""
+    """(id, CPG|None, error) — module-level so process pools can pickle it.
+
+    Per-function resume (``getgraphs.py:47-54`` idempotence parity): when the
+    item carries a ``_cache_dir``, the augmented CPG is pickled under a
+    content-addressed name and reused on re-runs; writes go through a
+    temp-file rename so parallel workers never see partial pickles."""
+    import hashlib
+    import os
+    import pickle
+
     from deepdfa_tpu.cpg.features import add_dependence_edges
     from deepdfa_tpu.cpg.frontend import parse_source
 
     fid, code = item["id"], item["before"]
+    cache_dir = item.get("_cache_dir")
+    cache_path = None
+    if cache_dir:
+        digest = hashlib.sha1(str(code).encode()).hexdigest()[:16]
+        cache_path = Path(cache_dir) / f"{fid}_{digest}.pkl"
+        if cache_path.exists():
+            try:
+                with open(cache_path, "rb") as f:
+                    return fid, pickle.load(f), None
+            except Exception:  # noqa: BLE001 — corrupt cache entry: re-extract
+                pass
     try:
-        return fid, add_dependence_edges(parse_source(code)), None
+        cpg = add_dependence_edges(parse_source(code))
     except Exception as exc:  # noqa: BLE001 — failure-file protocol
         return fid, None, f"{fid}\t{type(exc).__name__}: {exc}"
+    if cache_path is not None:
+        tmp = cache_path.with_suffix(f".tmp{os.getpid()}")
+        with open(tmp, "wb") as f:
+            pickle.dump(cpg, f)
+        tmp.rename(cache_path)
+    return fid, cpg, None
 
 
 def main(argv=None) -> dict:
@@ -60,6 +86,8 @@ def main(argv=None) -> dict:
     parser.add_argument("--limit-subkeys", type=int, default=1000)
     parser.add_argument("--dataflow-labels", action="store_true",
                         help="attach _DF_IN/_DF_OUT solver-solution node labels")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the per-function CPG extraction cache")
     args = parser.parse_args(argv)
 
     import numpy as np
@@ -89,8 +117,12 @@ def main(argv=None) -> dict:
         df = ingest.ds(args.dataset, sample=args.sample)
         graph_level = args.dataset == "devign"
 
-    # 2. extract CPGs (parallel, with the failure-file protocol)
+    # 2. extract CPGs (parallel, with the failure-file protocol; per-function
+    # pickle cache makes interrupted runs resume where they stopped)
     records = df.to_dict("records")
+    if not args.no_cache:
+        cache = utils.get_dir(utils.cache_dir() / "cpg_cache" / args.dataset)
+        df = df.assign(_cache_dir=str(cache))
     results = utils.dfmp(df, _extract_one, workers=args.workers, desc="extract")
     cpgs, failures = {}, []
     for fid, cpg, err in results:

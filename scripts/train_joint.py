@@ -56,7 +56,12 @@ def main(argv=None) -> dict:
     from deepdfa_tpu import utils
     from deepdfa_tpu.config import GGNNConfig
     from deepdfa_tpu.data.graphs import load_shards
-    from deepdfa_tpu.llm.dataset import GraphJoin, HashTokenizer, encode_functions
+    from deepdfa_tpu.llm.dataset import (
+        GraphJoin,
+        HashTokenizer,
+        encode_functions,
+        text_batches,
+    )
     from deepdfa_tpu.llm.fusion import FusionModel
     from deepdfa_tpu.llm.joint import JointConfig, JointTrainer
     from deepdfa_tpu.llm.llama import LlamaModel, tiny_llama
@@ -173,9 +178,24 @@ def main(argv=None) -> dict:
         out["history"] = trainer.history[-3:]
         out["num_missing"] = trainer.num_missing
     if args.do_test:
-        params = state.params if state is not None else None
-        if params is None:
-            raise SystemExit("--do_test without --do_train needs a checkpoint (todo)")
+        if state is not None:
+            params = state.params
+        else:
+            # test-only run: restore the newest epoch checkpoint
+            # (``--load_checkpoint`` parity, train.py:221-224)
+            epochs_saved = sorted(
+                Path(args.output_dir or run_dir).glob("epoch_*"),
+                key=lambda p: int(p.name.split("_")[1]),
+            )
+            if not epochs_saved:
+                raise SystemExit(
+                    f"--do_test without --do_train needs an epoch_* checkpoint "
+                    f"under {run_dir}"
+                )
+            # build the param template by tracing one batch, then load
+            first = trainer._joined(next(text_batches(test_ex, jcfg.eval_batch_size)))
+            template = trainer._build(1, first).params
+            params = trainer.load(template, epochs_saved[-1].name)
         out |= trainer.test(params, test_ex)
     print(json.dumps(out, default=float))
     return out

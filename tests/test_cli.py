@@ -107,3 +107,13 @@ def test_node_style_statement_ranking(storage, tmp_path):
     out = cli.main(["test", "--run-dir", str(run_dir), *overrides])
     assert "statement_hit@1" in out and "statement_hit@10" in out
     assert 0.0 <= out["statement_hit@1"] <= out["statement_hit@10"] <= 1.0
+
+
+def test_trace_capture(storage, tmp_path):
+    """--set trace=true writes a jax.profiler device trace during test."""
+    run_dir = tmp_path / "tracerun"
+    run_dir.mkdir()
+    cli.main(["fit", "--run-dir", str(run_dir), *SMALL])
+    cli.main(["test", "--run-dir", str(run_dir), *SMALL, "--set", "trace=true"])
+    trace_dir = run_dir / "trace"
+    assert trace_dir.exists() and any(trace_dir.rglob("*"))

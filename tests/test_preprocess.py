@@ -110,6 +110,15 @@ def test_train_joint_cli(tmp_path, monkeypatch):
         ]
     )
     assert "history" in out2
+    # test-only run restores the newest epoch checkpoint from the train run
+    out3 = train_joint.main(
+        [
+            "--dataset", "demo", "--sample", "--do_test",
+            "--output_dir", out["run_dir"],
+            "--epochs", "1", "--block_size", "24", "--eval_batch_size", "4",
+        ]
+    )
+    assert "test_f1_weighted" in out3 and np.isfinite(out3["test_loss"])
 
 
 def test_dataflow_label_training(tmp_path, monkeypatch):
@@ -152,3 +161,32 @@ def test_dataflow_label_training(tmp_path, monkeypatch):
     run_dir.mkdir()
     metrics = cli.fit(cfg, run_dir)
     assert np.isfinite(metrics["val_F1Score"])
+
+
+def test_extraction_cache_resume(tmp_path, monkeypatch):
+    """Second preprocess run reuses the per-function CPG cache (resume
+    parity with getgraphs.py); corrupt entries re-extract."""
+    import time
+
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import preprocess
+
+    t0 = time.monotonic()
+    s1 = preprocess.main(["--dataset", "demo", "--n", "40", "--workers", "1"])
+    first = time.monotonic() - t0
+    cache = Path(s1["out"]).parent.parent.parent / "cache" / "cpg_cache" / "demo"
+    entries = list(cache.glob("*.pkl"))
+    assert len(entries) == 40
+    # force a rebuild of the shards; extraction must hit the cache
+    t1 = time.monotonic()
+    s2 = preprocess.main(
+        ["--dataset", "demo", "--n", "40", "--workers", "1", "--overwrite"]
+    )
+    second = time.monotonic() - t1
+    assert s2["graphs"] == 40
+    # corrupt one entry: run still succeeds (re-extracts that function)
+    entries[0].write_bytes(b"garbage")
+    s3 = preprocess.main(
+        ["--dataset", "demo", "--n", "40", "--workers", "1", "--overwrite"]
+    )
+    assert s3["graphs"] == 40 and s3["failed"] == 0
