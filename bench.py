@@ -1,4 +1,4 @@
-"""Benchmark: flagship GGNN throughput on the local accelerator.
+"""Benchmark: flagship GGNN throughput on the local accelerator — self-validating.
 
 Prints ONE JSON line:
 ``{"metric": ..., "value": N, "unit": "graphs/sec", "vs_baseline": N, ...}``.
@@ -6,14 +6,29 @@ Prints ONE JSON line:
 Headline metric: **GGNN inference graphs/sec** at the reference's golden
 config (hidden 32, 5 steps, concat_all_absdf, batch 256 graphs) on Big-Vul-
 shaped synthetic batches (mean ~50 CFG nodes/function; the real corpus needs
-a network download the bench environment doesn't have).
+a network download the bench environment doesn't have). Bucket budgets are
+derived from the corpus (``data/graphs.derive_buckets``) so the number is
+quoted on real graphs, not padding — ``padding_efficiency`` is reported.
+
+Every throughput number self-validates against physics, in-process:
+
+- ``flops_per_step`` comes from the compiled step's ``cost_analysis()``;
+- ``roofline_tflops`` is a chained bf16 matmul measured in the same process
+  (the MXU ceiling actually reachable right now, tunnel and all);
+- each metric's implied FLOP/s must be ≤ the roofline or the metric is
+  REFUSED (reported as null with the reason in ``refused``). A throughput
+  that beats the hardware ceiling is a timing artifact, not throughput.
+
+Timing is strict: per-step ``block_until_ready``, median of k. A pipelined
+(dispatch-all, sync-once) rate is reported as a secondary field only —
+through a tunneled device its sync semantics are not trustworthy.
 
 ``vs_baseline``: ratio against a **same-semantics torch-CPU implementation**
 (``deepdfa_tpu/compat/torch_ref.py``) measured in-process. The reference's own
 GPU harness (DGL + CUDA events, ``base_module.py:246-281``) cannot run here —
-no CUDA and no DGL wheel — so this is the honest, reproducible stand-in;
-BASELINE.md records the protocol. Training throughput is also measured and
-reported as an extra field.
+no CUDA and no DGL wheel. ``est_vs_a100`` derives the north-star ratio
+(BASELINE.json: ≥8× vs 1×A100) as measured graphs/sec ÷ (A100 bf16 peak ×
+assumed MFU ÷ FLOPs/graph); the assumption is printed alongside.
 """
 
 from __future__ import annotations
@@ -24,37 +39,124 @@ import time
 
 import numpy as np
 
+A100_BF16_PEAK_TFLOPS = 312.0
+A100_ASSUMED_MFU = 0.40  # generous to the baseline: real GNN MFU on GPU is far lower
+
 
 def build_batches(n_batches: int, input_dim: int, batch_graphs: int = 256):
-    from deepdfa_tpu.config import BatchConfig
-    from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+    """Corpus-derived buckets; keep only batches of the main (largest) bucket
+    shape so one compiled shape is timed at near-full occupancy."""
+    from deepdfa_tpu.data.graphs import GraphBatcher, derive_buckets, padding_efficiency
     from deepdfa_tpu.data.synthetic import random_dataset
 
-    bc = BatchConfig()
-    scale = max(batch_graphs // bc.batch_graphs, 1)  # keep node/edge headroom
-    bucket = BucketSpec(batch_graphs + 1, bc.max_nodes * scale, bc.max_edges * scale)
-    graphs = random_dataset(n_batches * batch_graphs, seed=0, input_dim=input_dim)
-    batcher = GraphBatcher([bucket])
+    graphs = random_dataset(int(n_batches * batch_graphs * 1.5), seed=0, input_dim=input_dim)
+    buckets = derive_buckets(graphs, batch_graphs)
+    main = buckets[-1]
+    batcher = GraphBatcher(buckets)
     batches = []
     for b in batcher.batches(graphs):
-        if int(b.graph_mask.sum()) == batch_graphs:  # keep full batches only
+        if b.max_nodes == main.max_nodes:
             batches.append(b)
         if len(batches) == n_batches:
             break
     if not batches:
-        raise RuntimeError("no full batches produced; lower batch_graphs or raise budgets")
-    return batches
+        raise RuntimeError("no main-bucket batches produced; corpus too small")
+    return batches, padding_efficiency(batches)
+
+
+def _sync(x) -> float:
+    """Hard synchronisation: read a value back to the host. Through the
+    experimental device tunnel ``block_until_ready`` has been observed to
+    return before compute completes (round-1 verdict recorded a 3.7×-over-
+    ceiling 'throughput' from exactly that); an actual device→host readback
+    of the result cannot lie."""
+    import jax
+
+    leaf = jax.tree.leaves(x)[0]
+    return float(np.asarray(leaf).ravel()[0])
+
+
+def _timed(run_once, steps: int):
+    """Strict per-step readback-sync timing. Returns (median_s, pipelined_s).
+
+    ``run_once`` must return a SMALL array/scalar whose value depends on the
+    whole computation; each timed step transfers it to the host."""
+    import jax
+
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        _sync(run_once())
+        times.append(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(steps):
+        out = run_once()
+    _sync(out)
+    pipelined = (time.perf_counter() - t0) / steps
+    return float(np.median(times)), pipelined
+
+
+def _cost_flops(jitted, *args) -> float | None:
+    """FLOPs of the compiled computation via XLA's cost analysis."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca["flops"])
+    except Exception:
+        return None
+
+
+def measure_roofline(n_chain: int | None = None, dim: int | None = None,
+                     trials: int = 5) -> float:
+    """Best-case bf16 matmul FLOP/s reachable in this process right now:
+    ``n_chain`` dependent dim³ matmuls inside one jit (amortises dispatch),
+    strict sync, best of ``trials``. This is the ceiling every reported
+    throughput is checked against."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if dim is None or n_chain is None:
+        on_cpu = jax.default_backend() == "cpu"
+        dim = dim or (512 if on_cpu else 4096)
+        n_chain = n_chain or (8 if on_cpu else 64)
+
+    x = (jnp.ones((dim, dim), jnp.bfloat16) * 1e-2)
+    w = jax.random.normal(jax.random.key(0), (dim, dim), jnp.bfloat16) * (dim ** -0.5)
+
+    @jax.jit
+    def chain(x, w):
+        acc = lax.fori_loop(
+            0, n_chain,
+            lambda i, acc: jnp.dot(acc, w, preferred_element_type=jnp.bfloat16),
+            x,
+        )
+        return jnp.sum(acc.astype(jnp.float32))  # scalar out → cheap readback sync
+
+    _sync(chain(x, w))  # compile + warm
+    best = min(_time_once(lambda: _sync(chain(x, w))) for _ in range(trials))
+    return 2.0 * dim ** 3 * n_chain / best
+
+
+def _time_once(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
 
 
 def bench_jax(batches, steps: int, train: bool, dtype: str = "bfloat16"):
     """bf16 compute by default — the TPU-idiomatic precision (MXU-native;
     training still converges, see tests/test_preprocess.py's pipeline at
-    model.dtype=bfloat16). The reference runs fp32 on GPU."""
+    model.dtype=bfloat16). The reference runs fp32 on GPU.
+
+    Returns ``{graphs_per_sec, pipelined_graphs_per_sec, flops_per_step,
+    step_ms}`` with graphs/sec quoted on REAL (mask-counted) graphs."""
     import dataclasses
 
     import jax
     import jax.numpy as jnp
-    import optax
 
     from deepdfa_tpu.config import ExperimentConfig
     from deepdfa_tpu.models.ggnn import GGNN
@@ -67,32 +169,46 @@ def bench_jax(batches, steps: int, train: bool, dtype: str = "bfloat16"):
     dev_batches = [jax.tree.map(jnp.asarray, b) for b in batches]
     trainer = Trainer(model=model, cfg=cfg, pos_weight=15.0)
     state = trainer.init_state(dev_batches[0])
+    real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
 
     if train:
         step = trainer.train_step
         metrics = ConfusionState.zeros()
         state, metrics, loss, w = step(state, dev_batches[0], metrics)  # compile
         jax.block_until_ready(loss)
-        t0 = time.perf_counter()
-        for i in range(steps):
-            state, metrics, loss, w = step(state, dev_batches[i % len(dev_batches)], metrics)
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
+        flops = _cost_flops(step, state, dev_batches[0], metrics)
+        box = {"state": state, "metrics": metrics, "i": 0}
+
+        def run_once():
+            b = dev_batches[box["i"] % len(dev_batches)]
+            box["i"] += 1
+            box["state"], box["metrics"], loss, _ = step(box["state"], b, box["metrics"])
+            return loss
+
+        median_s, pipelined_s = _timed(run_once, steps)
     else:
         fwd = jax.jit(lambda p, b: model.apply({"params": p}, b))
-        out = fwd(state.params, dev_batches[0])
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for i in range(steps):
-            out = fwd(state.params, dev_batches[i % len(dev_batches)])
-        jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
-    graphs_per_batch = int(batches[0].graph_mask.sum())
-    return steps * graphs_per_batch / dt
+        jax.block_until_ready(fwd(state.params, dev_batches[0]))  # compile
+        flops = _cost_flops(fwd, state.params, dev_batches[0])
+        box = {"i": 0}
+
+        def run_once():
+            b = dev_batches[box["i"] % len(dev_batches)]
+            box["i"] += 1
+            return fwd(state.params, b)
+
+        median_s, pipelined_s = _timed(run_once, steps)
+
+    return {
+        "graphs_per_sec": real_graphs / median_s,
+        "pipelined_graphs_per_sec": real_graphs / pipelined_s,
+        "flops_per_step": flops,
+        "step_ms": median_s * 1e3,
+    }
 
 
 def bench_torch_cpu(batches, steps: int):
-    """Same-semantics torch-CPU inference baseline."""
+    """Same-semantics torch-CPU inference baseline (real graphs/sec)."""
     import torch
 
     from deepdfa_tpu.compat.torch_ref import TorchGGNN
@@ -125,51 +241,108 @@ def bench_torch_cpu(batches, steps: int):
         for i in range(steps):
             model(*prepped[i % len(prepped)])
         dt = time.perf_counter() - t0
-    return steps * prepped[0][4] / dt
+    mean_graphs = float(np.mean([p[4] for p in prepped]))
+    return steps * mean_graphs / dt
+
+
+def _validate(name: str, graphs_per_sec, flops_per_step, real_graphs, roofline, refused):
+    """Refuse any throughput whose implied FLOP/s exceeds the measured
+    roofline — it is a timing artifact, not throughput."""
+    if graphs_per_sec is None:
+        return None
+    if flops_per_step and roofline:
+        implied = graphs_per_sec / real_graphs * flops_per_step
+        if implied > roofline:
+            refused[name] = (
+                f"implied {implied / 1e12:.1f} TFLOP/s > measured roofline "
+                f"{roofline / 1e12:.1f} TFLOP/s"
+            )
+            return None
+    return round(graphs_per_sec, 1)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30)
-    ap.add_argument("--baseline-steps", type=int, default=5)
+    ap.add_argument("--baseline-steps", type=int, default=20)
     ap.add_argument("--batches", type=int, default=4)
     ap.add_argument("--skip-baseline", action="store_true")
     args = ap.parse_args()
 
     from deepdfa_tpu.config import FeatureConfig
 
-    batches = build_batches(args.batches, FeatureConfig().input_dim)
+    batches, occupancy = build_batches(args.batches, FeatureConfig().input_dim)
+    real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
 
     import jax
 
     backend = jax.default_backend()
-    infer_gps = bench_jax(batches, args.steps, train=False)
-    train_gps = bench_jax(batches, max(args.steps // 2, 5), train=True)
+    roofline = measure_roofline()
+    infer = bench_jax(batches, args.steps, train=False)
+    train = bench_jax(batches, max(args.steps // 2, 5), train=True)
 
     # Peak throughput at batch 1024: same model, larger static batch —
     # amortises per-dispatch host↔device latency (big on tunneled TPUs).
     try:
-        peak_batches = build_batches(2, FeatureConfig().input_dim, batch_graphs=1024)
-        peak_gps = bench_jax(peak_batches, args.steps, train=False)
-    except RuntimeError:
-        peak_gps = None
+        peak_batches, _ = build_batches(2, FeatureConfig().input_dim, batch_graphs=1024)
+        peak = bench_jax(peak_batches, args.steps, train=False)
+        peak_real = float(np.mean([int(b.graph_mask.sum()) for b in peak_batches]))
+    except (RuntimeError, ValueError):
+        peak, peak_real = None, 1.0
 
-    if args.skip_baseline:
-        base_gps = None
-    else:
-        base_gps = bench_torch_cpu(batches, args.baseline_steps)
+    base_gps = None if args.skip_baseline else bench_torch_cpu(batches, args.baseline_steps)
+
+    refused: dict[str, str] = {}
+    infer_gps = _validate("value", infer["graphs_per_sec"], infer["flops_per_step"],
+                          real_graphs, roofline, refused)
+    train_gps = _validate("train_graphs_per_sec", train["graphs_per_sec"],
+                          train["flops_per_step"], real_graphs, roofline, refused)
+    peak_gps = None
+    if peak is not None:
+        peak_gps = _validate("peak_batch1024_graphs_per_sec", peak["graphs_per_sec"],
+                             peak["flops_per_step"], peak_real, roofline, refused)
+
+    flops_per_graph = (infer["flops_per_step"] or 0.0) / real_graphs
+    # a refused headline must not fabricate implied/MFU numbers — keep null
+    implied_tflops = (
+        infer_gps * flops_per_graph / 1e12 if infer_gps is not None else None
+    )
+    # North-star bound: what 1×A100 would do on the same model at a generous
+    # MFU. The A100/DGL reference runs ragged batches, paying only real-graph
+    # FLOPs — so its per-graph cost excludes our padding share.
+    real_flops_per_graph = flops_per_graph * occupancy["nodes"]
+    a100_est_gps = (
+        A100_BF16_PEAK_TFLOPS * 1e12 * A100_ASSUMED_MFU / real_flops_per_graph
+        if real_flops_per_graph else None
+    )
 
     result = {
         "metric": "ggnn_inference_graphs_per_sec",
-        "value": round(infer_gps, 1),
+        "value": infer_gps,
         "unit": "graphs/sec",
-        "vs_baseline": round(infer_gps / base_gps, 2) if base_gps else None,
+        "vs_baseline": round(infer_gps / base_gps, 2) if (base_gps and infer_gps) else None,
         "backend": backend,
         "dtype": "bfloat16",
-        "train_graphs_per_sec": round(train_gps, 1),
-        "peak_batch1024_graphs_per_sec": round(peak_gps, 1) if peak_gps else None,
+        "timing": "strict per-step sync, median of k",
+        "step_ms": round(infer["step_ms"], 3),
+        "flops_per_step": infer["flops_per_step"],
+        "implied_tflops": round(implied_tflops, 2) if implied_tflops is not None else None,
+        "roofline_tflops": round(roofline / 1e12, 1),
+        "mfu": (
+            round(implied_tflops * 1e12 / roofline, 4)
+            if (roofline and implied_tflops is not None) else None
+        ),
+        "padding_efficiency": {k: round(v, 3) for k, v in occupancy.items()},
+        "graphs_per_batch": round(real_graphs, 1),
+        "pipelined_graphs_per_sec": round(infer["pipelined_graphs_per_sec"], 1),
+        "train_graphs_per_sec": train_gps,
+        "peak_batch1024_graphs_per_sec": peak_gps,
+        "refused": refused or None,
         "baseline": "torch-cpu same-semantics GGNN (compat/torch_ref.py)",
         "baseline_graphs_per_sec": round(base_gps, 1) if base_gps else None,
+        "est_a100_graphs_per_sec": round(a100_est_gps, 1) if a100_est_gps else None,
+        "est_vs_a100": round(infer_gps / a100_est_gps, 2) if (a100_est_gps and infer_gps) else None,
+        "a100_assumption": f"{A100_BF16_PEAK_TFLOPS:.0f} TFLOP/s bf16 peak × {A100_ASSUMED_MFU} MFU",
         "config": "hidden32_steps5_concat4_batch256",
     }
     print(json.dumps(result))

@@ -81,3 +81,42 @@ def test_shard_roundtrip(tmp_path):
         assert set(a.node_feats) == set(b.node_feats)
         for k in a.node_feats:
             np.testing.assert_array_equal(a.node_feats[k], b.node_feats[k])
+
+
+def test_derive_buckets_occupancy():
+    from deepdfa_tpu.data.graphs import derive_buckets, padding_efficiency
+
+    graphs = random_dataset(600, seed=3, input_dim=64)
+    buckets = derive_buckets(graphs, batch_graphs=128)
+    assert len(buckets) >= 2  # sub-buckets for tail batches
+    main = buckets[-1]
+    # main bucket must hold the largest single graph
+    assert main.max_nodes > max(g.n_nodes for g in graphs)
+    batches = list(GraphBatcher(buckets).batches(graphs))
+    assert batches, "no batches emitted"
+    full = [b for b in batches if b.max_nodes == main.max_nodes]
+    eff = padding_efficiency(full)
+    assert eff["nodes"] >= 0.8, eff  # the whole point of derived budgets
+    assert 0.0 < eff["edges"] <= 1.0 and 0.0 < eff["graphs"] <= 1.0
+    # every graph lands somewhere (no oversize drops with derived budgets)
+    total = sum(int(b.graph_mask.sum()) for b in batches)
+    assert total == len(graphs)
+
+
+def test_derive_buckets_huge_single_graph():
+    from deepdfa_tpu.data.graphs import derive_buckets
+
+    graphs = random_dataset(50, seed=0, input_dim=64)
+    # one graph far above the mean must still fit the main bucket
+    big = random_dataset(1, seed=1, input_dim=64, mean_nodes=400)[0]
+    buckets = derive_buckets(graphs + [big], batch_graphs=8)
+    assert buckets[-1].max_nodes > big.n_nodes
+    batches = list(GraphBatcher(buckets).batches(graphs + [big]))
+    assert sum(int(b.graph_mask.sum()) for b in batches) == 51
+
+
+def test_derive_buckets_empty_raises():
+    from deepdfa_tpu.data.graphs import derive_buckets
+
+    with pytest.raises(ValueError):
+        derive_buckets([], batch_graphs=8)
