@@ -154,21 +154,29 @@ def encode_functions(
     ``padding="max_length"``/``truncation`` exactly like the reference)."""
     if indices is None:
         indices = np.arange(len(funcs))
-    rows, masks = [], []
-    for func in funcs:
-        text = normalize_whitespace(str(func)) if normalize else str(func)
-        if hasattr(tokenizer, "encode_block"):
-            ids, mask = tokenizer.encode_block(text, block_size)
-        else:  # HF tokenizer — force the framework-wide left-pad convention
-            tokenizer.pad_token = tokenizer.eos_token
-            tokenizer.padding_side = "left"
-            out = tokenizer(
-                text, padding="max_length", truncation=True, max_length=block_size
-            )
-            ids = np.asarray(out["input_ids"], np.int32)
-            mask = np.asarray(out["attention_mask"], bool)
-        rows.append(ids)
-        masks.append(mask)
+    hf = not hasattr(tokenizer, "encode_block")
+    if hf:  # HF tokenizer — force the framework-wide left-pad convention for
+        # the duration of the call, then restore the caller's settings.
+        saved = (tokenizer.pad_token, tokenizer.padding_side)
+        tokenizer.pad_token = tokenizer.pad_token or tokenizer.eos_token
+        tokenizer.padding_side = "left"
+    try:
+        rows, masks = [], []
+        for func in funcs:
+            text = normalize_whitespace(str(func)) if normalize else str(func)
+            if not hf:
+                ids, mask = tokenizer.encode_block(text, block_size)
+            else:
+                out = tokenizer(
+                    text, padding="max_length", truncation=True, max_length=block_size
+                )
+                ids = np.asarray(out["input_ids"], np.int32)
+                mask = np.asarray(out["attention_mask"], bool)
+            rows.append(ids)
+            masks.append(mask)
+    finally:
+        if hf:
+            tokenizer.pad_token, tokenizer.padding_side = saved
     return TextExamples(
         input_ids=np.stack(rows) if rows else np.zeros((0, block_size), np.int32),
         labels=np.asarray(labels, np.int32),
@@ -241,6 +249,12 @@ class GraphJoin:
         return cls(graphs={g.gid: g for g in graphs}, **kw)
 
     def _placeholder(self) -> Graph:
+        if not self.graphs:
+            raise ValueError(
+                "GraphJoin has an empty graph store — no graphs were loaded "
+                "(shards dir present but empty?); cannot build placeholder "
+                "feature schema"
+            )
         any_g = next(iter(self.graphs.values()))
         feats = {
             k: np.zeros((0,) + v.shape[1:], v.dtype)

@@ -235,23 +235,33 @@ class JointTrainer:
             return self.join.join(batch)
         return JoinedBatch(text=batch, graphs=None, mask=batch.mask)
 
-    def _build(self, steps_per_epoch: int, example: JoinedBatch) -> JointState:
+    def _build(
+        self, steps_per_epoch: int, example: JoinedBatch, params: Any | None = None
+    ) -> JointState | None:
+        """Build the optimizer + jitted steps. With resumed ``params`` only
+        the step machinery is built (no LLM forward / fusion init / optimizer
+        state allocation — they'd be thrown away); without, a fresh
+        :class:`JointState` is initialised and returned."""
+        fresh = params is None
         rng = jax.random.key(self.cfg.seed)
-        rng, init_rng, drop_rng = jax.random.split(rng, 3)
-        hidden = self.llm.apply(
-            {"params": self.llm_params},
-            jnp.asarray(example.text.input_ids),
-            jnp.asarray(example.text.pad_mask),
-        )
-        params = self.fusion.init(
-            {"params": init_rng, "dropout": drop_rng},
-            hidden,
-            example.graphs if self.fusion.use_gnn else None,
-            deterministic=True,
-            token_mask=jnp.asarray(example.text.pad_mask),
-        )["params"]
+        if fresh:
+            rng, init_rng, drop_rng = jax.random.split(rng, 3)
+            hidden = self.llm.apply(
+                {"params": self.llm_params},
+                jnp.asarray(example.text.input_ids),
+                jnp.asarray(example.text.pad_mask),
+            )
+            params = self.fusion.init(
+                {"params": init_rng, "dropout": drop_rng},
+                hidden,
+                example.graphs if self.fusion.use_gnn else None,
+                deterministic=True,
+                token_mask=jnp.asarray(example.text.pad_mask),
+            )["params"]
         self.tx = joint_optimizer(self.cfg, steps_per_epoch, params)
         self._steps = make_joint_steps(self.llm, self.fusion, self.tx)
+        if not fresh:
+            return None
         return JointState(params, self.tx.init(params), rng, jnp.zeros((), jnp.int32))
 
     def train(
@@ -273,8 +283,12 @@ class JointTrainer:
             tr_loss, tr_num = 0.0, 0
             for step, tb in enumerate(batches):
                 jb = self._joined(tb)
-                if state is None:
-                    state = self._build(n_batches, jb)
+                if self._steps is None or state is None:
+                    built = self._build(
+                        n_batches, jb,
+                        params=None if state is None else state.params,
+                    )
+                    state = state if state is not None else built
                 train_step, _ = self._steps
                 state, loss, _probs = train_step(state, self.llm_params, jb)
                 tr_loss += float(loss)
@@ -299,7 +313,7 @@ class JointTrainer:
         for tb in text_batches(examples, self.cfg.eval_batch_size):
             jb = self._joined(tb)
             if self._steps is None:  # standalone eval (test-only runs)
-                self._build(1, jb)
+                self._build(1, jb, params=params)
             _, eval_step = self._steps
             loss, probs = eval_step(params, self.llm_params, jb)
             losses.append(float(loss))

@@ -68,6 +68,33 @@ def test_encode_functions_index_join_key():
     assert ex.input_ids.shape == (5, 16)
 
 
+def test_encode_functions_restores_hf_tokenizer_state():
+    """encode_functions must not leak its left-pad convention into the
+    caller's tokenizer (ADVICE r1)."""
+
+    class FakeHF:
+        eos_token = "</s>"
+        pad_token = None
+        padding_side = "right"
+
+        def __call__(self, text, padding, truncation, max_length):
+            assert self.pad_token == self.eos_token  # convention active inside
+            assert self.padding_side == "left"
+            return {"input_ids": [0] * max_length, "attention_mask": [1] * max_length}
+
+    tok = FakeHF()
+    ex = encode_functions(["int f();"], [0], tok, 8)
+    assert ex.input_ids.shape == (1, 8)
+    assert tok.pad_token is None and tok.padding_side == "right"  # restored
+
+
+def test_graph_join_empty_store_raises():
+    join = GraphJoin(graphs={})
+    ex = _examples(n=2)
+    with pytest.raises(ValueError, match="empty graph store"):
+        join.join(next(text_batches(ex, 2)))
+
+
 def test_devign_split_80_10_10():
     s = devign_split(100)
     assert len(s["train"]) == 80 and len(s["eval"]) == 10 and len(s["test"]) == 10
@@ -254,12 +281,14 @@ def joint_setup(tmp_path_factory):
         join=GraphJoin.from_list(graphs, max_nodes=512, max_edges=1024),
         run_dir=tmp_path_factory.mktemp("joint"),
     )
-    return trainer, examples
+    # train here (module-scoped, once) so every test below is independently
+    # runnable under ``pytest -k`` — no state smuggled between tests
+    state = trainer.train(examples, examples)
+    return trainer, examples, state
 
 
 def test_joint_training_learns(joint_setup):
-    trainer, examples = joint_setup
-    state = trainer.train(examples, examples)
+    trainer, examples, state = joint_setup
     assert state is not None
     losses = [h["train_loss"] for h in trainer.history if "train_loss" in h]
     assert len(losses) == 5
@@ -267,12 +296,10 @@ def test_joint_training_learns(joint_setup):
     # eval cadence ran during training and produced report keys
     evals = [h for h in trainer.history if "eval_loss" in h]
     assert evals and "eval_f1_macro" in evals[0]
-    trainer._trained_state = state  # share with the following tests
 
 
 def test_joint_test_report(joint_setup):
-    trainer, examples = joint_setup
-    state = trainer._trained_state
+    trainer, examples, state = joint_setup
     out = trainer.test(state.params, examples)
     assert "test_f1_macro" in out and "test_loss" in out
     assert out["test_f1_macro"] > 0.6  # separable by construction
@@ -281,12 +308,32 @@ def test_joint_test_report(joint_setup):
 def test_joint_checkpoint_roundtrip(joint_setup):
     import jax
 
-    trainer, examples = joint_setup
-    state = trainer._trained_state
+    trainer, examples, state = joint_setup
     restored = trainer.load(state.params, "epoch_4")
     jax.tree.map(np.testing.assert_array_equal, state.params, restored)
     # no_missing in full join
     assert trainer.num_missing == 0
+
+
+def test_joint_resume_on_fresh_trainer(joint_setup):
+    """Passing a resumed state to a trainer that never built its steps must
+    work (ADVICE r1: _build was skipped when state was supplied)."""
+    import dataclasses
+
+    from deepdfa_tpu.llm.joint import JointTrainer
+
+    trainer, examples, state = joint_setup
+    fresh = JointTrainer(
+        llm=trainer.llm,
+        llm_params=trainer.llm_params,
+        fusion=trainer.fusion,
+        cfg=dataclasses.replace(trainer.cfg, epochs=1),
+        join=trainer.join,
+        run_dir=None,
+    )
+    resumed = fresh.train(examples, examples, state=state)
+    assert resumed is not None
+    assert int(resumed.step) > int(state.step)
 
 
 def test_joint_no_flowgnn_mode():
