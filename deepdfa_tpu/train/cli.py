@@ -84,6 +84,16 @@ def load_corpus(cfg: ExperimentConfig) -> dict[str, list[Graph]]:
     if shard_dir.exists() and splits_file.exists():
         graphs = load_shards(shard_dir)
         splits = {k: set(v) for k, v in json.loads(splits_file.read_text()).items()}
+        # split-leakage guard (reference linevd/datamodule.py:75-78: train/val/
+        # test id sets must be pairwise disjoint at construction)
+        for a in ("train", "val", "test"):
+            for b in ("train", "val", "test"):
+                if a < b and splits.get(a, set()) & splits.get(b, set()):
+                    overlap = sorted(splits[a] & splits[b])[:5]
+                    raise ValueError(
+                        f"split leakage: {a}∩{b} non-empty (e.g. {overlap}) "
+                        f"in {splits_file}"
+                    )
         out: dict[str, list[Graph]] = {"train": [], "val": [], "test": []}
         missing = 0
         for g in graphs:

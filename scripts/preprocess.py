@@ -74,9 +74,69 @@ def _extract_one(item: dict) -> tuple[int, object, str | None]:
     return fid, cpg, None
 
 
+def _extract_with_joern(records: list[dict], dataset: str):
+    """Joern extraction path: source files land under ``processed/{ds}/before``
+    (the reference's storage layout), one interactive session exports
+    ``.nodes/.edges/.dataflow.json`` per function via the framework's own
+    query script (``cpg/queries/export_func_graph.sc``), and the artifacts are
+    read back with :func:`deepdfa_tpu.cpg.joern.load_cpg`.
+
+    Parallel scale-out = one :class:`JoernSession` per worker id (sessions use
+    private ``workers/{id}`` workspaces); kept sequential here because the
+    JVM spin-up dominates only once per corpus. Returns ``(cpgs, failures,
+    parse_after)`` where ``parse_after`` extracts an after-patch CPG for the
+    statement labeler through the same session."""
+    import hashlib
+
+    from deepdfa_tpu import utils
+    from deepdfa_tpu.cpg.joern import load_cpg
+    from deepdfa_tpu.cpg.joern_session import JoernSession
+
+    src_dir = utils.get_dir(utils.processed_dir() / dataset / "before")
+    after_dir = utils.get_dir(utils.processed_dir() / dataset / "after")
+    session = JoernSession(worker_id=0)
+    cpgs: dict[int, object] = {}
+    failures: list[str] = []
+
+    def _export_and_load(c_path: Path):
+        stem = str(c_path)
+        if not (Path(stem + ".nodes.json").exists() and Path(stem + ".edges.json").exists()):
+            session.run_script("export_func_graph", {"filename": stem})
+        return load_cpg(stem)
+
+    try:
+        for row in records:
+            fid = row["id"]
+            # content-addressed like the native CPG cache: a changed `before`
+            # text must never silently reuse stale artifacts
+            digest = hashlib.sha1(str(row["before"]).encode()).hexdigest()[:16]
+            c_path = src_dir / f"{fid}_{digest}.c"
+            if not c_path.exists():
+                c_path.write_text(str(row["before"]))
+            try:
+                cpgs[fid] = _export_and_load(c_path)
+            except Exception as exc:  # noqa: BLE001 — failure-file protocol
+                failures.append(f"{fid}\t{type(exc).__name__}: {exc}")
+    except BaseException:
+        session.close()
+        raise
+
+    def parse_after(source: str):
+        digest = hashlib.sha1(source.encode()).hexdigest()[:16]
+        c_path = after_dir / f"{digest}.c"
+        if not c_path.exists():
+            c_path.write_text(source)
+        return _export_and_load(c_path)
+
+    return cpgs, failures, parse_after, session
+
+
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser()
     parser.add_argument("--dataset", default="demo", help="demo | bigvul | devign")
+    parser.add_argument("--frontend", default="native", choices=["native", "joern"],
+                        help="CPG producer: hermetic native C frontend (default) "
+                             "or a local joern install via the interactive session")
     parser.add_argument("--n", type=int, default=200, help="demo corpus size")
     parser.add_argument("--sample", action="store_true")
     parser.add_argument("--seed", type=int, default=0)
@@ -94,7 +154,6 @@ def main(argv=None) -> dict:
 
     from deepdfa_tpu import utils
     from deepdfa_tpu.config import FeatureConfig
-    from deepdfa_tpu.cpg.features import dep_add_lines
     from deepdfa_tpu.cpg.frontend import parse_source
     from deepdfa_tpu.data.graphs import save_shards
     from deepdfa_tpu.data.materialize import CorpusBuilder
@@ -120,38 +179,67 @@ def main(argv=None) -> dict:
     # 2. extract CPGs (parallel, with the failure-file protocol; per-function
     # pickle cache makes interrupted runs resume where they stopped)
     records = df.to_dict("records")
-    if not args.no_cache:
-        cache = utils.get_dir(utils.cache_dir() / "cpg_cache" / args.dataset)
-        df = df.assign(_cache_dir=str(cache))
-    results = utils.dfmp(df, _extract_one, workers=args.workers, desc="extract")
-    cpgs, failures = {}, []
-    for fid, cpg, err in results:
-        if cpg is not None and len(cpg):
-            cpgs[fid] = cpg
-        if err is not None:
-            failures.append(err)
+    parse_after = parse_source
+    joern_session = None
+    if args.frontend == "joern":
+        cpgs, failures, parse_after, joern_session = _extract_with_joern(
+            records, args.dataset
+        )
+    else:
+        if not args.no_cache:
+            cache = utils.get_dir(utils.cache_dir() / "cpg_cache" / args.dataset)
+            df = df.assign(_cache_dir=str(cache))
+        results = utils.dfmp(df, _extract_one, workers=args.workers, desc="extract")
+        cpgs, failures = {}, []
+        for fid, cpg, err in results:
+            if cpg is not None and len(cpg):
+                cpgs[fid] = cpg
+            if err is not None:
+                failures.append(err)
     out_dir.mkdir(parents=True, exist_ok=True)
+    failed_rate = len(failures) / max(len(records), 1)
     if failures:
         (out_dir / "failed_frontend.txt").write_text("\n".join(failures) + "\n")
+        print(
+            f"frontend failures: {len(failures)}/{len(records)} "
+            f"({failed_rate:.1%}) — see {out_dir / 'failed_frontend.txt'}",
+            file=sys.stderr,
+        )
 
-    # 3. labels: removed ∪ dep-add for line-level corpora
+    # 3. labels: removed ∪ dep-add for line-level corpora, via the corpus-wide
+    # statement-labels cache (statement_labels.pkl parity, evaluate.py:239-255)
     row_of = {r["id"]: r for r in records}
     vuln_lines = graph_labels = None
-    if graph_level:
-        graph_labels = {fid: int(row_of[fid].get("vul", 0)) for fid in cpgs}
-    else:
-        vuln_lines = {}
-        for fid, cpg in cpgs.items():
-            row = row_of[fid]
-            lines = set(row.get("removed") or [])
-            added = list(row.get("added") or [])
-            if added and row.get("after"):
-                try:
-                    after_cpg = parse_source(row["after"])
-                    lines |= set(dep_add_lines(cpg, after_cpg, added))
-                except Exception:  # noqa: BLE001 — label fallback: removed only
-                    pass
-            vuln_lines[fid] = lines
+    try:
+        if graph_level:
+            graph_labels = {fid: int(row_of[fid].get("vul", 0)) for fid in cpgs}
+        else:
+            import hashlib
+
+            from deepdfa_tpu.cpg.ivdetect import statement_labels
+
+            # content-addressed cache name (like the CPG cache): a stale pkl
+            # from a different corpus must never be silently reused
+            label_key = hashlib.sha1(
+                json.dumps(
+                    [[r["id"], int(r.get("vul", 1)),
+                      list(r.get("removed") or []), list(r.get("added") or [])]
+                     for r in records]
+                ).encode()
+            ).hexdigest()[:16]
+            stmt = statement_labels(
+                records, cpgs, parse_after,
+                cache_path=out_dir / f"statement_labels{suffix}_{label_key}.pkl",
+                cache=not args.overwrite,
+            )
+            vuln_lines = {
+                fid: set(stmt.get(fid, {}).get("removed", []))
+                | set(stmt.get(fid, {}).get("depadd", []))
+                for fid in cpgs
+            }
+    finally:  # the session is a JVM — never leak it past the labeling stage
+        if joern_session is not None:
+            joern_session.close()
 
     # 4. split (random 70/10/20 unless the ingest table carries one)
     rng = np.random.default_rng(args.seed)
@@ -184,6 +272,7 @@ def main(argv=None) -> dict:
         "cpgs": len(cpgs),
         "graphs": len(graphs),
         "failed": len(failures),
+        "failed_rate": round(failed_rate, 4),
         "shards": n_shards,
         "vul_graphs": int(sum(g.node_feats["_VULN"].max() > 0 for g in graphs)),
     }

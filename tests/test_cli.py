@@ -117,3 +117,26 @@ def test_trace_capture(storage, tmp_path):
     cli.main(["test", "--run-dir", str(run_dir), *SMALL, "--set", "trace=true"])
     trace_dir = run_dir / "trace"
     assert trace_dir.exists() and any(trace_dir.rglob("*"))
+
+
+def test_split_leakage_guard(storage, monkeypatch):
+    """Overlapping split id sets must be rejected at corpus load
+    (linevd/datamodule.py:75-78 parity)."""
+    from pathlib import Path
+
+    from deepdfa_tpu import utils
+    from deepdfa_tpu.config import ExperimentConfig
+    from deepdfa_tpu.data.graphs import save_shards
+    from deepdfa_tpu.data.synthetic import random_dataset
+
+    cfg = ExperimentConfig()
+    shard_dir = Path(utils.processed_dir()) / cfg.data.dsname / "shards"
+    graphs = random_dataset(6, seed=0, input_dim=cfg.input_dim)
+    for i, g in enumerate(graphs):
+        g.gid = i
+    save_shards(graphs, shard_dir)
+    (shard_dir / "splits.json").write_text(
+        json.dumps({"train": [0, 1, 2], "val": [2, 3], "test": [4, 5]})
+    )
+    with pytest.raises(ValueError, match="split leakage"):
+        cli.load_corpus(cfg)
