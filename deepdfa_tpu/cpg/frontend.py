@@ -505,6 +505,18 @@ class _Builder:
             return call, (cfrag[0], [call])
         return call, (arm_entries, [call])
 
+    def cond_frag(self, croot: int, cfrag: Frag) -> Frag:
+        """Branch conditions are ALWAYS CFG-evaluated. A bare identifier /
+        literal / member condition (``if (ptr)``, ``while (n)``,
+        ``switch (op)``) lowers to an expression with no CALL inside, so its
+        fragment is empty — without this, the construct would have no branch
+        node: no path-sensitivity for reaching defs, no control dependence,
+        and a ``switch`` would disconnect the CFG entirely. Joern gives every
+        condition expression a CFG node; we promote the expression root."""
+        if cfrag[0]:
+            return cfrag
+        return [croot], [croot]
+
     # -- statements ------------------------------------------------------
     def stmt(self, n, parent: int, order: int) -> Frag:
         """Lower a statement; returns its CFG fragment."""
@@ -564,12 +576,9 @@ class _Builder:
             croot, cfrag = self.expr(n.cond, order=1)
             self.ast_edge(cs, croot)
             self.edges.append((cs, croot, "CONDITION"))
+            cfrag = self.cond_frag(croot, cfrag)
             tfrag = self.stmt(n.iftrue, cs, 2)
             ffrag = self.stmt(n.iffalse, cs, 3) if n.iffalse else EMPTY
-            if not cfrag[0]:
-                # condition has no CFG nodes: both arms are alternative paths
-                entries = tfrag[0] + ffrag[0]
-                return entries, tfrag[1] + ffrag[1]
             exits: list[int] = []
             for e, x in (tfrag, ffrag):
                 if e:
@@ -586,17 +595,14 @@ class _Builder:
             croot, cfrag = self.expr(n.cond, order=1)
             self.ast_edge(cs, croot)
             self.edges.append((cs, croot, "CONDITION"))
+            cfrag = self.cond_frag(croot, cfrag)
             self._breaks.append([])
             self._continues.append([])
             bfrag = self.stmt(n.stmt, cs, 2)
             brk, cont = self._breaks.pop(), self._continues.pop()
-            if cfrag[0]:
-                self.wire(cfrag[1], bfrag[0] or cfrag[0])
-                self.wire(bfrag[1] + cont, cfrag[0])
-                return cfrag[0], cfrag[1] + brk
-            # condition with no calls (e.g. while(1)): loop through body
-            self.wire(bfrag[1] + cont, bfrag[0])
-            return bfrag[0], brk
+            self.wire(cfrag[1], bfrag[0] or cfrag[0])
+            self.wire(bfrag[1] + cont, cfrag[0])
+            return cfrag[0], cfrag[1] + brk
 
         if isinstance(n, c_ast.DoWhile):
             cs = self.add_node("CONTROL_STRUCTURE", name="DO",
@@ -609,13 +615,11 @@ class _Builder:
             croot, cfrag = self.expr(n.cond, order=2)
             self.ast_edge(cs, croot)
             self.edges.append((cs, croot, "CONDITION"))
-            if cfrag[0]:
-                self.wire(bfrag[1] + cont, cfrag[0])
-                self.wire(cfrag[1], bfrag[0] or cfrag[0])
-                entries = bfrag[0] or cfrag[0]
-                return entries, cfrag[1] + brk
-            self.wire(bfrag[1] + cont, bfrag[0])
-            return bfrag[0], brk + bfrag[1]
+            cfrag = self.cond_frag(croot, cfrag)
+            self.wire(bfrag[1] + cont, cfrag[0])
+            self.wire(cfrag[1], bfrag[0] or cfrag[0])
+            entries = bfrag[0] or cfrag[0]
+            return entries, cfrag[1] + brk
 
         if isinstance(n, c_ast.For):
             cs = self.add_node("CONTROL_STRUCTURE", name="FOR", code="for (...)",
@@ -627,6 +631,7 @@ class _Builder:
                 croot, cfrag = self.expr(n.cond, order=2)
                 self.ast_edge(cs, croot)
                 self.edges.append((cs, croot, "CONDITION"))
+                cfrag = self.cond_frag(croot, cfrag)
             else:
                 cfrag = EMPTY
             self._breaks.append([])
@@ -688,6 +693,7 @@ class _Builder:
             croot, cfrag = self.expr(n.cond, order=1)
             self.ast_edge(cs, croot)
             self.edges.append((cs, croot, "CONDITION"))
+            cfrag = self.cond_frag(croot, cfrag)
             self._breaks.append([])
             prev_out: list[int] = []
             has_default = False

@@ -1,0 +1,9 @@
+int accumulate_scaled(int *xs, int n, int scale) {
+    int total = 0;
+    int k;
+    for (k = 0; k < n; k++) {
+        int term = xs[k] * scale;
+        total = total + term;
+    }
+    return total;
+}

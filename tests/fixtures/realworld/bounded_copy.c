@@ -1,0 +1,10 @@
+int bounded_copy(char *dst, const char *src, int n) {
+    int i = 0;
+    if (n > 256)
+        n = 256;
+    while (i < n) {
+        dst[i] = src[i];
+        i = i + 1;
+    }
+    return i;
+}

@@ -1,0 +1,8 @@
+int do_while_acc(int seed, int rounds) {
+    int h = seed;
+    do {
+        h = h * 31 + 7;
+        rounds = rounds - 1;
+    } while (rounds > 0);
+    return h;
+}

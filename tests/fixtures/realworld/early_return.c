@@ -1,0 +1,9 @@
+int early_return(int *buf, int idx, int max) {
+    if (buf == 0)
+        return -1;
+    if (idx >= max)
+        return -2;
+    int v = buf[idx];
+    v = v * 2;
+    return v;
+}

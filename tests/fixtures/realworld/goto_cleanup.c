@@ -1,0 +1,12 @@
+int goto_cleanup(int fd, int want) {
+    int got = 0;
+    int rc = 0;
+    if (fd < 0) {
+        rc = -1;
+        goto out;
+    }
+    got = want;
+    rc = got;
+out:
+    return rc;
+}

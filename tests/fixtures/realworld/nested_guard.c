@@ -1,0 +1,11 @@
+int nested_guard(int x, int y) {
+    int z = 0;
+    if (x > 0) {
+        if (y > 0) {
+            z = x * y;
+        } else {
+            z = x;
+        }
+    }
+    return z;
+}

@@ -1,0 +1,9 @@
+int ptr_walk(const int *p, int n) {
+    int sum = 0;
+    const int *end = p + n;
+    while (p < end) {
+        sum += *p;
+        p = p + 1;
+    }
+    return sum;
+}

@@ -1,0 +1,8 @@
+struct pkt { int len; int used; };
+int struct_update(struct pkt *q, int add) {
+    int avail = q->len - q->used;
+    if (add > avail)
+        add = avail;
+    q->used = q->used + add;
+    return add;
+}

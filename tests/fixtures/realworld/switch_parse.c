@@ -1,0 +1,15 @@
+int switch_parse(int op, int a, int b) {
+    int r = 0;
+    switch (op) {
+    case 0:
+        r = a + b;
+        break;
+    case 1:
+        r = a - b;
+        break;
+    default:
+        r = -1;
+        break;
+    }
+    return r;
+}

@@ -1,0 +1,6 @@
+int ternary_minmax(int a, int b, int lo, int hi) {
+    int v = a > b ? a : b;
+    v = v < lo ? lo : v;
+    v = v > hi ? hi : v;
+    return v;
+}
