@@ -110,7 +110,9 @@ class JoernRunner:
     reference's idempotence contract, ``get_func_graph.sc:36-48``).
     """
 
-    def __init__(self, script: str | Path, joern_bin: str = "joern"):
+    def __init__(self, script: str | Path | None = None, joern_bin: str = "joern"):
+        if script is None:  # the framework ships its own query script
+            script = Path(__file__).parent / "queries" / "export_func_graph.sc"
         self.script = Path(script)
         self.joern_bin = joern_bin
 

@@ -1,0 +1,123 @@
+// deepdfa-tpu Joern export query (CPG + reaching-definitions artifacts).
+//
+// Artifact contract — consumed by deepdfa_tpu/cpg/joern.py readers; same
+// on-disk shapes as the reference pipeline it replaces (capability parity
+// with DDFA/storage/external/get_func_graph.sc:26-75, reimplemented):
+//
+//   {filename}.nodes.json    array of node property maps
+//   {filename}.edges.json    array of [inNodeId, outNodeId, label, VARIABLE]
+//   {filename}.dataflow.json {method: {"problem.gen"/"problem.kill"/
+//                             "solution.in"/"solution.out": {nodeId: [defIds]}}}
+//   {filename}.cpg.bin       binary CPG (reused on re-runs: idempotent)
+//
+// Run (batch):       joern --script export_func_graph.sc --params filename=f.c
+// Run (interactive): via deepdfa_tpu.cpg.joern_session.JoernSession.run_script
+//
+// Tested against joern 1.1.x (the dataflowengineoss reaching-def API).
+
+import better.files.File
+import io.joern.dataflowengineoss.passes.reachingdef.{
+  DataFlowSolver,
+  ReachingDefFlowGraph,
+  ReachingDefProblem,
+  ReachingDefTransferFunction
+}
+
+// Minimal JSON writer with proper string escaping (the artifact files hold
+// raw C source in `code` properties — quotes/backslashes/newlines included).
+def q(s: String): String = {
+  val b = new StringBuilder("\"")
+  s.foreach {
+    case '"'  => b.append("\\\"")
+    case '\\' => b.append("\\\\")
+    case '\n' => b.append("\\n")
+    case '\r' => b.append("\\r")
+    case '\t' => b.append("\\t")
+    case c if c < ' ' => b.append(f"\\u${c.toInt}%04x")
+    case c    => b.append(c)
+  }
+  b.append("\"").toString
+}
+
+def jval(v: Any): String = v match {
+  case null               => "null"
+  case s: String          => q(s)
+  case b: Boolean         => b.toString
+  case i: Int             => i.toString
+  case l: Long            => l.toString
+  case d: Double          => d.toString
+  case seq: Seq[_]        => seq.map(jval).mkString("[", ",", "]")
+  case m: Map[_, _]       =>
+    m.map { case (k, x) => q(k.toString) + ":" + jval(x) }.mkString("{", ",", "}")
+  case other              => q(other.toString)
+}
+
+def rdSolutionJson(): String = {
+  val perMethod = cpg.method
+    .filter(m => m.filename != "<empty>" && m.name != "<global>")
+    .map { m =>
+      val problem  = ReachingDefProblem.create(m)
+      val solution = new DataFlowSolver().calculateMopSolutionForwards(problem)
+      val tf       = problem.transferFunction.asInstanceOf[ReachingDefTransferFunction]
+      val num2node = problem.flowGraph.asInstanceOf[ReachingDefFlowGraph].numberToNode
+      def sets(raw: Map[_ <: AnyRef, Set[Int]]): Map[String, Seq[Long]] =
+        raw.map { case (node, bits) =>
+          val id = node.getClass.getMethod("id").invoke(node).toString
+          id -> bits.toSeq.sorted.map(num2node).map(_.id)
+        }.toMap
+      m.name -> Map(
+        "problem.gen"  -> sets(tf.gen),
+        "problem.kill" -> sets(tf.kill),
+        "solution.in"  -> sets(solution.in),
+        "solution.out" -> sets(solution.out)
+      )
+    }
+    .toMap
+  jval(perMethod)
+}
+
+@main def exec(
+    filename: String,
+    runOssDataflow: Boolean = true,
+    exportJson: Boolean = true,
+    exportCpg: Boolean = true,
+    exportDataflow: Boolean = true,
+    deleteAfter: Boolean = true
+) = {
+  val binFile = File(filename + ".cpg.bin")
+  if (binFile.exists) {
+    importCpg(binFile.toString)
+  } else {
+    importCode(filename)
+    if (runOssDataflow) { run.ossdataflow }
+  }
+
+  if (exportCpg && !binFile.exists) {
+    save
+    File(project.path + "/cpg.bin").copyTo(binFile, overwrite = true)
+  }
+
+  if (exportJson) {
+    val nodesOut = File(filename + ".nodes.json")
+    val edgesOut = File(filename + ".edges.json")
+    if (!nodesOut.exists || !edgesOut.exists) {
+      val edgeRows = cpg.graph.E
+        .map(e =>
+          Seq(e.inNode.id, e.outNode.id, e.label, e.propertiesMap.get("VARIABLE"))
+        )
+        .toSeq
+      edgesOut.overwrite(jval(edgeRows))
+      val nodeRows = cpg.graph.V
+        .map(v => v.propertiesMap.asScala.toMap ++ Map("id" -> v.id, "_label" -> v.label))
+        .toSeq
+      nodesOut.overwrite(jval(nodeRows))
+    }
+  }
+
+  if (exportDataflow) {
+    val dfOut = File(filename + ".dataflow.json")
+    if (!dfOut.exists) { dfOut.overwrite(rdSolutionJson()) }
+  }
+
+  if (deleteAfter) { delete }
+}

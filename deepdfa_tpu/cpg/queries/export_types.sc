@@ -1,0 +1,51 @@
+// deepdfa-tpu Joern query: resolve a type name to its leaf member types.
+//
+// Capability parity with the reference's get_type.sc (struct members are
+// flattened to leaf/external type names so the abstract-dataflow `datatype`
+// feature can hash composite types consistently); reimplemented on the same
+// public Joern traversal API.
+//
+// Run: joern --script export_types.sc --params "typename=my_struct,filename=f.c"
+// Output: {filename}.types.{typename}.json — JSON array of leaf type names.
+
+import better.files.File
+import scala.collection.mutable
+
+def resolveAlias(tn: String): Traversal[TypeDecl] = {
+  val aliases = cpg.typeDecl.name(tn).aliasTypeFullName.dedup.l
+  aliases.headOption match {
+    case Some(target) if target.startsWith("anonymous_type_") =>
+      // anonymous aliases index into the file's unnamed decls by order
+      val idx = target.stripPrefix("anonymous_type_").toInt
+      cpg.typeDecl
+        .name("")
+        .filename(cpg.typeDecl.name(tn).filename.head)
+        .sortBy(_.order)
+        .drop(idx)
+        .take(1)
+    case Some(target) => cpg.typeDecl.name(target)
+    case None         => cpg.typeDecl.name(tn)
+  }
+}
+
+def leafTypes(decls: List[TypeDecl], seen: mutable.HashSet[String]): List[String] = {
+  seen ++= decls.map(_.name)
+  val external = decls.filter(_.isExternal).map(_.name)
+  val members  = decls.flatMap(_.member.typeFullName.l).filterNot(seen)
+  if (members.isEmpty) external ::: decls.map(_.name)
+  else {
+    seen ++= members
+    external ::: members
+      .flatMap(m => leafTypes(resolveAlias(m).l, seen))
+      .distinct
+  }
+}
+
+@main def exec(typename: String, filename: String) = {
+  val binFile = File(filename + ".cpg.bin")
+  if (binFile.exists) { importCpg(binFile.toString) } else { importCode(filename) }
+  val leaves = leafTypes(resolveAlias(typename).l, mutable.HashSet[String]())
+  val out = leaves.distinct.map(s => "\"" + s.replace("\"", "\\\"") + "\"")
+  File(s"$filename.types.$typename.json").overwrite(out.mkString("[", ",", "]"))
+  delete
+}
