@@ -1,0 +1,168 @@
+"""Compat layers exercised on FAITHFUL artifact shapes (round-2 brief):
+
+- a full-schema MSR/Big-Vul CSV (every typed column of the reference reader,
+  ``DDFA/sastvd/helpers/datasets.py:159-198``) through ``ingest.bigvul``;
+- a real HF checkpoint directory (``save_pretrained`` safetensors +
+  config.json) through ``convert.load_hf_checkpoint`` → forward → generate.
+
+These would catch schema drift that the minimal synthetic fixtures cannot.
+"""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+BEFORE = (
+    "static int copy_data(char *dst, const char *src, int n)\n"
+    "{\n"
+    "  int i;\n"
+    "  for (i = 0; i < n; i++)\n"
+    "    dst[i] = src[i];\n"
+    "  return i;\n"
+    "}\n"
+)
+AFTER = (
+    "static int copy_data(char *dst, const char *src, int n)\n"
+    "{\n"
+    "  int i;\n"
+    "  if (n > 64)\n"
+    "    n = 64;\n"
+    "  for (i = 0; i < n; i++)\n"
+    "    dst[i] = src[i];\n"
+    "  return i;\n"
+    "}\n"
+)
+
+
+def _msr_full_schema_df(n_nonvul: int = 7) -> pd.DataFrame:
+    """Rows with EVERY column (and dtype) the reference's ``pd.read_csv``
+    declares (``datasets.py:161-196``), not just the ones our reader uses."""
+    base = {
+        "commit_id": "deadbeef0123",
+        "del_lines": 1,
+        "file_name": "drivers/net/foo.c",
+        "lang": "C",
+        "lines_after": "12,13",
+        "lines_before": "12",
+        "Access Gained": "None",
+        "Attack Origin": "Remote",
+        "Authentication Required": "Not required",
+        "Availability": "Partial",
+        "CVE ID": "CVE-2018-1000001",
+        "CVE Page": "https://www.cvedetails.com/cve/CVE-2018-1000001/",
+        "CWE ID": "CWE-787",
+        "Complexity": "Low",
+        "Confidentiality": "Partial",
+        "Integrity": "Partial",
+        "Known Exploits": "",
+        "Score": 7.5,
+        "Summary": "Out-of-bounds write in copy_data.",
+        "Vulnerability Classification": "Overflow",
+        "add_lines": 2,
+        "codeLink": "https://github.com/example/repo/commit/deadbeef0123",
+        "commit_message": "fix OOB write",
+        "files_changed": "drivers/net/foo.c",
+        "parentID": "cafebabe4567",
+        "patch": "@@ -3,0 +4,2 @@",
+        "project": "linux",
+        "project_after": "linux",
+        "project_before": "linux",
+        "vul_func_with_fix": AFTER,
+        "Publish Date": "2018-02-01",
+        "Update Date": "2019-03-02",
+    }
+    rows = [dict(base, func_before=BEFORE, func_after=AFTER, vul=1)]
+    for i in range(n_nonvul):
+        code = f"int h{i}(int x)\n{{\n  int y = x + {i};\n  return y;\n}}\n"
+        rows.append(
+            dict(base, commit_id=f"c{i:07x}", func_before=code, func_after=code,
+                 vul=0, del_lines=0, add_lines=0, Score=2.1)
+        )
+    return pd.DataFrame(rows)
+
+
+def test_bigvul_full_msr_schema(tmp_path, monkeypatch):
+    """The faithful ~35-typed-column CSV (incl. the unnamed index column that
+    becomes ``id``, date columns, float Score) parses into the minimal
+    table with correct diff labels."""
+    from deepdfa_tpu.data import ingest
+
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    df = _msr_full_schema_df()
+    path = tmp_path / "MSR_data_cleaned.csv"
+    # index=True + no index name == the real file's leading "Unnamed: 0"
+    df.to_csv(path, index=True)
+
+    raw = pd.read_csv(path)
+    assert "Unnamed: 0" in raw.columns  # the artifact shape we claim to parse
+    assert len(raw.columns) == len(df.columns) + 1
+
+    out = ingest.bigvul(csv_path=path, cache=False, workers=1)
+    assert set(ingest._MINIMAL_COLS) <= set(out.columns)
+    # ids come from the unnamed index column
+    assert sorted(out["id"]) == list(range(len(df)))
+    vul = out[out.vul == 1]
+    assert len(vul) == 1
+    row = vul.iloc[0]
+    # the bound-check insertion is an added-lines-only patch
+    assert list(row.added), "diff labeler found no added lines"
+    assert row.before.startswith("static int copy_data")
+    # comments are stripped and non-vul rows all survive
+    assert len(out[out.vul == 0]) == 7
+
+
+def test_hf_checkpoint_dir_roundtrip(tmp_path):
+    """save_pretrained → load_hf_config/load_hf_checkpoint → logits parity →
+    generate. Exercises the on-disk safetensors + config.json format, not an
+    in-memory state_dict."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM as HFLlama
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.llm.convert import load_hf_checkpoint, load_hf_config
+    from deepdfa_tpu.llm.generate import GenerateConfig, generate
+    from deepdfa_tpu.llm.llama import LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(
+        vocab_size=320,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rope_theta=1e6,
+        rms_norm_eps=1e-5,
+        max_position_embeddings=64,
+        attn_implementation="eager",
+    )
+    hf = HFLlama(hf_cfg).eval()
+    ckpt_dir = tmp_path / "ckpt"
+    hf.save_pretrained(ckpt_dir, safe_serialization=True)
+    assert list(ckpt_dir.glob("*.safetensors")), "not a safetensors checkpoint"
+
+    cfg = load_hf_config(ckpt_dir)
+    assert cfg.hidden_size == 64 and cfg.num_key_value_heads == 2
+    params = load_hf_checkpoint(ckpt_dir)
+
+    ids = np.random.default_rng(0).integers(3, 320, (2, 10))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    model = LlamaForCausalLM(cfg)
+    out = model.apply({"params": params}, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4)
+
+    # and the loaded tree drives generation end-to-end
+    mask = np.ones((2, 10), bool)
+    toks = generate(
+        model, params, ids, mask,
+        GenerateConfig(max_new_tokens=4, do_sample=False),
+        rng=jax.random.key(0),
+    )
+    assert toks.shape == (2, 4)
+    assert ((toks >= 0) & (toks < 320)).all()
