@@ -19,7 +19,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
-__all__ = ["generate_function", "demo_corpus"]
+__all__ = ["generate_function", "generate_hard_function", "demo_corpus"]
 
 
 def _names(rng: np.random.Generator, n: int) -> list[str]:
@@ -80,14 +80,85 @@ def generate_function(fid: int, vul: bool, rng: np.random.Generator) -> dict:
     }
 
 
-def demo_corpus(n: int = 200, vul_ratio: float = 0.5, seed: int = 0) -> pd.DataFrame:
-    """Balanced-ish labeled corpus (the sample CSV analogue: 100 vul +
-    100 non-vul in the reference's sample mode)."""
-    rng = np.random.default_rng(seed)
-    rows = [
-        generate_function(fid, bool(rng.random() < vul_ratio), rng)
-        for fid in range(n)
+def generate_hard_function(fid: int, vul: bool, rng: np.random.Generator) -> dict:
+    """A *dataflow-hard* (before, after) pair: both classes are built from the
+    SAME statement multiset — identical per-node abstract-dataflow features,
+    identical token histogram — and differ ONLY in the CFG order of two
+    statements:
+
+        T:  ``cap = strlen(src);``              (tainted bound)
+        C:  ``if (cap >= K) { cap = K - 1; }``  (clamp)
+
+    safe order ``T;C``  → the clamp dominates the copy: IN(memcpy) ∋ clamp def
+    vul order  ``C;T``  → the taint re-defines cap after the clamp:
+                          IN(memcpy) = {taint def} only
+
+    So the class is a function of *which definition reaches the copy* — pure
+    reaching-definitions reasoning (the reference's learned-DFA thesis,
+    ``clipper.py:50-77``); any bag-of-features classifier is at chance by
+    construction. A random 0-8 statement gap between the clamp/taint block
+    and the copy stretches the def→use chains past a fixed message-passing
+    depth for some functions, keeping the task nontrivial for the GGNN too.
+
+    The patch (``after``) restores the safe order, so ``removed``/``added``
+    line labels mirror a real reordering fix.
+    """
+    a, b, c = _names(rng, 3)
+    k1 = int(rng.integers(2, 9))
+    k2 = int(rng.integers(16, 64))
+    cap = f"cap{fid}"
+
+    taint = f"    {cap} = (int)strlen({c});"
+    clamp = f"    if ({cap} >= {k2}) {{ {cap} = {k2} - 1; }}"
+    gap_pool = [
+        f"    int {a} = {k1};",
+        f"    int {b} = {a} + {k1};" if rng.random() < 0.5 else f"    int {b} = {k1} * 2;",
+        f"    if ({a} > {k1}) {{ {a} = {a} - 1; }}",
+        f"    for (int i = 0; i < {k1}; i++) {{ {b} += i; }}",
+        f"    {b} = {b} ^ {a};",
+        f"    while ({a} > 0) {{ {a} -= 1; }}",
+        f"    {a} = {a} + {b};",
+        f"    if ({b} > {a}) {{ {b} = {a}; }}",
     ]
+    n_gap = int(rng.integers(0, 9))
+    gap = [gap_pool[i] for i in sorted(rng.choice(len(gap_pool), min(n_gap, len(gap_pool)), replace=False))]
+
+    head = f"int f{fid}(char *{c}, int n)"
+    decl = [f"    char dst{fid}[{k2}];", f"    int {cap} = 0;"]
+    copy = f"    memcpy(dst{fid}, {c}, {cap});"
+    tail = f"    return {cap};"
+
+    def render(order: list[str]) -> str:
+        return "\n".join([head, "{", *decl, *order, *gap, copy, tail, "}"])
+
+    before = render([clamp, taint] if vul else [taint, clamp])
+    after = render([taint, clamp])
+    if vul:
+        taint_line_before = 4 + 2  # head, "{", 2 decls, clamp, then taint
+        copy_line = 4 + 2 + len(gap) + 1
+        removed = [taint_line_before, copy_line]
+        added = [4 + 1]  # taint moved before the clamp in `after`
+    else:
+        removed, added = [], []
+    return {
+        "id": fid,
+        "before": before,
+        "after": after,
+        "vul": int(vul),
+        "removed": removed,
+        "added": added,
+    }
+
+
+def demo_corpus(
+    n: int = 200, vul_ratio: float = 0.5, seed: int = 0, style: str = "easy"
+) -> pd.DataFrame:
+    """Balanced-ish labeled corpus (the sample CSV analogue: 100 vul +
+    100 non-vul in the reference's sample mode). ``style="hard"`` uses the
+    dataflow-hard generator (identical feature histograms across classes)."""
+    gen = generate_hard_function if style == "hard" else generate_function
+    rng = np.random.default_rng(seed)
+    rows = [gen(fid, bool(rng.random() < vul_ratio), rng) for fid in range(n)]
     df = pd.DataFrame(rows)
-    df["dataset"] = "demo"
+    df["dataset"] = "demo" if style == "easy" else "demo_hard"
     return df

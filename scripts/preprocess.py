@@ -165,10 +165,13 @@ def main(argv=None) -> dict:
         return {"status": "exists", "out": str(out_dir)}
 
     # 1. ingest
-    if args.dataset == "demo":
+    if args.dataset in ("demo", "demo_hard"):
         from deepdfa_tpu.data.codegen import demo_corpus
 
-        df = demo_corpus(args.n if not args.sample else min(args.n, 60), seed=args.seed)
+        df = demo_corpus(
+            args.n if not args.sample else min(args.n, 60), seed=args.seed,
+            style="hard" if args.dataset == "demo_hard" else "easy",
+        )
         graph_level = False
     else:
         from deepdfa_tpu.data import ingest

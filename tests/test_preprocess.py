@@ -190,3 +190,37 @@ def test_extraction_cache_resume(tmp_path, monkeypatch):
         ["--dataset", "demo", "--n", "40", "--workers", "1", "--overwrite"]
     )
     assert s3["graphs"] == 40 and s3["failed"] == 0
+
+
+def test_hard_corpus_invariants():
+    """demo_hard: identical statement multiset across classes; the clamp def
+    reaches the copy iff the function is safe (the RD distinguisher the
+    dataflow experiment depends on)."""
+    import numpy as np
+
+    from deepdfa_tpu.cpg.dataflow import ReachingDefinitions
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import generate_hard_function
+
+    v = generate_hard_function(1, True, np.random.default_rng(3))
+    s = generate_hard_function(1, False, np.random.default_rng(3))
+    assert sorted(v["before"].splitlines()) == sorted(s["before"].splitlines())
+    assert v["removed"] and not s["removed"]
+
+    rng = np.random.default_rng(0)
+    for i in range(8):
+        vul = bool(rng.random() < 0.5)
+        row = generate_hard_function(i, vul, rng)
+        cpg = parse_source(row["before"])
+        in_sets, _ = ReachingDefinitions(cpg).solve()
+        copy_node = max(
+            (n for n, nd in cpg.nodes.items()
+             if nd.label == "CALL" and nd.code.startswith("memcpy")),
+            key=lambda n: len(cpg.nodes[n].code),
+        )
+        defs = in_sets.get(copy_node, set())
+        clamp_reaches = any(
+            "- 1" in cpg.nodes[d.node].code and d.var.startswith("cap")
+            for d in defs
+        )
+        assert clamp_reaches == (not vul), f"fn {i} vul={vul}"
