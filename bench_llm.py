@@ -1,0 +1,189 @@
+"""LLM fine-tune benchmark: **tokens/sec/chip** for CodeLlama-7B-shaped LoRA
+training (the north-star metric BASELINE.json names; reference anchor: the
+MSIVD HF-Trainer fine-tune loop, ``MSIVD/msivd/train.py:873-911``).
+
+Prints ONE JSON line. Protocol:
+
+- A decoder stack with CodeLlama-7B's real dims (hidden 4096, inter 11008,
+  32 heads, vocab 32016) but ``--layers`` decoder layers (default 2) so one
+  chip's HBM holds it; LoRA rank 16 on q/v, base weights frozen — exactly
+  the reference's PEFT setup. Causal-LM loss, grads on LoRA params only.
+- Strict per-step readback-sync timing (median of k), same as ``bench.py``.
+- Self-validation: compiled-step FLOPs from ``cost_analysis``, an in-process
+  chained-matmul roofline, implied TFLOP/s and MFU; any number over the
+  roofline is REFUSED (reported null with the reason).
+- Full-model extrapolation: the per-layer marginal cost is measured as
+  ``t(L) - t(L/2)`` between two compiled stacks, so the embed+head overhead
+  cancels; ``t(32) ≈ t(L) + slope × (32 - L)`` gives
+  ``est_full_model_tokens_per_sec_per_chip``.
+
+Usage: python bench_llm.py [--layers 2] [--batch 4] [--seq 512] [--steps 10]
+       python bench_llm.py --tiny     # CPU-sized smoke (CI / no TPU)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from bench import _sync, _timed, _cost_flops, measure_roofline  # shared protocol
+
+FULL_LAYERS = 32  # CodeLlama-7B
+
+
+def build_step(cfg, batch: int, seq: int, seed: int = 0):
+    """(run_once, flops, params_info): one jitted LoRA train step —
+    causal-LM loss, grads/updates on the LoRA adapters only."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from deepdfa_tpu.llm.llama import LlamaForCausalLM
+    from deepdfa_tpu.llm.lora import split_lora
+
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(3, cfg.vocab_size, (batch, seq)), jnp.int32)
+
+    params = jax.jit(lambda: model.init(jax.random.key(0), ids)["params"])()
+    # Frozen base as in PEFT: differentiate ONLY the LoRA subtree, so XLA
+    # never emits base weight-grad matmuls (activation grads still flow
+    # through every layer into earlier adapters, as they must).
+    lora_p, base_p = split_lora(params)
+
+    def combine(lora, base):
+        return jax.tree.map(
+            lambda l, b: b if l is None else l, lora, base,
+            is_leaf=lambda x: x is None,
+        )
+
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(2e-4))
+    opt_state = jax.jit(tx.init)(lora_p)
+
+    def loss_fn(lora, base, ids):
+        logits = model.apply({"params": combine(lora, base)}, ids)
+        # next-token cross entropy (the fine-tune objective's compute shape)
+        tgt = ids[:, 1:]
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        nll = -jnp.take_along_axis(lp, tgt[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    @jax.jit
+    def train_step(lora, base, opt_state, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(lora, base, ids)
+        updates, opt_state = tx.update(grads, opt_state, lora)
+        lora = optax.apply_updates(lora, updates)
+        return lora, opt_state, loss
+
+    state = {"lora": lora_p, "opt": opt_state}
+
+    def run_once():
+        state["lora"], state["opt"], loss = train_step(
+            state["lora"], base_p, state["opt"], ids
+        )
+        return loss
+
+    # compile + warm
+    _sync(run_once())
+    flops = _cost_flops(train_step, state["lora"], base_p, state["opt"], ids)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    n_lora = sum(x.size for x in jax.tree.leaves(lora_p))
+    return run_once, flops, {"n_params": int(n_params), "n_lora_params": int(n_lora)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--lora-rank", type=int, default=16)
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny dims (CPU smoke); full-model extrapolation off")
+    args = ap.parse_args()
+
+    import jax
+
+    from deepdfa_tpu.llm.llama import codellama_7b, tiny_llama
+
+    if args.tiny:
+        mk = lambda n: tiny_llama(num_hidden_layers=n, lora_rank=args.lora_rank,
+                                  max_position_embeddings=max(args.seq, 256))
+        args.batch, args.seq = min(args.batch, 2), min(args.seq, 128)
+    else:
+        mk = lambda n: codellama_7b(
+            num_hidden_layers=n, lora_rank=args.lora_rank, remat=True,
+            dtype="bfloat16",
+        )
+
+    backend = jax.default_backend()
+    roofline = measure_roofline()
+    tokens = args.batch * args.seq
+
+    run_once, flops, pinfo = build_step(mk(args.layers), args.batch, args.seq)
+    median_s, pipelined_s = _timed(run_once, args.steps)
+
+    # per-layer marginal (embed/head overhead cancels in the difference)
+    half = max(args.layers // 2, 1)
+    slope_s = None
+    if half < args.layers:
+        run_half, _, _ = build_step(mk(half), args.batch, args.seq)
+        half_s, _ = _timed(run_half, max(args.steps // 2, 3))
+        slope_s = (median_s - half_s) / (args.layers - half)
+
+    tok_per_sec = tokens / median_s
+    implied = (flops or 0.0) / median_s
+    refused = {}
+    if flops and roofline and implied > roofline:
+        refused["tokens_per_sec_per_chip"] = (
+            f"implied {implied / 1e12:.1f} TFLOP/s > roofline "
+            f"{roofline / 1e12:.1f} TFLOP/s"
+        )
+        tok_per_sec = None
+
+    est_full = None
+    if slope_s is not None and slope_s <= 0:
+        refused["est_full_model_tokens_per_sec_per_chip"] = (
+            f"non-positive per-layer slope ({slope_s * 1e3:.2f} ms) — timing "
+            "noise exceeded the half-stack difference; raise --steps"
+        )
+        slope_s = None
+    if slope_s is not None and tok_per_sec is not None:
+        t_full = median_s + slope_s * (FULL_LAYERS - args.layers)
+        est_full = tokens / t_full
+
+    result = {
+        "metric": "llm_lora_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 1) if tok_per_sec else None,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,  # the reference publishes no tokens/sec number
+        "backend": backend,
+        "model": "tiny_llama" if args.tiny else "codellama_7b_dims",
+        "layers_measured": args.layers,
+        "batch": args.batch,
+        "seq": args.seq,
+        "lora_rank": args.lora_rank,
+        "n_params": pinfo["n_params"],
+        "n_lora_params": pinfo["n_lora_params"],
+        "timing": "strict per-step readback sync, median of k",
+        "step_ms": round(median_s * 1e3, 2),
+        "pipelined_tokens_per_sec": round(tokens / pipelined_s, 1),
+        "flops_per_step": flops,
+        "implied_tflops": round(implied / 1e12, 2) if flops else None,
+        "roofline_tflops": round(roofline / 1e12, 1),
+        "mfu": round(implied / roofline, 4) if (flops and roofline) else None,
+        "per_layer_ms": round(slope_s * 1e3, 2) if slope_s is not None else None,
+        "est_full_model_tokens_per_sec_per_chip": (
+            round(est_full, 1) if est_full else None
+        ),
+        "extrapolation": f"t({args.layers}) + slope x ({FULL_LAYERS}-{args.layers}) layers",
+        "refused": refused or None,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,11 @@ self-contained random-search driver over the typed config:
 
 If the real ``nni`` package is importable (it is not in this image), trial
 results are additionally forwarded to it — gated, never required.
+
+Scale note: trials run sequentially in-process with no early-stop/pruning —
+fine for the demo corpora; HPO at real-corpus scale should run each trial in
+a subprocess (isolated XLA compilation cache + device memory, crash
+containment) and add median-pruning on the ``tuning.jsonl`` stream.
 """
 
 from __future__ import annotations
