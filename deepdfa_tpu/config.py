@@ -119,6 +119,10 @@ class BatchConfig:
     max_nodes: int = 40960  # node budget incl. 1 padding node
     max_edges: int = 81920  # edge budget
     drop_oversize: bool = True  # drop graphs that alone exceed the budget
+    # derive bucket budgets from corpus statistics (data/graphs.derive_buckets),
+    # capped by the max_nodes/max_edges ceilings above — padded FLOPs are the
+    # direct multiplier on step time, a worst-case constant budget wastes ~3x
+    auto_buckets: bool = True
 
 
 @dataclass(frozen=True)

@@ -112,8 +112,23 @@ def load_corpus(cfg: ExperimentConfig) -> dict[str, list[Graph]]:
     return _synthetic_corpus(cfg)
 
 
-def _batcher(cfg: ExperimentConfig) -> GraphBatcher:
+def _batcher(cfg: ExperimentConfig, graphs: list[Graph] | None = None) -> GraphBatcher:
+    """Fixed-shape batcher. With ``auto_buckets`` and a corpus to measure,
+    budgets come from corpus statistics (capped by the configured ceilings)
+    instead of the worst-case constants — padding is wasted FLOPs on TPU."""
     b = cfg.data.batch
+    if b.auto_buckets and graphs:
+        from deepdfa_tpu.data.graphs import derive_buckets
+
+        buckets = [
+            BucketSpec(
+                max_graphs=min(s.max_graphs, b.batch_graphs + 1),
+                max_nodes=min(s.max_nodes, b.max_nodes),
+                max_edges=min(s.max_edges, b.max_edges),
+            )
+            for s in derive_buckets(graphs, b.batch_graphs)
+        ]
+        return GraphBatcher(buckets, drop_oversize=b.drop_oversize)
     return GraphBatcher(
         [BucketSpec(b.batch_graphs + 1, b.max_nodes, b.max_edges)],
         drop_oversize=b.drop_oversize,
@@ -159,7 +174,7 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
 
     model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
     trainer = Trainer(model, cfg, pos_weight=pos_weight)
-    batcher = _batcher(cfg)
+    batcher = _batcher(cfg, train + val)
     example = jax.tree.map(jnp.asarray, next(batcher.batches(train[: cfg.data.batch.batch_graphs])))
     state = trainer.init_state(example)
     ckpts = CheckpointManager(run_dir / "checkpoints", cfg.checkpoint)
@@ -213,7 +228,7 @@ def test(
     test_graphs = corpus["test"]
     model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
     trainer = Trainer(model, cfg)
-    batcher = _batcher(cfg)
+    batcher = _batcher(cfg, test_graphs)
     example = jax.tree.map(jnp.asarray, next(batcher.batches(test_graphs)))
     state = trainer.init_state(example)
 
