@@ -145,21 +145,20 @@ def export_params_to_flax(model: TorchGGNN) -> dict:
     else:
         params["embed"] = {"embedding": model.embedding.weight.detach().numpy()}
 
+    # torch GRUCell stores weight_ih/weight_hh as (3H, H) with rows ordered
+    # r,z,n — exactly the flax GRUCell's fused x_proj/h_proj kernels,
+    # transposed (columns ordered r|z|n).
     gru = model.ggnn.gru
-    H = gru.hidden_size
-    w_ih, w_hh = gru.weight_ih.detach().numpy(), gru.weight_hh.detach().numpy()
-    b_ih, b_hh = gru.bias_ih.detach().numpy(), gru.bias_hh.detach().numpy()
-    names = ("r", "z", "n")
-    gru_params = {}
-    for j, g in enumerate(names):
-        gru_params[f"i{g}" if g != "n" else "in"] = {
-            "kernel": w_ih[j * H : (j + 1) * H].T,
-            "bias": b_ih[j * H : (j + 1) * H],
-        }
-        gru_params[f"h{g}"] = {
-            "kernel": w_hh[j * H : (j + 1) * H].T,
-            "bias": b_hh[j * H : (j + 1) * H],
-        }
+    gru_params = {
+        "x_proj": {
+            "kernel": gru.weight_ih.detach().numpy().T,
+            "bias": gru.bias_ih.detach().numpy(),
+        },
+        "h_proj": {
+            "kernel": gru.weight_hh.detach().numpy().T,
+            "bias": gru.bias_hh.detach().numpy(),
+        },
+    }
     params["ggnn"] = {"edge_linear": lin(model.ggnn.edge_linear), "gru": gru_params}
 
     if model.label_style == "graph":

@@ -41,17 +41,27 @@ __all__ = ["GGNN", "GRUCell"]
 
 class GRUCell(nn.Module):
     """GRU cell with torch ``nn.GRUCell`` gate layout (reset/update/new), the
-    update rule DGL's GatedGraphConv uses. ``features`` is the hidden width."""
+    update rule DGL's GatedGraphConv uses. ``features`` is the hidden width.
+
+    The three per-gate projections of each input are fused into ONE
+    ``(features → 3·features)`` matmul per input (columns ordered ``r|z|n`` —
+    exactly torch's ``weight_ih``/``weight_hh`` row layout, transposed), so a
+    step costs 2 MXU-shaped matmuls instead of 6 slivers. Per-output-element
+    math is unchanged: fusing along the output axis does not reorder any
+    reduction."""
 
     features: int
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
-        dense = lambda name: nn.Dense(self.features, dtype=self.dtype, name=name)
-        r = nn.sigmoid(dense("ir")(x) + dense("hr")(h))
-        z = nn.sigmoid(dense("iz")(x) + dense("hz")(h))
-        n = jnp.tanh(dense("in")(x) + r * dense("hn")(h))
+        xp = nn.Dense(3 * self.features, dtype=self.dtype, name="x_proj")(x)
+        hp = nn.Dense(3 * self.features, dtype=self.dtype, name="h_proj")(h)
+        xr, xz, xn = jnp.split(xp, 3, axis=-1)
+        hr, hz, hn = jnp.split(hp, 3, axis=-1)
+        r = nn.sigmoid(xr + hr)
+        z = nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
         return (1.0 - z) * n + z * h
 
 
@@ -184,11 +194,23 @@ class GGNN(nn.Module):
 
     def embed_nodes(self, batch: BatchedGraphs) -> jnp.ndarray:
         if self.cfg.concat_all_absdf:
-            parts = [
-                self.embeddings[sk](batch.node_feats[f"_ABS_DATAFLOW_{sk}"])
-                for sk in ALL_SUBKEYS
-            ]
-            return jnp.concatenate(parts, axis=-1)
+            # One fused gather instead of 4: stack the per-subkey tables into
+            # a (4·input_dim, embed) matrix (params-only concat — XLA hoists
+            # it out of the step), offset each subkey's ids into its table
+            # slice, gather once, and flatten (n, 4, embed) -> (n, 4·embed).
+            # Row-major reshape preserves exactly the per-subkey concat order.
+            table = jnp.concatenate(
+                [self.embeddings[sk].embedding for sk in ALL_SUBKEYS], axis=0
+            ).astype(self.compute_dtype)
+            ids = jnp.stack(
+                [
+                    batch.node_feats[f"_ABS_DATAFLOW_{sk}"] + i * self.input_dim
+                    for i, sk in enumerate(ALL_SUBKEYS)
+                ],
+                axis=-1,
+            )
+            out = jnp.take(table, ids, axis=0)
+            return out.reshape(*ids.shape[:-1], -1)
         return self.embedding(batch.node_feats["_ABS_DATAFLOW"])
 
     def __call__(self, batch: BatchedGraphs) -> jnp.ndarray:

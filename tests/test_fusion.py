@@ -209,13 +209,13 @@ def test_weight_decay_mask():
     params = {
         "dense": {"kernel": np.zeros(2), "bias": np.zeros(2)},
         "input_layernorm": {"weight": np.zeros(2)},
-        "gru": {"hr": {"kernel": np.zeros(2), "bias": np.zeros(2)}},
+        "gru": {"h_proj": {"kernel": np.zeros(2), "bias": np.zeros(2)}},
     }
     mask = weight_decay_mask(params)
     assert mask["dense"]["kernel"] is True
     assert mask["dense"]["bias"] is False
     assert mask["input_layernorm"]["weight"] is False
-    assert mask["gru"]["hr"]["kernel"] is True
+    assert mask["gru"]["h_proj"]["kernel"] is True
 
 
 def test_cosine_warmup_schedule():
