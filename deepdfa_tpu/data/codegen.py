@@ -80,7 +80,9 @@ def generate_function(fid: int, vul: bool, rng: np.random.Generator) -> dict:
     }
 
 
-def generate_hard_function(fid: int, vul: bool, rng: np.random.Generator) -> dict:
+def generate_hard_function(
+    fid: int, vul: bool, rng: np.random.Generator, chain_depth: int | None = None
+) -> dict:
     """A *dataflow-hard* (before, after) pair: both classes are built from the
     SAME statement multiset — identical per-node abstract-dataflow features,
     identical token histogram — and differ ONLY in the CFG order of two
@@ -102,6 +104,19 @@ def generate_hard_function(fid: int, vul: bool, rng: np.random.Generator) -> dic
 
     The patch (``after``) restores the safe order, so ``removed``/``added``
     line labels mirror a real reordering fix.
+
+    ``chain_depth=L`` switches to the **depth-controlled** variant (the
+    union-vs-sum separation corpus, round-3): the two defs are separated by
+    exactly ``L`` branch-merge statements over unrelated variables, and the
+    copy follows immediately after the second def. Around every statement the
+    two classes are locally identical (same taint, same clamp, same gap
+    multiset); telling WHICH def comes last — i.e. which one reaches the
+    ``memcpy`` — requires integrating order information across ≥ L CFG hops.
+    Each gap ``if`` is a reconvergent diamond, so defs re-arrive along
+    multiple paths: a sum aggregator accumulates path-multiplicity counts
+    while an idempotent union (a∪a=a, the RD lattice meet) does not — the
+    regime where the reference's differentiable-DFA aggregator
+    (``clipper.py:50-77``) should earn its keep.
     """
     a, b, c = _names(rng, 3)
     k1 = int(rng.integers(2, 9))
@@ -110,34 +125,51 @@ def generate_hard_function(fid: int, vul: bool, rng: np.random.Generator) -> dic
 
     taint = f"    {cap} = (int)strlen({c});"
     clamp = f"    if ({cap} >= {k2}) {{ {cap} = {k2} - 1; }}"
-    gap_pool = [
-        f"    int {a} = {k1};",
-        f"    int {b} = {a} + {k1};" if rng.random() < 0.5 else f"    int {b} = {k1} * 2;",
-        f"    if ({a} > {k1}) {{ {a} = {a} - 1; }}",
-        f"    for (int i = 0; i < {k1}; i++) {{ {b} += i; }}",
-        f"    {b} = {b} ^ {a};",
-        f"    while ({a} > 0) {{ {a} -= 1; }}",
-        f"    {a} = {a} + {b};",
-        f"    if ({b} > {a}) {{ {b} = {a}; }}",
-    ]
-    n_gap = int(rng.integers(0, 9))
-    gap = [gap_pool[i] for i in sorted(rng.choice(len(gap_pool), min(n_gap, len(gap_pool)), replace=False))]
+
+    if chain_depth is None:
+        gap_pool = [
+            f"    int {a} = {k1};",
+            f"    int {b} = {a} + {k1};" if rng.random() < 0.5 else f"    int {b} = {k1} * 2;",
+            f"    if ({a} > {k1}) {{ {a} = {a} - 1; }}",
+            f"    for (int i = 0; i < {k1}; i++) {{ {b} += i; }}",
+            f"    {b} = {b} ^ {a};",
+            f"    while ({a} > 0) {{ {a} -= 1; }}",
+            f"    {a} = {a} + {b};",
+            f"    if ({b} > {a}) {{ {b} = {a}; }}",
+        ]
+        n_gap = int(rng.integers(0, 9))
+        gap = [gap_pool[i] for i in sorted(rng.choice(len(gap_pool), min(n_gap, len(gap_pool)), replace=False))]
+        between: list[str] = []
+    else:
+        # L branch-merge diamonds BETWEEN the defs; nothing after the second
+        # def, so receptive-field distance to the copy is exactly the chain.
+        between = [
+            f"    if ({a} > {int(rng.integers(0, 99))}) {{ {b} = {b} + {i}; }}"
+            for i in range(chain_depth)
+        ]
+        gap = []
 
     head = f"int f{fid}(char *{c}, int n)"
-    decl = [f"    char dst{fid}[{k2}];", f"    int {cap} = 0;"]
+    decl = [f"    char dst{fid}[{k2}];", f"    int {cap} = 0;",
+            f"    int {a} = n; int {b} = {k1};"] if chain_depth is not None else [
+            f"    char dst{fid}[{k2}];", f"    int {cap} = 0;"]
     copy = f"    memcpy(dst{fid}, {c}, {cap});"
     tail = f"    return {cap};"
 
-    def render(order: list[str]) -> str:
-        return "\n".join([head, "{", *decl, *order, *gap, copy, tail, "}"])
+    def render(first: str, second: str) -> str:
+        return "\n".join(
+            [head, "{", *decl, first, *between, second, *gap, copy, tail, "}"]
+        )
 
-    before = render([clamp, taint] if vul else [taint, clamp])
-    after = render([taint, clamp])
+    before = render(clamp, taint) if vul else render(taint, clamp)
+    after = render(taint, clamp)
+    n_decl = len(decl)
     if vul:
-        taint_line_before = 4 + 2  # head, "{", 2 decls, clamp, then taint
-        copy_line = 4 + 2 + len(gap) + 1
+        # 1-based: head, "{", decls, first def, between..., second def (taint)
+        taint_line_before = 2 + n_decl + 1 + len(between) + 1
+        copy_line = taint_line_before + len(gap) + 1
         removed = [taint_line_before, copy_line]
-        added = [4 + 1]  # taint moved before the clamp in `after`
+        added = [2 + n_decl + 1]  # taint moved before the clamp in `after`
     else:
         removed, added = [], []
     return {
@@ -151,14 +183,28 @@ def generate_hard_function(fid: int, vul: bool, rng: np.random.Generator) -> dic
 
 
 def demo_corpus(
-    n: int = 200, vul_ratio: float = 0.5, seed: int = 0, style: str = "easy"
+    n: int = 200,
+    vul_ratio: float = 0.5,
+    seed: int = 0,
+    style: str = "easy",
+    chain_depth: int | None = None,
 ) -> pd.DataFrame:
     """Balanced-ish labeled corpus (the sample CSV analogue: 100 vul +
     100 non-vul in the reference's sample mode). ``style="hard"`` uses the
-    dataflow-hard generator (identical feature histograms across classes)."""
-    gen = generate_hard_function if style == "hard" else generate_function
+    dataflow-hard generator (identical feature histograms across classes);
+    ``chain_depth=L`` additionally pins the def→def CFG distance (the
+    union-vs-sum separation corpus, dataset name ``demo_chain{L}``)."""
+    import functools
+
     rng = np.random.default_rng(seed)
+    if chain_depth is not None:
+        gen = functools.partial(generate_hard_function, chain_depth=chain_depth)
+        dataset = f"demo_chain{chain_depth}"
+    elif style == "hard":
+        gen, dataset = generate_hard_function, "demo_hard"
+    else:
+        gen, dataset = generate_function, "demo"
     rows = [gen(fid, bool(rng.random() < vul_ratio), rng) for fid in range(n)]
     df = pd.DataFrame(rows)
-    df["dataset"] = "demo" if style == "easy" else "demo_hard"
+    df["dataset"] = dataset
     return df

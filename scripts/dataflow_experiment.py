@@ -95,28 +95,63 @@ def feature_lr_baseline(seed: int = 0) -> dict:
             "feature_lr_train_acc": round(train_acc, 4)}
 
 
-def _hard_cfg(cfg, **model_overrides):
+def _hard_cfg(cfg, dsname: str = "demo_hard", **model_overrides):
     import dataclasses
 
     return dataclasses.replace(
         cfg,
-        data=dataclasses.replace(cfg.data, dsname="demo_hard"),
+        data=dataclasses.replace(cfg.data, dsname=dsname),
         model=dataclasses.replace(cfg.model, **model_overrides),
     )
 
 
-def run_ggnn(run_dir: Path, epochs: int, **model_overrides) -> dict:
+def run_ggnn(run_dir: Path, epochs: int, dsname: str = "demo_hard", **model_overrides) -> dict:
     import dataclasses
 
     from deepdfa_tpu.config import ExperimentConfig
     from deepdfa_tpu.train import cli
 
     cfg = ExperimentConfig()
-    cfg = _hard_cfg(cfg, **model_overrides)
+    cfg = _hard_cfg(cfg, dsname=dsname, **model_overrides)
     cfg = dataclasses.replace(cfg, optim=dataclasses.replace(cfg.optim, max_epochs=epochs))
     run_dir.mkdir(parents=True, exist_ok=True)
     cli.fit(cfg, run_dir)
     return cli.test(cfg, run_dir)
+
+
+def chain_sweep(args) -> dict:
+    """Union-vs-sum separation curves (round-3, VERDICT #4): for each def→def
+    CFG distance L, train the golden GGNN on ``demo_chain{L}`` with
+    aggregation ∈ {sum, union_relu} at the golden depth (n_steps=5) and at a
+    chain-covering depth (n_steps=L+3). The class is decided by WHICH
+    definition reaches the memcpy across L reconvergent diamonds — the regime
+    where the idempotent union lattice (``clipper.py:50-77``) and the sum
+    aggregator must diverge (or measurably don't; either way the curve is the
+    evidence).
+    """
+    from scripts import preprocess as pp
+
+    depths = [int(x) for x in args.chain_sweep.split(",")]
+    out = Path(args.out)
+    curves: dict = {"n": args.n, "epochs": args.epochs, "depths": depths, "runs": {}}
+    for L in depths:
+        ds = f"demo_chain{L}"
+        summary = pp.main(["--dataset", ds, "--n", str(args.n),
+                           "--seed", str(args.seed), "--overwrite"])
+        if summary.get("graphs") != args.n:
+            raise RuntimeError(f"corpus build mismatch for {ds}: {summary}")
+        for agg in ("sum", "union_relu"):
+            for steps in sorted({5, L + 3}):
+                key = f"L{L}_{agg}_n{steps}"
+                r = run_ggnn(out / key, args.epochs, dsname=ds,
+                             aggregation=agg, n_steps=steps)
+                curves["runs"][key] = {
+                    "f1": round(float(r["test_F1Score"]), 4),
+                    "acc": round(float(r["test_Accuracy"]), 4),
+                }
+                print(f"{key}: {curves['runs'][key]}", file=sys.stderr)
+    print(json.dumps(curves))
+    return curves
 
 
 def main(argv=None):
@@ -125,7 +160,13 @@ def main(argv=None):
     ap.add_argument("--epochs", type=int, default=25)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="runs/dataflow_experiment")
+    ap.add_argument("--chain-sweep", default=None, metavar="L1,L2,...",
+                    help="run the union-vs-sum chain-depth separation sweep "
+                         "instead of the standard experiment")
     args = ap.parse_args(argv)
+
+    if args.chain_sweep:
+        return chain_sweep(args)
 
     from scripts import preprocess as pp
 

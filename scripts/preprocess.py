@@ -165,12 +165,17 @@ def main(argv=None) -> dict:
         return {"status": "exists", "out": str(out_dir)}
 
     # 1. ingest
-    if args.dataset in ("demo", "demo_hard"):
+    if args.dataset in ("demo", "demo_hard") or args.dataset.startswith("demo_chain"):
         from deepdfa_tpu.data.codegen import demo_corpus
 
+        chain_depth = (
+            int(args.dataset[len("demo_chain"):])
+            if args.dataset.startswith("demo_chain") else None
+        )
         df = demo_corpus(
             args.n if not args.sample else min(args.n, 60), seed=args.seed,
-            style="hard" if args.dataset == "demo_hard" else "easy",
+            style="hard" if args.dataset != "demo" else "easy",
+            chain_depth=chain_depth,
         )
         graph_level = False
     else:
