@@ -169,7 +169,11 @@ class JoernSession:
         entry point with marshalled parameters.
 
         Ammonite ``$file`` imports are cwd-relative and dotted, so scripts
-        outside the session cwd are staged into ``.deepdfa_joern/`` first.
+        outside the session cwd are staged into ``deepdfa_joern_scripts/``
+        first. Every path segment must be a valid Scala identifier — a
+        dotted/hidden directory name would render as ``import $file..foo``
+        and fail to parse (the reference's ``storage.external`` import obeys
+        the same constraint, ``joern_session.py:81-86``).
         """
         src = Path(script_dir) / f"{script}.sc"
         if not src.exists():
@@ -177,11 +181,17 @@ class JoernSession:
         try:
             rel = src.resolve().relative_to(self.cwd.resolve())
         except ValueError:
-            stage = self.cwd / ".deepdfa_joern"
+            stage = self.cwd / "deepdfa_joern_scripts"
             stage.mkdir(exist_ok=True)
             shutil.copyfile(src, stage / src.name)
-            rel = Path(".deepdfa_joern") / src.name
+            rel = Path("deepdfa_joern_scripts") / src.name
         dotted = ".".join(rel.with_suffix("").parts)
+        if not all(re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", p)
+                   for p in rel.with_suffix("").parts):
+            raise ValueError(
+                f"script path {rel} has segments that are not valid Scala "
+                "identifiers — Ammonite $file imports cannot express it"
+            )
         self.run_command(f"import $file.{dotted}")
         return self.run_command(
             f"{script}.exec({marshal_params(params)})", timeout=timeout

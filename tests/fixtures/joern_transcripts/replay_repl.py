@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""Transcript-replay stand-in for the ``joern`` REPL.
+
+Loads a transcript (JSON: ``{"banner": str, "exchanges": [{"expect": str,
+"reply": str}, ...]}``) from ``$JOERN_TRANSCRIPT`` and replays it over
+stdin/stdout with pipe semantics (no echo — the driver uses subprocess pipes,
+not a pty). Every received line must match the next exchange's ``expect``
+EXACTLY; on mismatch it prints a diagnosable error WITHOUT a prompt and exits
+nonzero, so the driver's reader loop surfaces it as "REPL exited
+unexpectedly" with the mismatch text in the buffer.
+
+``{CWD}`` placeholders in the transcript are substituted with the process
+cwd at load time, so transcripts can reference session-local paths.
+
+The exit protocol mirrors Joern's: ``exit`` asks a y/N question with no
+prompt; ``y`` terminates cleanly.
+"""
+
+import json
+import os
+import sys
+
+PROMPT = "\x1b[32mjoern>\x1b[0m "  # colored: the driver must find it anyway
+
+
+def main() -> int:
+    with open(os.environ["JOERN_TRANSCRIPT"]) as f:
+        transcript = json.load(f)
+    cwd = os.getcwd()
+    subst = lambda s: s.replace("{CWD}", cwd)
+
+    out = sys.stdout
+    out.write(subst(transcript.get("banner", "")) + PROMPT)
+    out.flush()
+    exchanges = list(transcript["exchanges"])
+    i = 0
+    for line in sys.stdin:
+        line = line.rstrip("\n")
+        if line == "exit":
+            out.write("The Joern server will be stopped... Would you like to "
+                      "save changes? [y/N]\n")
+            out.flush()
+            continue
+        if line == "y":
+            return 0
+        if i >= len(exchanges):
+            out.write(f"TRANSCRIPT EXHAUSTED: unexpected command {line!r}\n")
+            out.flush()
+            return 1
+        exp = subst(exchanges[i]["expect"])
+        if line != exp:
+            out.write(
+                f"TRANSCRIPT MISMATCH at exchange {i}:\n"
+                f"  got:  {line!r}\n  want: {exp!r}\n"
+            )
+            out.flush()
+            return 1
+        out.write(subst(exchanges[i]["reply"]) + "\n" + PROMPT)
+        out.flush()
+        i += 1
+    return 0 if i == len(exchanges) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

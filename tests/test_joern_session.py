@@ -91,7 +91,7 @@ def test_session_run_script_stages_and_marshals(fake_joern, tmp_path):
             "export_func_graph", {"filename": "f.c", "exportCpg": False}
         )
         # the shipped script was staged into the session cwd and imported
-        assert (tmp_path / ".deepdfa_joern" / "export_func_graph.sc").exists()
+        assert (tmp_path / "deepdfa_joern_scripts" / "export_func_graph.sc").exists()
         assert out == 'ack:export_func_graph.exec(filename="f.c", exportCpg=false)'
     finally:
         sess.close()
