@@ -1,0 +1,50 @@
+"""Seeded golden-quality regression gate (VERDICT r02 #5): the committed
+config + seed on the generated-C corpora must reach the committed test-F1
+floor (``configs/golden_quality.json``), so model-quality drift fails loudly
+the way parity drift already does. Reference protocol analogue:
+``scripts/performance_evaluation.sh:1-9`` (fixed-config train+test runs).
+
+Full pipeline per corpus: codegen → native frontend → RD features → vocab →
+shards → fit → best-ckpt test. ~30s each on CPU.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+GOLDEN = json.loads(
+    (Path(__file__).parent.parent / "configs" / "golden_quality.json").read_text()
+)
+
+
+@pytest.fixture()
+def storage(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    return tmp_path
+
+
+@pytest.mark.parametrize("dsname", ["demo", "demo_hard"])
+def test_golden_quality_floor(storage, tmp_path, dsname):
+    from scripts import preprocess as pp
+
+    from deepdfa_tpu.train import cli
+
+    spec = GOLDEN[dsname]
+    summary = pp.main(["--dataset", dsname, "--n", str(spec["n"]),
+                       "--seed", str(spec["corpus_seed"])])
+    assert summary.get("graphs") == spec["n"], summary
+
+    overrides = [
+        "--set", f"optim.max_epochs={spec['max_epochs']}",
+        "--set", f"data.dsname={dsname}",
+        "--set", f"seed={spec['train_seed']}",
+    ]
+    run_dir = tmp_path / f"golden_{dsname}"
+    cli.main(["fit", "--run-dir", str(run_dir), *overrides])
+    res = cli.main(["test", "--run-dir", str(run_dir), *overrides])
+    f1 = float(res["test_F1Score"])
+    assert f1 >= spec["min_test_f1"], (
+        f"golden-quality drift on {dsname}: test F1 {f1:.4f} < floor "
+        f"{spec['min_test_f1']} (committed band: configs/golden_quality.json)"
+    )
