@@ -123,6 +123,104 @@ def strip_comments(code: str) -> str:
     return re.sub(pattern, repl, code, flags=re.DOTALL)
 
 
+def _blank_span(text: str) -> str:
+    """Replace a span with spaces, preserving newlines (and therefore every
+    line/column the parser will report)."""
+    return "".join(ch if ch == "\n" else " " for ch in text)
+
+
+def _match_paren(code: str, i: int) -> int | None:
+    """Index just past the ``)`` matching the ``(`` at ``i`` — skipping
+    parens inside string/char literals (extended asm templates contain
+    them, e.g. ``asm("save (" ::: "memory")``)."""
+    depth = 0
+    k = i
+    while k < len(code):
+        ch = code[k]
+        if ch in "\"'":
+            quote = ch
+            k += 1
+            while k < len(code) and code[k] != quote:
+                k += 2 if code[k] == "\\" else 1
+        elif ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return k + 1
+        k += 1
+    return None
+
+
+def _scrub_kw_parens(code: str, keyword_re: re.Pattern, repl: str) -> str:
+    """Blank every ``keyword (...balanced...)`` construct, substituting
+    ``repl`` at the keyword position (length-padded)."""
+    out = []
+    pos = 0
+    while True:
+        m = keyword_re.search(code, pos)
+        if not m:
+            out.append(code[pos:])
+            return "".join(out)
+        i = code.find("(", m.end() - 1)
+        j = _match_paren(code, i) if i >= 0 else None
+        if i < 0 or j is None:  # unbalanced — leave for the parser to report
+            out.append(code[pos:m.end()])
+            pos = m.end()
+            continue
+        span = code[m.start():j]
+        blanked = _blank_span(span)
+        out.append(code[pos:m.start()])
+        out.append(repl + blanked[len(repl):] if len(repl) <= len(blanked) else repl)
+        pos = j
+
+
+_ATTR_RE = re.compile(r"\b__attribute__\s*(?=\()")
+_ASM_RE = re.compile(r"\b(?:__asm__|__asm|asm)\b\s*(?:__volatile__|volatile)?\s*(?=\()")
+_TYPEOF_RE = re.compile(r"\b(?:__typeof__|__typeof|typeof)\s*(?=\()")
+# GNU spelling → standard spelling, length-padded so columns survive
+_GNU_TOKEN_MAP = [
+    (re.compile(r"\b__restrict__\b"), "restrict"),
+    (re.compile(r"\b__restrict\b"), "restrict"),
+    (re.compile(r"\b__inline__\b"), "inline"),
+    (re.compile(r"\b__inline\b"), "inline"),
+    (re.compile(r"\b__volatile__\b"), "volatile"),
+    (re.compile(r"\b__signed__\b"), "signed"),
+    (re.compile(r"\b__const\b"), "const"),
+    (re.compile(r"\b__extension__\b"), ""),
+]
+_CASE_RANGE_RE = re.compile(r"(\bcase\b[^:\n]*?)\.\.\.[^:\n]*(:)")
+# an ALL-CAPS call alone on a line with the block opener on the next line —
+# the `LIST_FOREACH(x, list)\n{` shape of statement-like macros; appending a
+# `;` turns it into a call statement followed by a plain block, keeping the
+# block's statements in the CFG
+_MACRO_BLOCK_RE = re.compile(
+    r"^([ \t]*[A-Z][A-Z0-9_]*\s*\([^;{}\n]*\))(?=[ \t]*(?:\n\s*)?\{)",
+    re.MULTILINE,
+)
+
+
+def _scrub_gnu_extensions(code: str) -> str:
+    """Cheap, line/column-preserving scrubs for the constructs a header-less
+    Big-Vul-style function actually contains but pycparser cannot eat:
+    ``__attribute__((...))``, (extended) asm, ``typeof(x)`` (degraded to
+    ``int`` — extraction cares about the CFG/def-use shape, not the inferred
+    type), GNU keyword spellings, ``case a ... b:`` ranges, and statement
+    macros that open a block. Everything is blanked with spaces, never
+    removed, so parser positions keep pointing at the original source."""
+    code = _scrub_kw_parens(code, _ATTR_RE, "")
+    code = _scrub_kw_parens(code, _ASM_RE, "")
+    code = _scrub_kw_parens(code, _TYPEOF_RE, "int")
+    for pat, repl in _GNU_TOKEN_MAP:
+        code = pat.sub(lambda m, r=repl: r + " " * (len(m.group(0)) - len(r)), code)
+    code = _CASE_RANGE_RE.sub(
+        lambda m: m.group(1) + " " * (len(m.group(0)) - len(m.group(1)) - 1) + m.group(2),
+        code,
+    )
+    code = _MACRO_BLOCK_RE.sub(lambda m: m.group(1) + ";", code)
+    return code
+
+
 def _preprocess(code: str) -> str:
     code = strip_comments(code)
     lines = []
@@ -131,7 +229,7 @@ def _preprocess(code: str) -> str:
             lines.append("")  # keep line numbering
         else:
             lines.append(ln)
-    return "\n".join(lines)
+    return _scrub_gnu_extensions("\n".join(lines))
 
 
 _PARSE_ERR_RE = re.compile(r":(\d+):(\d+): before: (\S+)")

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Hostile-C torture corpus for the native frontend (VERDICT r02 #6).
+
+Real Big-Vul functions arrive macro-ridden, K&R-flavoured and full of GNU
+extensions; the reference shrugs these into ``failed_joern.txt``
+(``DDFA/sastvd/scripts/getgraphs.py:57-59``) and this framework mirrors that
+failure protocol — but the *rate* must be measured, not guessed. This script
+parses a labelled torture corpus through :func:`deepdfa_tpu.cpg.frontend.
+parse_source` and prints ONE JSON line: per-class pass/fail, overall
+``failed_rate`` and the top failure classes, for BASELINE.md.
+
+Each case is (class, name, source). Classes group the constructs VERDICT
+named: function-like macros, do{}while(0), attribute specifiers, old-style
+(K&R) params, nested function-pointer typedefs, plus the GNU/asm extensions
+Big-Vul's kernel-heavy corpus actually contains.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+CASES: list[tuple[str, str, str]] = [
+    # -- function-like macros ------------------------------------------------
+    ("macro_call", "macro_stmt_with_semi", """
+#define CHECK(x) if (!(x)) return -1
+int f(int a) {
+    CHECK(a > 0);
+    return a;
+}
+"""),
+    ("macro_call", "macro_expr_in_init", """
+#define MAX(a, b) ((a) > (b) ? (a) : (b))
+int f(int a, int b) {
+    int m = MAX(a, b);
+    return m;
+}
+"""),
+    ("macro_call", "list_foreach_block", """
+int f(int *list, int n) {
+    int total = 0;
+    FOR_EACH(i, n) {
+        total += list[i];
+    }
+    return total;
+}
+"""),
+    # -- do {} while(0) ------------------------------------------------------
+    ("do_while_0", "plain", """
+int f(int a) {
+    do { a += 1; } while (0);
+    return a;
+}
+"""),
+    ("do_while_0", "nested_macroish", """
+int f(int a, int b) {
+    do {
+        if (a > b) { a = b; }
+        do { b -= 1; } while (0);
+    } while (0);
+    return a + b;
+}
+"""),
+    # -- attribute specifiers ------------------------------------------------
+    ("attributes", "attr_on_function", """
+__attribute__((noinline)) int f(int a) {
+    return a * 2;
+}
+"""),
+    ("attributes", "attr_on_var", """
+int f(int n) {
+    int buf[16] __attribute__((aligned(8)));
+    buf[0] = n;
+    return buf[0];
+}
+"""),
+    ("attributes", "packed_struct_param", """
+struct s { int a; char b; } __attribute__((packed));
+int f(struct s *p) {
+    return p->a + p->b;
+}
+"""),
+    # -- old-style (K&R) params ----------------------------------------------
+    ("knr_params", "classic", """
+int f(a, b)
+int a;
+char b;
+{
+    return a + b;
+}
+"""),
+    ("knr_params", "pointer_param", """
+int len(s)
+char *s;
+{
+    int n = 0;
+    while (*s++) n++;
+    return n;
+}
+"""),
+    # -- nested typedefs of function pointers --------------------------------
+    ("fnptr_typedef", "simple", """
+typedef int (*cb_t)(int, int);
+int f(cb_t cb, int a) {
+    return cb(a, a + 1);
+}
+"""),
+    ("fnptr_typedef", "nested", """
+typedef int (*inner_t)(int);
+typedef inner_t (*outer_t)(inner_t, int);
+int f(outer_t get, inner_t dflt, int x) {
+    inner_t g = get(dflt, x);
+    return g(x);
+}
+"""),
+    ("fnptr_typedef", "struct_of_callbacks", """
+typedef void (*handler_t)(void *, int);
+struct ops { handler_t on_read; handler_t on_close; };
+int f(struct ops *o, void *ctx, int fd) {
+    o->on_read(ctx, fd);
+    o->on_close(ctx, fd);
+    return 0;
+}
+"""),
+    # -- GNU extensions ------------------------------------------------------
+    ("gnu_ext", "inline_restrict", """
+static __inline__ int f(int *__restrict p, int n) {
+    return p[n];
+}
+"""),
+    ("gnu_ext", "typeof_decl", """
+int f(int a) {
+    typeof(a) b = a + 1;
+    return b;
+}
+"""),
+    ("gnu_ext", "statement_expr", """
+int f(int a) {
+    int b = ({ int t = a * 2; t + 1; });
+    return b;
+}
+"""),
+    ("gnu_ext", "asm_stmt", """
+int f(int a) {
+    __asm__ __volatile__("nop");
+    return a;
+}
+"""),
+    ("gnu_ext", "case_range", """
+int f(int a) {
+    switch (a) {
+    case 0 ... 9: return 1;
+    default: return 0;
+    }
+}
+"""),
+    ("gnu_ext", "asm_paren_in_string", """
+int f(int y) {
+    int x;
+    asm volatile("# save ( state" ::: "memory");
+    x = y + 1;
+    return x;
+}
+"""),
+    # -- unknown typedefs (header-less reality) ------------------------------
+    ("unknown_types", "size_t_family", """
+size_t f(const char *s, size_t n) {
+    size_t i;
+    for (i = 0; i < n && s[i]; i++) ;
+    return i;
+}
+"""),
+    ("unknown_types", "project_types", """
+static gint f(GObject *obj, guint flags) {
+    gint rc = 0;
+    if (obj != NULL) rc = (gint) flags;
+    return rc;
+}
+"""),
+    ("unknown_types", "ptr_decl_ambiguity", """
+int f(int n) {
+    mytype *p = 0;
+    othertype *q = p;
+    return n + (q == 0);
+}
+"""),
+    # -- misc hostile shapes ---------------------------------------------------
+    ("misc", "bitfields", """
+struct flags { unsigned a : 1; unsigned b : 3; };
+int f(struct flags fl) {
+    return fl.a + fl.b;
+}
+"""),
+    ("misc", "varargs", """
+int f(int n, ...) {
+    return n;
+}
+"""),
+    ("misc", "goto_labels", """
+int f(int n) {
+    int i = 0;
+retry:
+    i++;
+    if (i < n) goto retry;
+    return i;
+}
+"""),
+    ("misc", "conditional_compilation", """
+int f(int a) {
+#ifdef BIG
+    int scale = 10;
+#else
+    int scale = 2;
+#endif
+    return a * scale;
+}
+"""),
+]
+
+
+def run(cases=CASES) -> dict:
+    from deepdfa_tpu.cpg.frontend import parse_source
+
+    per_class: dict[str, dict] = {}
+    failures: list[dict] = []
+    for cls, name, src in cases:
+        entry = per_class.setdefault(cls, {"pass": 0, "fail": 0})
+        try:
+            cpg = parse_source(src)
+            assert len(cpg), "empty CPG"
+            entry["pass"] += 1
+        except Exception as exc:  # noqa: BLE001 — failure-file protocol
+            entry["fail"] += 1
+            failures.append(
+                {"class": cls, "case": name,
+                 "error": f"{type(exc).__name__}: {str(exc)[:120]}"}
+            )
+    n = len(cases)
+    top = Counter(f["class"] for f in failures).most_common(3)
+    return {
+        "metric": "frontend_torture_failed_rate",
+        "failed_rate": round(len(failures) / n, 4),
+        "cases": n,
+        "per_class": per_class,
+        "top_failure_classes": [{"class": c, "fails": k} for c, k in top],
+        "failures": failures,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))
