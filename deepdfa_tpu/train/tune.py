@@ -19,10 +19,14 @@ self-contained random-search driver over the typed config:
 If the real ``nni`` package is importable (it is not in this image), trial
 results are additionally forwarded to it — gated, never required.
 
-Scale note: trials run sequentially in-process with no early-stop/pruning —
-fine for the demo corpora; HPO at real-corpus scale should run each trial in
-a subprocess (isolated XLA compilation cache + device memory, crash
-containment) and add median-pruning on the ``tuning.jsonl`` stream.
+NNI-practice parity (round-3): ``isolate=True`` runs every trial in a fresh
+subprocess — its own XLA client, compilation cache and device memory die with
+it, so peak parent RSS stays flat across a long sweep and a crashing trial
+cannot take the sweep down. ``pruner=MedianPruner(...)`` watches each live
+trial's ``tuning.jsonl`` stream and kills it early when its intermediate val
+F1 falls below the median of prior trials at the same epoch (NNI's
+``Medianstop`` assessor); pruned trials keep their best-so-far F1 as the
+objective, exactly as NNI scores early-stopped trials.
 """
 
 from __future__ import annotations
@@ -31,6 +35,10 @@ import dataclasses
 import itertools
 import json
 import logging
+import os
+import subprocess
+import sys
+import time
 from pathlib import Path
 from typing import Any, Iterator, Mapping, Sequence
 
@@ -38,7 +46,14 @@ import numpy as np
 
 logger = logging.getLogger("deepdfa_tpu")
 
-__all__ = ["Trial", "sample_space", "grid_space", "run_trials", "best_trial"]
+__all__ = [
+    "Trial",
+    "MedianPruner",
+    "sample_space",
+    "grid_space",
+    "run_trials",
+    "best_trial",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,12 +62,36 @@ class Trial:
     overrides: dict[str, Any]
     metrics: dict[str, float]
     error: str | None = None  # set when the trial raised; objective is -inf
+    pruned: bool = False  # stopped early by the pruner; metrics = best-so-far
 
     @property
     def objective(self) -> float:
         if self.error is not None:
             return float("-inf")
         return self.metrics.get("val_F1Score", float("-inf"))
+
+
+@dataclasses.dataclass
+class MedianPruner:
+    """NNI ``Medianstop``: kill a trial whose val F1 at epoch *e* is below
+    the median of all prior trials' F1 at epoch *e* — after ``warmup_epochs``
+    and only once ``min_history`` prior curves reach that epoch."""
+
+    warmup_epochs: int = 2
+    min_history: int = 2
+    poll_seconds: float = 0.25
+    histories: list[list[float]] = dataclasses.field(default_factory=list)
+
+    def should_prune(self, epoch: int, f1: float) -> bool:
+        if epoch < self.warmup_epochs:
+            return False
+        at_epoch = [h[epoch] for h in self.histories if len(h) > epoch]
+        if len(at_epoch) < self.min_history:
+            return False
+        return f1 < float(np.median(at_epoch))
+
+    def record(self, curve: list[float]) -> None:
+        self.histories.append(curve)
 
 
 def sample_space(
@@ -71,18 +110,113 @@ def grid_space(space: Mapping[str, Sequence[Any]]) -> Iterator[dict[str, Any]]:
         yield dict(zip(keys, combo))
 
 
+_WORKER_SNIPPET = (
+    "import json, sys\n"
+    "from pathlib import Path\n"
+    "spec = json.loads(Path(sys.argv[1]).read_text())\n"
+    "from deepdfa_tpu.config import load_config\n"
+    "from deepdfa_tpu.train import cli\n"
+    "cfg = load_config(*spec['configs'], overrides=spec['overrides'])\n"
+    "cli.fit(cfg, Path(spec['run_dir']))\n"
+)
+
+
+def _read_curve(tuning_file: Path) -> list[float]:
+    """Per-epoch val F1 curve from a (possibly still-growing) tuning.jsonl."""
+    if not tuning_file.exists():
+        return []
+    curve: list[float] = []
+    for line in tuning_file.read_text().splitlines():
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:  # torn tail of an in-flight write
+            break
+        if "epoch" in row:
+            curve.append(float(row["val_F1Score"]))
+    return curve
+
+
+def _run_trial_isolated(
+    spec: dict, run_dir: Path, pruner: MedianPruner | None
+) -> tuple[dict, str | None, bool]:
+    """One trial in a fresh subprocess (own XLA client / compile cache /
+    device memory); the parent tails ``tuning.jsonl`` for the pruner.
+    Returns (metrics, error, pruned)."""
+    spec_path = run_dir / "trial_spec.json"
+    spec_path.write_text(json.dumps(spec))
+    repo_root = Path(__file__).resolve().parents[2]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{repo_root}{os.pathsep}{env.get('PYTHONPATH', '')}"
+    # A tunnel-device platform pin without its pool env is unreachable in the
+    # child (the plugin only registers when the pool var is set — the test
+    # harness pops it); drop the pin and let jax pick an available backend.
+    if "axon" in env.get("JAX_PLATFORMS", "") and "PALLAS_AXON_POOL_IPS" not in env:
+        env.pop("JAX_PLATFORMS", None)
+    stderr_path = run_dir / "trial_stderr.log"
+    with open(stderr_path, "w") as stderr_f:
+        # stderr goes to a file, not a pipe: a chatty child (XLA warnings,
+        # long tracebacks) would fill a pipe buffer and deadlock the sweep
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _WORKER_SNIPPET, str(spec_path)],
+            env=env,
+            cwd=repo_root,
+            stdout=subprocess.DEVNULL,
+            stderr=stderr_f,
+            text=True,
+        )
+        tuning_file = run_dir / "tuning.jsonl"
+        pruned = False
+        curve: list[float] = []
+        while proc.poll() is None:
+            time.sleep(pruner.poll_seconds if pruner else 0.5)
+            if pruner is None:
+                continue
+            curve = _read_curve(tuning_file)
+            for epoch in range(len(curve)):
+                if pruner.should_prune(epoch, curve[epoch]):
+                    proc.kill()
+                    proc.wait()
+                    pruned = True
+                    break
+            if pruned:
+                break
+    stderr = stderr_path.read_text() if stderr_path.exists() else ""
+    curve = _read_curve(tuning_file)
+    if pruner is not None:
+        pruner.record(curve)
+    if pruned:
+        best = max(curve) if curve else float("-inf")
+        return {"val_F1Score": best}, None, True
+    if proc.returncode != 0:
+        return {}, f"trial subprocess rc={proc.returncode}: {stderr[-500:]}", False
+    final = run_dir / "final_metrics.json"
+    metrics = json.loads(final.read_text()) if final.exists() else {}
+    return metrics, None, False
+
+
 def run_trials(
     candidates: Iterator[dict[str, Any]],
     out_dir: str | Path,
     configs: Sequence[str] = (),
     base_overrides: Mapping[str, Any] | None = None,
+    isolate: bool = False,
+    pruner: MedianPruner | None = None,
 ) -> list[Trial]:
     """Run one ``fit`` per candidate override-set; log every trial to
     ``trials.jsonl``. Failures are recorded (objective -inf), not raised —
-    a bad hyperparameter draw must not kill the sweep."""
-    from deepdfa_tpu.config import load_config
-    from deepdfa_tpu.train import cli
+    a bad hyperparameter draw must not kill the sweep.
 
+    ``isolate=True``: subprocess per trial (fresh XLA client; flat parent
+    RSS; crash containment — the parent never even imports the training
+    stack). ``pruner``: median early-stopping on the live ``tuning.jsonl``
+    stream (requires ``isolate=True``)."""
+    if pruner is not None and not isolate:
+        raise ValueError("pruning requires isolate=True (a live child to stop)")
+    if not isolate:
+        # import once, outside the per-trial try: a broken environment must
+        # raise, not masquerade as N failed hyperparameter draws
+        from deepdfa_tpu.config import load_config
+        from deepdfa_tpu.train import cli
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     trials_file = out_dir / "trials.jsonl"
@@ -92,23 +226,36 @@ def run_trials(
         run_dir = out_dir / f"trial_{i}"
         run_dir.mkdir(parents=True, exist_ok=True)
         error = None
+        pruned = False
         metrics: dict = {}
-        try:
-            cfg = load_config(*configs, overrides=merged)
-            metrics = cli.fit(cfg, run_dir)
-        except Exception as exc:  # noqa: BLE001 — sweep survives bad draws
-            logger.warning("trial %d failed: %s", i, exc)
-            error = str(exc)
+        if isolate:
+            spec = {"configs": list(configs), "overrides": merged,
+                    "run_dir": str(run_dir)}
+            try:
+                json.dumps(spec)
+            except TypeError as exc:
+                error = f"overrides not serialisable: {exc}"
+            else:
+                metrics, error, pruned = _run_trial_isolated(spec, run_dir, pruner)
+        else:
+            try:
+                cfg = load_config(*configs, overrides=merged)
+                metrics = cli.fit(cfg, run_dir)
+            except Exception as exc:  # noqa: BLE001 — sweep survives bad draws
+                logger.warning("trial %d failed: %s", i, exc)
+                error = str(exc)
         trial = Trial(
             i,
             dict(merged),
             {k: v for k, v in metrics.items() if isinstance(v, float)},
             error=error,
+            pruned=pruned,
         )
         trials.append(trial)
         with open(trials_file, "a") as f:
             f.write(json.dumps({"trial_id": i, "overrides": trial.overrides,
-                                "metrics": trial.metrics, "error": trial.error}) + "\n")
+                                "metrics": trial.metrics, "error": trial.error,
+                                "pruned": trial.pruned}) + "\n")
         _forward_to_nni(trial)
     return trials
 

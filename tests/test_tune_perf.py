@@ -94,3 +94,84 @@ def test_performance_evaluation_script(tmp_path, monkeypatch):
     assert r["profile_examples_per_sec"] and r["profile_examples_per_sec"] > 0
     saved = json.loads((tmp_path / "perf" / "performance_evaluation.json").read_text())
     assert saved["mean_test_F1Score"] == agg["mean_test_F1Score"]
+
+
+def test_median_pruner_logic():
+    from deepdfa_tpu.train.tune import MedianPruner
+
+    p = MedianPruner(warmup_epochs=2, min_history=2)
+    p.record([0.5, 0.6, 0.7, 0.8])
+    p.record([0.4, 0.5, 0.6, 0.7])
+    assert not p.should_prune(1, 0.0)        # warmup
+    assert p.should_prune(2, 0.1)            # below median(0.7, 0.6)
+    assert not p.should_prune(2, 0.65)       # at/above median
+    p2 = MedianPruner(warmup_epochs=0, min_history=2)
+    p2.record([0.9])
+    assert not p2.should_prune(0, 0.0)       # only 1 prior curve
+
+
+def test_isolated_trials_and_pruning(tmp_path, monkeypatch):
+    """Subprocess-per-trial sweep: fresh XLA client per trial (parent RSS
+    flat), crash containment via rc, and median pruning that stops a bad
+    trial before its final epoch."""
+    import resource
+
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import importlib
+
+    from deepdfa_tpu import utils
+
+    importlib.reload(utils)
+
+    from deepdfa_tpu.train.tune import MedianPruner, best_trial, run_trials
+
+    base = {
+        "data.sample": True,
+        "optim.max_epochs": 10,
+        "model.hidden_dim": 8,
+        "model.n_steps": 1,
+        "data.batch.batch_graphs": 64,
+        "data.batch.max_nodes": 8192,
+        "data.batch.max_edges": 16384,
+    }
+    # trial 0: sane lr -> learns; establishes the median history
+    # trial 1: sane lr again (min_history=2 needs two prior curves)
+    # trial 2: absurd lr -> flat/awful F1 curve -> must be pruned mid-run
+    # (10 epochs x >=0.1s each vs 0.05s polls: a kill window is guaranteed)
+    # trial 3: unparseable override -> contained subprocess failure
+    candidates = [
+        {"optim.lr": 1e-3},
+        {"optim.lr": 3e-3},
+        {"optim.lr": 1e9},
+        {"optim.lr": "not-a-number"},
+    ]
+    pruner = MedianPruner(warmup_epochs=1, min_history=2, poll_seconds=0.05)
+    # first trial alone: any residual parent-side import/setup cost lands here
+    head = run_trials(
+        iter(candidates[:1]), tmp_path / "sweep_head", base_overrides=base,
+        isolate=True,
+    )
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    trials = head + run_trials(
+        iter(candidates), tmp_path / "sweep", base_overrides=base,
+        isolate=True, pruner=pruner,
+    )
+    rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    trials = trials[1:]
+    assert len(trials) == 4
+    assert trials[0].objective > float("-inf") and not trials[0].pruned
+    assert trials[2].pruned, trials[2]
+    # the pruned trial stopped before its final epoch
+    pruned_curve_rows = [
+        json.loads(l)
+        for l in (tmp_path / "sweep" / "trial_2" / "tuning.jsonl")
+        .read_text().splitlines()
+        if "epoch" in l
+    ]
+    assert len(pruned_curve_rows) < 10
+    assert trials[3].error and "rc=" in trials[3].error
+    assert best_trial(trials).trial_id in (0, 1)
+    # trials run out-of-process: after the first trial, a 4-trial sweep must
+    # not grow parent peak RSS (in-process trials accumulate ~100MB+ of XLA
+    # compile cache each; isolation keeps that in the children)
+    assert rss_after - rss_before < 50_000, (rss_before, rss_after)
