@@ -106,12 +106,23 @@ class GatedGraphConv(nn.Module):
                 if self.aggregation == "union_simple"
                 else segment_union_relu
             )
+        if self.aggregation == "sum":
+            # Sort edges by receiver ONCE per forward (receivers are constant
+            # across steps): every scatter-add in the unrolled chain then runs
+            # XLA's sorted-segment fast path instead of the general
+            # duplicate-index scatter. Sum is permutation-invariant, so the
+            # math is unchanged (addition order differs within a segment —
+            # float-level only).
+            order = jnp.argsort(receivers)
+            senders = jnp.take(senders, order)
+            receivers = jnp.take(receivers, order)
         # Python loop, unrolled by trace: n_steps is small (5) and static;
         # unrolling lets XLA pipeline the matmuls instead of a lax.scan barrier.
         for _ in range(self.n_steps):
             msg_src = edge_linear(h)
             if self.aggregation == "sum":
-                agg = segment_sum(gather(msg_src, senders), receivers, n_nodes)
+                agg = segment_sum(gather(msg_src, senders), receivers, n_nodes,
+                                  indices_are_sorted=True)
             else:
                 # union space is [0,1] soft membership: messages AND the
                 # node's own state map through sigmoid (the reference fold

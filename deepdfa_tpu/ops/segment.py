@@ -20,9 +20,15 @@ def gather(values: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
     return jnp.take(values, indices, axis=0)
 
 
-def segment_sum(data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+def segment_sum(
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    indices_are_sorted: bool = False,
+) -> jnp.ndarray:
     return jax.ops.segment_sum(
-        data, segment_ids, num_segments=num_segments, indices_are_sorted=False
+        data, segment_ids, num_segments=num_segments,
+        indices_are_sorted=indices_are_sorted,
     )
 
 
