@@ -394,6 +394,38 @@ def _nominal_peak_tflops() -> float | None:
     return None
 
 
+def _init_backend_with_retry(attempts: int = 5, backoff_s: float = 60.0):
+    """First device touch, with retry-on-UNAVAILABLE: the tunneled TPU pool
+    intermittently reports 'Unable to initialize backend ... UNAVAILABLE' for
+    a while and then recovers — a bench run (the driver gets ONE per round)
+    must not die on a transient. Retries only on UNAVAILABLE (permanent
+    failures like a plugin/version mismatch fail fast) and only under a
+    single-platform pin: with several platforms listed, jax caches whichever
+    initialized before the failure and a retry would silently 'recover' onto
+    the fallback. A *hang* here is the other failure mode; the stage marker
+    above each attempt leaves a diagnosable tail for it."""
+    import os
+
+    import jax
+
+    multi_platform = "," in os.environ.get("JAX_PLATFORMS", "")
+    for attempt in range(attempts):
+        _progress(
+            f"initialising device backend (attempt {attempt + 1}/{attempts}; "
+            "a wedged tunnel grant hangs HERE)"
+        )
+        try:
+            return jax.default_backend(), jax.devices()[0].device_kind
+        except RuntimeError as e:
+            retryable = "UNAVAILABLE" in str(e) and not multi_platform
+            if attempt == attempts - 1 or not retryable:
+                raise
+            _progress(f"backend init failed ({str(e)[:120]}); "
+                      f"retrying in {backoff_s:.0f}s")
+            time.sleep(backoff_s)
+    raise AssertionError("unreachable")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
@@ -410,11 +442,7 @@ def main():
     batches, occupancy = build_batches(args.batches, FeatureConfig().input_dim)
     real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
 
-    import jax
-
-    _progress("initialising device backend (a wedged tunnel grant hangs HERE)")
-    backend = jax.default_backend()
-    device_kind = jax.devices()[0].device_kind
+    backend, device_kind = _init_backend_with_retry()
     _progress(f"backend={backend} device_kind={device_kind}; measuring roofline")
     roofline = measure_roofline()
     _progress(f"roofline {roofline / 1e12:.1f} TFLOP/s; chained inference (k={args.chain})")

@@ -32,7 +32,14 @@ import time
 
 import numpy as np
 
-from bench import _sync, _time_once, _timed, _cost_flops, measure_roofline  # shared protocol
+from bench import (  # shared protocol
+    _cost_flops,
+    _init_backend_with_retry,
+    _sync,
+    _time_once,
+    _timed,
+    measure_roofline,
+)
 
 FULL_LAYERS = 32  # CodeLlama-7B
 
@@ -165,7 +172,7 @@ def main():
             dtype="bfloat16",
         )
 
-    backend = jax.default_backend()
+    backend, _device_kind = _init_backend_with_retry()
     roofline = measure_roofline()
     tokens = args.batch * args.seq
 
