@@ -452,16 +452,22 @@ def main():
     _progress("single-dispatch strict/pipelined")
     strict = bench_jax(batches, args.steps, train=False)
 
-    # Peak throughput at superbatch 1024: same model, larger static batch —
-    # bigger kernels per dispatch. Failure is recorded, never swallowed.
-    _progress("superbatch-1024 peak")
-    peak, peak_real, peak_error = None, 1.0, None
-    try:
-        peak_batches, _ = build_batches(2, FeatureConfig().input_dim, batch_graphs=1024)
-        peak_real = float(np.mean([int(b.graph_mask.sum()) for b in peak_batches]))
-        peak = bench_chained(peak_batches, max(args.chain // 4, 8), train=False)
-    except Exception as e:  # recorded verbatim in the artifact
-        peak_error = f"{type(e).__name__}: {e}"
+    # Peak throughput at superbatches: same model, larger static batches —
+    # bigger kernels per dispatch, higher arithmetic intensity. Failures are
+    # recorded per size, never swallowed.
+    peak_runs: dict[str, tuple] = {}
+    peak_errors: dict[str, str] = {}
+    for bg in (1024, 2048):
+        _progress(f"superbatch-{bg} peak")
+        try:
+            peak_batches, _ = build_batches(2, FeatureConfig().input_dim, batch_graphs=bg)
+            pr = float(np.mean([int(b.graph_mask.sum()) for b in peak_batches]))
+            peak_runs[str(bg)] = (
+                bench_chained(peak_batches, max(args.chain // 4, 8), train=False),
+                pr,
+            )
+        except Exception as e:  # recorded verbatim in the artifact
+            peak_errors[str(bg)] = f"{type(e).__name__}: {e}"
 
     _progress("torch-cpu baseline (skipped)" if args.skip_baseline
               else "torch-cpu baseline")
@@ -474,10 +480,13 @@ def main():
                           chained_train["flops_per_step"], real_graphs, roofline, refused)
     strict_gps = _validate("strict_graphs_per_sec", strict["graphs_per_sec"],
                            strict["flops_per_step"], real_graphs, roofline, refused)
-    peak_gps = None
-    if peak is not None:
-        peak_gps = _validate("peak_batch1024_graphs_per_sec", peak["graphs_per_sec"],
-                             peak["flops_per_step"], peak_real, roofline, refused)
+    peak_by_size: dict[str, float | None] = {}
+    for bg, (p, pr) in peak_runs.items():
+        peak_by_size[bg] = _validate(f"peak_batch{bg}_graphs_per_sec",
+                                     p["graphs_per_sec"], p["flops_per_step"],
+                                     pr, roofline, refused)
+    peak_valid = [v for v in peak_by_size.values() if v is not None]
+    peak_gps = max(peak_valid) if peak_valid else None
 
     flops_per_graph = (chained["flops_per_step"] or 0.0) / real_graphs
     # a refused headline must not fabricate implied/MFU numbers — keep null
@@ -528,8 +537,9 @@ def main():
         "pipelined_graphs_per_sec": round(strict["pipelined_graphs_per_sec"], 1),
         "train_graphs_per_sec": train_gps,
         "train_step_ms": round(chained_train["step_ms"], 3),
-        "peak_batch1024_graphs_per_sec": peak_gps,
-        "peak_batch1024_error": peak_error,
+        "peak_superbatch_graphs_per_sec": peak_gps,
+        "peak_by_batch": peak_by_size or None,
+        "peak_errors": peak_errors or None,
         "refused": refused or None,
         "baseline": "torch-cpu same-semantics GGNN (compat/torch_ref.py)",
         "baseline_graphs_per_sec": round(base_gps, 1) if base_gps else None,

@@ -20,7 +20,7 @@ Prints ONE JSON line. Protocol:
   cancels; ``t(32) ≈ t(L) + slope × (32 - L)`` gives
   ``est_full_model_tokens_per_sec_per_chip``.
 
-Usage: python bench_llm.py [--layers 2] [--batch 4] [--seq 512] [--steps 10]
+Usage: python bench_llm.py [--layers 2] [--batch 8] [--seq 1024] [--steps 10]
        python bench_llm.py --tiny     # CPU-sized smoke (CI / no TPU)
 """
 
@@ -148,8 +148,8 @@ def build_step(cfg, batch: int, seq: int, seed: int = 0, measure_strict: bool = 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=2)
-    ap.add_argument("--batch", type=int, default=4)
-    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--chain", type=int, default=8,
                     help="k optimizer steps per chained-scan dispatch (headline)")

@@ -44,7 +44,9 @@ def to_dot(
     appended to each node label as ``RD:{var@line,...}``."""
     edges = rdg(cpg, gtype)  # validates gtype
     etypes = RDG_ETYPES[gtype]
-    keep = {s for s, _ in edges} | {d for _, d in edges}
+    # only endpoints that exist in the node table: a malformed export row
+    # must not make Graphviz auto-create bare nodes
+    keep = ({s for s, _ in edges} | {d for _, d in edges}) & set(cpg.nodes)
     lines = [
         "digraph cpg {",
         '  node [shape=box, fontname="monospace", fontsize=9];',
@@ -86,5 +88,5 @@ def to_dot(
 
 def write_dot(cpg: CPG, path: str | Path, **kwargs) -> Path:
     path = Path(path)
-    path.write_text(to_dot(cpg, **kwargs))
+    path.write_text(to_dot(cpg, **kwargs), encoding="utf-8")
     return path

@@ -1,0 +1,36 @@
+"""scripts/download_all.py — corpus-layout preflight contract."""
+
+import contextlib
+import io
+import json
+
+
+def test_layout_report_rc_and_slots(tmp_path, monkeypatch):
+    """Reports every slot; rc=1 while a required artifact is absent, rc=0
+    once it exists."""
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import scripts.download_all as da
+
+    def run(args):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = da.main(args)
+        return rc, json.loads(buf.getvalue())
+
+    rc, report = run(["--dataset", "bigvul"])
+    assert rc == 1 and report["missing_required"]
+    csv = tmp_path / "storage" / "external" / "MSR_data_cleaned.csv"
+    csv.parent.mkdir(parents=True, exist_ok=True)
+    csv.write_text("id\n")
+    rc, report = run(["--dataset", "bigvul"])
+    assert rc == 0 and not report["missing_required"]
+
+
+def test_fetch_commands_scoped_to_dataset(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import scripts.download_all as da
+
+    with contextlib.redirect_stdout(io.StringIO()):
+        da.main(["--dataset", "devign", "--fetch"])
+    err = capsys.readouterr().err
+    assert "function.json" in err and "curl" not in err

@@ -62,35 +62,3 @@ def test_write_dot(tmp_path, cpg):
 def test_unknown_gtype_is_loud(cpg):
     with pytest.raises(ValueError, match="unknown gtype"):
         to_dot(cpg, gtype="nope")
-
-
-def test_download_all_layout_report(tmp_path, monkeypatch):
-    """scripts/download_all.py is the corpus-layout preflight: reports every
-    slot and fails (rc=1) when a required artifact is absent."""
-    import importlib
-    import json as _json
-
-    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
-    from deepdfa_tpu import utils
-
-    importlib.reload(utils)
-    import scripts.download_all as da
-
-    importlib.reload(da)
-    rc = None
-    import io
-    import contextlib
-
-    buf = io.StringIO()
-    with contextlib.redirect_stdout(buf):
-        rc = da.main(["--dataset", "bigvul"])
-    report = _json.loads(buf.getvalue())
-    assert rc == 1 and report["missing_required"]
-    # satisfy the required slot -> rc 0
-    csv = tmp_path / "storage" / "external" / "MSR_data_cleaned.csv"
-    csv.parent.mkdir(parents=True, exist_ok=True)
-    csv.write_text("id\n")
-    buf = io.StringIO()
-    with contextlib.redirect_stdout(buf):
-        rc = da.main(["--dataset", "bigvul"])
-    assert rc == 0
