@@ -53,9 +53,7 @@ def to_dot(
         '  edge [fontsize=8];',
     ]
     for nid in sorted(keep):
-        n = cpg.nodes.get(nid)
-        if n is None:
-            continue
+        n = cpg.nodes[nid]  # keep ⊆ cpg.nodes by construction above
         code = n.code[:max_code_chars] + ("…" if len(n.code) > max_code_chars else "")
         label = f"{nid} {n.label}"
         if n.line is not None:

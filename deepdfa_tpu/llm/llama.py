@@ -78,6 +78,10 @@ class LlamaConfig:
     remat: bool = False  # rematerialize each decoder layer (memory <-> FLOPs)
     lora_rank: int = 0  # 0 = disabled; >0 adds LoRA to q_proj/v_proj
     lora_alpha: float = 16.0
+    # int8-resident projection weights via the fused dequant-matmul pallas
+    # kernel (ops/int8_matmul.py): halves weight HBM so 7B fits one v5e.
+    # Single-chip inference path — incompatible with a GSPMD mesh.
+    int8_runtime: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -122,7 +126,41 @@ def tiny_llama(**kw) -> LlamaConfig:
     return LlamaConfig(**defaults)
 
 
-def _dense(features: int, in_axis: str, out_axis: str, dtype, name: str) -> nn.Dense:
+class Int8Dense(nn.Module):
+    """Inference-only projection with **int8-resident** weights: the fused
+    dequant-matmul pallas kernel (``ops/int8_matmul.py``) reads ``q`` (int8)
+    and the per-channel ``scale`` straight from HBM and dequantises tiles in
+    VMEM — weight footprint and traffic halve vs bf16. Params are produced
+    from a trained checkpoint by ``quant.to_int8_runtime_params``; ``init``
+    only fixes shapes. Single-chip path (a pallas call is not GSPMD-
+    partitionable here); the mesh path stays bf16."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from deepdfa_tpu.ops.int8_matmul import int8_matmul
+
+        q = self.param(
+            "q", nn.initializers.zeros_init(), (x.shape[-1], self.features), jnp.int8
+        )
+        scale = self.param(
+            "scale", nn.initializers.ones_init(), (self.features,), jnp.float32
+        )
+        return int8_matmul(
+            x, q, scale,
+            out_dtype=jnp.dtype(self.dtype),
+            interpret=jax.default_backend() == "cpu",
+        )
+
+
+def _dense(
+    features: int, in_axis: str, out_axis: str, dtype, name: str,
+    int8: bool = False,
+) -> nn.Module:
+    if int8:
+        return Int8Dense(features, dtype=dtype, name=name)
     return nn.Dense(
         features,
         use_bias=False,
@@ -232,10 +270,10 @@ class Attention(nn.Module):
         h, h_kv, d = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
         b, s, _ = x.shape
 
-        q_proj = _dense(h * d, "embed", "heads", dtype, "q_proj")
-        k_proj = _dense(h_kv * d, "embed", "kv_heads", dtype, "k_proj")
-        v_proj = _dense(h_kv * d, "embed", "kv_heads", dtype, "v_proj")
-        o_proj = _dense(cfg.hidden_size, "heads", "embed", dtype, "o_proj")
+        q_proj = _dense(h * d, "embed", "heads", dtype, "q_proj", int8=cfg.int8_runtime)
+        k_proj = _dense(h_kv * d, "embed", "kv_heads", dtype, "k_proj", int8=cfg.int8_runtime)
+        v_proj = _dense(h_kv * d, "embed", "kv_heads", dtype, "v_proj", int8=cfg.int8_runtime)
+        o_proj = _dense(cfg.hidden_size, "heads", "embed", dtype, "o_proj", int8=cfg.int8_runtime)
 
         q = q_proj(x)
         k = k_proj(x)
@@ -328,9 +366,9 @@ class MLP(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
-        gate = _dense(cfg.intermediate_size, "embed", "mlp", dtype, "gate_proj")
-        up = _dense(cfg.intermediate_size, "embed", "mlp", dtype, "up_proj")
-        down = _dense(cfg.hidden_size, "mlp", "embed", dtype, "down_proj")
+        gate = _dense(cfg.intermediate_size, "embed", "mlp", dtype, "gate_proj", int8=cfg.int8_runtime)
+        up = _dense(cfg.intermediate_size, "embed", "mlp", dtype, "up_proj", int8=cfg.int8_runtime)
+        down = _dense(cfg.hidden_size, "mlp", "embed", dtype, "down_proj", int8=cfg.int8_runtime)
         return down(nn.silu(gate(x)) * up(x))
 
 
@@ -369,6 +407,12 @@ class LlamaModel(nn.Module):
     ) -> jnp.ndarray:
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
+        if cfg.int8_runtime and self.mesh is not None:
+            raise ValueError(
+                "int8_runtime is the single-chip inference path — the pallas "
+                "dequant-matmul is not GSPMD-partitionable; use bf16 + mesh "
+                "sharding for multi-chip"
+            )
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(input_ids.shape[1]), input_ids.shape
@@ -407,7 +451,8 @@ class LlamaForCausalLM(nn.Module):
             input_ids, attn_mask, positions, decode
         )
         logits = _dense(
-            self.cfg.vocab_size, "embed", "vocab", jnp.dtype(self.cfg.dtype), "lm_head"
+            self.cfg.vocab_size, "embed", "vocab", jnp.dtype(self.cfg.dtype),
+            "lm_head", int8=self.cfg.int8_runtime,
         )(hidden)
         return logits.astype(jnp.float32)
 

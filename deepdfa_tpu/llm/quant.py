@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["QuantizedLeaf", "quantize_tree", "dequantize_tree"]
+__all__ = ["QuantizedLeaf", "quantize_tree", "dequantize_tree", "to_int8_runtime_params"]
 
 
 class QuantizedLeaf(NamedTuple):
@@ -67,6 +67,35 @@ def dequantize_tree(params: Any, dtype: Any = jnp.bfloat16) -> Any:
         return leaf
 
     return jax.tree.map(deq, params, is_leaf=lambda x: isinstance(x, QuantizedLeaf))
+
+
+def to_int8_runtime_params(params: Any) -> Any:
+    """Trained checkpoint tree → ``Int8Dense`` runtime tree: every mapping
+    holding a 2-D ``kernel`` (a projection; this model family uses
+    ``use_bias=False``) becomes ``{"q": int8, "scale": f32[out]}`` in place,
+    matching the params :class:`deepdfa_tpu.llm.llama.Int8Dense` declares.
+    Embeddings, norms and LoRA adapters pass through unchanged (they are a
+    rounding error of total bytes and precision-sensitive)."""
+
+    from collections.abc import Mapping
+
+    from flax import linen as nn
+
+    # strip logical-partitioning metadata boxes: the int8 runtime is the
+    # single-chip path, and a boxed kernel hides its .ndim from the walk
+    params = nn.meta.unbox(params)
+
+    def walk(node):
+        if isinstance(node, Mapping):  # dict or flax FrozenDict alike
+            if "kernel" in node and getattr(node["kernel"], "ndim", 0) == 2:
+                leaf = _quantize(node["kernel"])
+                out = {k: walk(v) for k, v in node.items() if k != "kernel"}
+                out["q"], out["scale"] = leaf.q, leaf.scale
+                return out
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)
 
 
 def tree_nbytes(params: Any) -> int:
