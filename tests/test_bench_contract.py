@@ -1,0 +1,90 @@
+"""bench.py's self-validation contract: the refusal gate, the chained-batch
+tiling, and the backend-init retry policy. These are what make the emitted
+numbers trustworthy — a bench that can't refuse impossible results is a
+bench that can lie (round-1 shipped a 3.7×-over-ceiling artifact exactly
+that way)."""
+
+import numpy as np
+import pytest
+
+import bench
+
+
+def test_validate_refuses_over_roofline():
+    refused = {}
+    # 1000 g/s × 1e9 flops/graph = 1 TFLOP/s implied vs 0.5 TFLOP/s roofline
+    out = bench._validate("value", 1000.0, 1e9, 1.0, 0.5e12, refused)
+    assert out is None
+    assert "value" in refused and "roofline" in refused["value"]
+
+
+def test_validate_passes_under_roofline():
+    refused = {}
+    out = bench._validate("value", 1000.0, 1e9, 1.0, 2e12, refused)
+    assert out == 1000.0 and not refused
+
+
+def test_validate_without_flops_passes_through():
+    """No cost analysis ⇒ nothing to check against — the number passes but
+    the artifact carries flops_per_step=null for the reader."""
+    refused = {}
+    assert bench._validate("value", 123.4, None, 1.0, 1e12, refused) == 123.4
+    assert not refused
+
+
+def test_stack_tiled_cycles_distinct_batches():
+    import jax
+
+    batches = [
+        {"x": np.full((2, 3), i, np.float32)} for i in range(3)
+    ]
+    stacked = bench._stack_tiled(batches, k=7)
+    vals = np.asarray(stacked["x"])[:, 0, 0]
+    assert vals.tolist() == [0, 1, 2, 0, 1, 2, 0]
+
+
+def test_init_retry_only_on_unavailable(monkeypatch):
+    calls = {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("Unable to initialize backend 'x': UNAVAILABLE: nope")
+        raise RuntimeError("Unable to initialize backend 'x': plugin version mismatch")
+
+    monkeypatch.setattr(bench, "_progress", lambda *_: None)
+    monkeypatch.setattr(bench.time, "sleep", lambda *_: None)
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", flaky)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    # two UNAVAILABLEs retried, then the permanent failure raises immediately
+    with pytest.raises(RuntimeError, match="version mismatch"):
+        bench._init_backend_with_retry(attempts=5, backoff_s=0)
+    assert calls["n"] == 3
+
+
+def test_init_retry_disabled_for_multi_platform(monkeypatch):
+    monkeypatch.setattr(bench, "_progress", lambda *_: None)
+    import jax
+
+    def unavailable(*a, **k):
+        raise RuntimeError("Unable to initialize backend 'x': UNAVAILABLE")
+
+    monkeypatch.setattr(jax, "default_backend", unavailable)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu,axon")
+    # with a fallback platform listed, jax may cache the fallback — no retry
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        bench._init_backend_with_retry(attempts=5, backoff_s=0)
+
+
+def test_nominal_peak_lookup(monkeypatch):
+    class FakeDev:
+        device_kind = "TPU v5 lite chip"
+
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [FakeDev()])
+    assert bench._nominal_peak_tflops() == 197.0
+    FakeDev.device_kind = "SomethingElse"
+    assert bench._nominal_peak_tflops() is None
