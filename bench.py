@@ -426,6 +426,67 @@ def _init_backend_with_retry(attempts: int = 5, backoff_s: float = 60.0):
     raise AssertionError("unreachable")
 
 
+def run_with_device_watchdog(
+    script_path: str, argv: list[str], fallback_argv: list[str] | None = None
+) -> int:
+    """Orchestrate a bench run so the driver's ONE shot always yields an
+    artifact: run the real bench in a child (inheriting the TPU env) under a
+    wall-clock budget (``BENCH_TPU_TIMEOUT_S``, default 1500s — a wedged
+    tunnel grant can hang device init for 25+ minutes, unkillable from
+    inside the process); if it times out or fails, re-run on CPU with the
+    tunnel env dropped and emit that JSON with ``tpu_unavailable`` recording
+    the TPU attempt's fate. An honestly-labelled CPU artifact beats an empty
+    file; ``backend`` in the JSON says which one this is."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["_BENCH_CHILD"] = "1"
+    timeout_s = float(os.environ.get("BENCH_TPU_TIMEOUT_S", "1500"))
+    cmd = [sys.executable, script_path, *argv]
+    reason = None
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout_s,
+                              stdout=subprocess.PIPE, text=True)
+        if proc.returncode == 0 and proc.stdout.strip():
+            print(proc.stdout.strip().splitlines()[-1])
+            return 0
+        reason = f"device bench exited rc={proc.returncode}"
+    except subprocess.TimeoutExpired:
+        reason = (f"device bench exceeded {timeout_s:.0f}s "
+                  "(wedged tunnel grant hangs device init)")
+    _progress(f"{reason}; falling back to a CPU-labelled artifact")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the fallback gets CPU-sized args: the device-sized workload on a single
+    # CPU core would blow the same budget the TPU attempt just spent
+    fb_cmd = [sys.executable, script_path,
+              *(fallback_argv if fallback_argv is not None else argv)]
+
+    def _failed(why: str, rc=None) -> int:
+        print(json.dumps({"metric": "bench_failed", "value": None,
+                          "unit": None, "vs_baseline": None,
+                          "tpu_unavailable": reason,
+                          "cpu_fallback_error": why,
+                          "cpu_fallback_rc": rc}))
+        return 1
+
+    try:
+        proc = subprocess.run(fb_cmd, env=env, timeout=timeout_s,
+                              stdout=subprocess.PIPE, text=True)
+    except subprocess.TimeoutExpired:
+        return _failed(f"CPU fallback exceeded {timeout_s:.0f}s")
+    if proc.returncode != 0 or not proc.stdout.strip():
+        return _failed("CPU fallback produced no output", proc.returncode)
+    try:
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+    except json.JSONDecodeError as e:
+        return _failed(f"CPU fallback stdout not JSON: {e}", proc.returncode)
+    result["tpu_unavailable"] = reason
+    print(json.dumps(result))
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
@@ -552,4 +613,13 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import os
+
+    if os.environ.get("_BENCH_CHILD") == "1":
+        main()
+    else:
+        raise SystemExit(run_with_device_watchdog(
+            __file__, sys.argv[1:],
+            fallback_argv=["--chain", "8", "--steps", "5", "--batches", "2",
+                           "--skip-baseline"],
+        ))

@@ -257,4 +257,15 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import os
+    import sys
+
+    if os.environ.get("_BENCH_CHILD") == "1":
+        main()
+    else:
+        from bench import run_with_device_watchdog
+
+        raise SystemExit(run_with_device_watchdog(
+            __file__, sys.argv[1:],
+            fallback_argv=["--tiny", "--steps", "3", "--chain", "4"],
+        ))

@@ -33,8 +33,6 @@ def test_validate_without_flops_passes_through():
 
 
 def test_stack_tiled_cycles_distinct_batches():
-    import jax
-
     batches = [
         {"x": np.full((2, 3), i, np.float32)} for i in range(3)
     ]
@@ -88,3 +86,49 @@ def test_nominal_peak_lookup(monkeypatch):
     assert bench._nominal_peak_tflops() == 197.0
     FakeDev.device_kind = "SomethingElse"
     assert bench._nominal_peak_tflops() is None
+
+
+def test_watchdog_falls_back_to_labelled_cpu_artifact(tmp_path, monkeypatch):
+    """A failing device child must yield a CPU-labelled artifact carrying the
+    TPU attempt's fate — never an empty file."""
+    import contextlib
+    import io
+    import json
+
+    fake = tmp_path / "fake_bench.py"
+    fake.write_text(
+        "import json, os, sys\n"
+        "if os.environ.get('JAX_PLATFORMS') == 'cpu' "
+        "and 'PALLAS_AXON_POOL_IPS' not in os.environ:\n"
+        "    print(json.dumps({'metric': 'm', 'value': 1.0, 'unit': 'u',\n"
+        "                      'vs_baseline': None, 'backend': 'cpu'}))\n"
+        "else:\n"
+        "    sys.exit(3)\n"
+    )
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")  # simulated tunnel
+    monkeypatch.setattr(bench, "_progress", lambda *_: None)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = bench.run_with_device_watchdog(str(fake), [])
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rc == 0
+    assert out["backend"] == "cpu" and "rc=3" in out["tpu_unavailable"]
+
+
+def test_watchdog_passes_through_healthy_device_run(tmp_path, monkeypatch):
+    import contextlib
+    import io
+    import json
+
+    fake = tmp_path / "fake_bench.py"
+    fake.write_text(
+        "import json\n"
+        "print(json.dumps({'metric': 'm', 'value': 2.0, 'unit': 'u',\n"
+        "                  'vs_baseline': None, 'backend': 'tpu'}))\n"
+    )
+    monkeypatch.setattr(bench, "_progress", lambda *_: None)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = bench.run_with_device_watchdog(str(fake), [])
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rc == 0 and out["backend"] == "tpu" and "tpu_unavailable" not in out
