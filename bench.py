@@ -23,11 +23,11 @@ number is still reported (``strict_graphs_per_sec``) alongside.
 Every throughput number self-validates against physics, in-process:
 
 - ``flops_per_step`` comes from the compiled computation's ``cost_analysis()``;
-- ``roofline_tflops`` is a chained bf16 matmul measured in the same process
-  (the MXU ceiling actually reachable right now, tunnel and all). NOTE: this
-  is a serialized-chain *lower bound* on peak, so ``mfu`` reads "fraction of
-  reachable-chain throughput"; ``mfu_nominal`` uses the chip's datasheet peak
-  when the device kind is recognised.
+- ``roofline_tflops`` is parallel independent bf16 matmul chains measured in
+  the same process (the MXU ceiling actually reachable right now, tunnel and
+  all — ~87% of the v5e datasheet peak); ``mfu`` is the fraction of it,
+  ``mfu_nominal`` uses the chip's datasheet peak when the device kind is
+  recognised.
 - each metric's implied FLOP/s must be ≤ the roofline or the metric is
   REFUSED (reported as null with the reason in ``refused``). A throughput
   that beats the hardware ceiling is a timing artifact, not throughput.
@@ -141,36 +141,46 @@ def _cost_flops(jitted, *args) -> float | None:
 
 
 def measure_roofline(n_chain: int | None = None, dim: int | None = None,
-                     trials: int = 5) -> float:
+                     trials: int = 4, n_par: int = 2) -> float:
     """Best-case bf16 matmul FLOP/s reachable in this process right now:
-    ``n_chain`` dependent dim³ matmuls inside one jit (amortises dispatch),
-    strict sync, best of ``trials``. This is the ceiling every reported
-    throughput is checked against. Serialized-chain lower bound on peak —
-    see module docstring."""
+    ``n_par`` INDEPENDENT chains of ``n_chain`` dependent two-matmul hops
+    (``acc @ w1 @ w2``, weights stationary) inside one jit, strict readback
+    sync, best of ``trials``. This is the ceiling every reported throughput
+    is checked against.
+
+    Round-3 redesign: a single serialized dim³ chain measured only ~39% of
+    the v5e's nominal peak (each matmul stalls the MXU pipeline on its
+    predecessor), so the honest LLM bench — 65% MFU on dense decoder
+    matmuls — was refused against a ceiling the probe itself couldn't
+    reach. Independent parallel chains keep the pipeline full: this probe
+    measures ~87% of nominal on the tunneled v5e (170/197 TFLOP/s), making
+    the refusal gate a true upper bound instead of a 2.2×-too-low one."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     if dim is None or n_chain is None:
         on_cpu = jax.default_backend() == "cpu"
-        dim = dim or (512 if on_cpu else 4096)
-        n_chain = n_chain or (8 if on_cpu else 64)
+        dim = dim or (512 if on_cpu else 8192)
+        n_chain = n_chain or (4 if on_cpu else 32)
 
-    x = (jnp.ones((dim, dim), jnp.bfloat16) * 1e-2)
-    w = jax.random.normal(jax.random.key(0), (dim, dim), jnp.bfloat16) * (dim ** -0.5)
+    x = jnp.ones((n_par, dim, dim), jnp.bfloat16) * 1e-2
+    w1 = jax.random.normal(jax.random.key(0), (n_par, dim, dim), jnp.bfloat16) * (dim ** -0.5)
+    w2 = jax.random.normal(jax.random.key(1), (n_par, dim, dim), jnp.bfloat16) * (dim ** -0.5)
 
     @jax.jit
-    def chain(x, w):
-        acc = lax.fori_loop(
-            0, n_chain,
-            lambda i, acc: jnp.dot(acc, w, preferred_element_type=jnp.bfloat16),
-            x,
-        )
+    def chain(x, w1, w2):
+        def body(i, acc):
+            h = jnp.einsum("bmk,bkn->bmn", acc, w1,
+                           preferred_element_type=jnp.bfloat16)
+            return jnp.einsum("bmn,bnk->bmk", h, w2,
+                              preferred_element_type=jnp.bfloat16)
+        acc = lax.fori_loop(0, n_chain, body, x)
         return jnp.sum(acc.astype(jnp.float32))  # scalar out → cheap readback sync
 
-    _sync(chain(x, w))  # compile + warm
-    best = min(_time_once(lambda: _sync(chain(x, w))) for _ in range(trials))
-    return 2.0 * dim ** 3 * n_chain / best
+    _sync(chain(x, w1, w2))  # compile + warm
+    best = min(_time_once(lambda: _sync(chain(x, w1, w2))) for _ in range(trials))
+    return 2.0 * dim ** 3 * 2 * n_chain * n_par / best
 
 
 def _time_once(fn) -> float:
@@ -449,8 +459,20 @@ def run_with_device_watchdog(
         proc = subprocess.run(cmd, env=env, timeout=timeout_s,
                               stdout=subprocess.PIPE, text=True)
         if proc.returncode == 0 and proc.stdout.strip():
-            print(proc.stdout.strip().splitlines()[-1])
+            # Contract: ONE JSON line on stdout (progress goes to stderr).
+            # If the last line isn't JSON (e.g. --help usage text), relay
+            # the full stdout instead of silently truncating it.
+            last = proc.stdout.strip().splitlines()[-1]
+            try:
+                json.loads(last)
+                print(last)
+            except json.JSONDecodeError:
+                sys.stdout.write(proc.stdout)
             return 0
+        if proc.returncode == 2:
+            # argparse usage error: deterministic caller mistake, not device
+            # trouble — a CPU fallback would mask it under a green rc.
+            return 2
         reason = f"device bench exited rc={proc.returncode}"
     except subprocess.TimeoutExpired:
         reason = (f"device bench exceeded {timeout_s:.0f}s "
@@ -476,7 +498,12 @@ def run_with_device_watchdog(
                               stdout=subprocess.PIPE, text=True)
     except subprocess.TimeoutExpired:
         return _failed(f"CPU fallback exceeded {timeout_s:.0f}s")
-    if proc.returncode != 0 or not proc.stdout.strip():
+    if proc.returncode != 0:
+        tail = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        why = (f"CPU fallback crashed (last stdout line: {tail!r})" if tail
+               else "CPU fallback crashed with no output")
+        return _failed(why, proc.returncode)
+    if not proc.stdout.strip():
         return _failed("CPU fallback produced no output", proc.returncode)
     try:
         result = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -581,7 +608,8 @@ def main():
         "flops_per_step": chained["flops_per_step"],
         "implied_tflops": round(implied_tflops, 2) if implied_tflops is not None else None,
         "roofline_tflops": round(roofline / 1e12, 1),
-        "roofline_note": "serialized-chain lower bound on peak; mfu = fraction of it",
+        "roofline_note": ("parallel independent bf16 matmul chains — the "
+                          "ceiling reachable in-process; mfu = fraction of it"),
         "mfu": (
             round(implied_tflops * 1e12 / roofline, 4)
             if (roofline and implied_tflops is not None) else None

@@ -115,6 +115,31 @@ def test_watchdog_falls_back_to_labelled_cpu_artifact(tmp_path, monkeypatch):
     assert out["backend"] == "cpu" and "rc=3" in out["tpu_unavailable"]
 
 
+def test_watchdog_propagates_usage_errors(tmp_path, monkeypatch):
+    """rc=2 (argparse usage error) is a deterministic caller mistake: the
+    watchdog must propagate it, not mask it under a green CPU fallback."""
+    fake = tmp_path / "fake_bench.py"
+    fake.write_text("import sys\nsys.exit(2)\n")
+    monkeypatch.setattr(bench, "_progress", lambda *_: None)
+    assert bench.run_with_device_watchdog(str(fake), ["--chian", "8"]) == 2
+
+
+def test_watchdog_relays_full_non_json_stdout(tmp_path, monkeypatch):
+    """A healthy child whose stdout isn't the one-JSON-line contract (e.g.
+    --help usage text) is relayed whole, not truncated to its last line."""
+    import contextlib
+    import io
+
+    fake = tmp_path / "fake_bench.py"
+    fake.write_text("print('usage: bench.py [--steps N]')\nprint('options:')\n")
+    monkeypatch.setattr(bench, "_progress", lambda *_: None)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = bench.run_with_device_watchdog(str(fake), ["--help"])
+    assert rc == 0
+    assert buf.getvalue() == "usage: bench.py [--steps N]\noptions:\n"
+
+
 def test_watchdog_passes_through_healthy_device_run(tmp_path, monkeypatch):
     import contextlib
     import io
