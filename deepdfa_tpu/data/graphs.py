@@ -171,6 +171,13 @@ def batch_np(
 
 @dataclasses.dataclass(frozen=True)
 class BucketSpec:
+    """Static padded-batch budget. One graph slot (``max_graphs - 1``) and one
+    node slot (``max_nodes - 1``) are RESERVED as the padding sinks — padding
+    nodes point at the sink graph, padding edges at the sink node — so a
+    bucket holds at most ``max_graphs - 1`` real graphs over
+    ``max_nodes - 1`` real nodes (see :func:`batch_np`). ``max_graphs`` and
+    ``max_nodes`` must therefore be ≥ 2 for the bucket to hold anything."""
+
     max_graphs: int
     max_nodes: int
     max_edges: int
@@ -199,6 +206,14 @@ class GraphBatcher:
     def __init__(self, buckets: Sequence[BucketSpec], drop_oversize: bool = True):
         if not buckets:
             raise ValueError("need at least one bucket")
+        for b in buckets:
+            if b.max_graphs < 2 or b.max_nodes < 2:
+                # the padding-sink reservation makes such a bucket hold zero
+                # real graphs — with drop_oversize it would silently drop ALL
+                raise ValueError(
+                    f"unusable bucket {b}: max_graphs and max_nodes must be "
+                    "≥ 2 (one slot each is reserved as the padding sink)"
+                )
         self.buckets = sorted(buckets, key=lambda b: (b.max_nodes, b.max_edges, b.max_graphs))
         self.big = self.buckets[-1]
         self.drop_oversize = drop_oversize

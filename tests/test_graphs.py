@@ -61,6 +61,19 @@ def test_batcher_packs_and_drops():
     assert total_real == 10
 
 
+def test_degenerate_bucket_rejected_at_construction():
+    """max_graphs=1 (or max_nodes=1) can hold zero real graphs once the
+    padding sink is reserved — with drop_oversize it would silently drop the
+    whole corpus, so construction must fail loudly."""
+    with pytest.raises(ValueError, match="padding sink"):
+        GraphBatcher([BucketSpec(1, 128, 256)])
+    with pytest.raises(ValueError, match="padding sink"):
+        GraphBatcher([BucketSpec(4, 1, 256)])
+    # a single real graph in a valid minimal bucket batches fine
+    out = list(GraphBatcher([BucketSpec(2, 32, 32)]).batches([tiny(4)]))
+    assert len(out) == 1 and int(out[0].graph_mask.sum()) == 1
+
+
 def test_multi_bucket_picks_smallest():
     small = BucketSpec(4, 16, 16)
     big = BucketSpec(8, 64, 64)
