@@ -84,7 +84,9 @@ class BatchedGraphs(NamedTuple):
     """Device-ready batch. All shapes static within a bucket.
 
     node_feats: dict of ``[max_nodes, ...]`` arrays.
-    senders/receivers: ``[max_edges]`` int32 into the node axis.
+    senders/receivers: ``[max_edges]`` int32 into the node axis, SORTED by
+    receiver (``batch_np`` contract) so segment reductions over receivers
+    may pass ``indices_are_sorted=True``.
     node_gidx: ``[max_nodes]`` int32 graph slot of each node.
     node_mask / edge_mask / graph_mask: bool validity masks.
     """
@@ -142,6 +144,16 @@ def batch_np(
         node_gidx[node_off : node_off + nn] = gi
         node_off += nn
         edge_off += ne
+
+    # Contract: edges sorted by receiver (stable). Real receivers are all
+    # < max_nodes-1 (the padding sink), so padding edges stay at the end.
+    # Sorting here — cheap numpy on the host, once per batch — lets every
+    # device-side scatter-add take XLA's sorted-segment fast path, and the
+    # model no longer pays a device-side O(E log² E) bitonic argsort once
+    # per jitted forward.
+    order = np.argsort(receivers, kind="stable")
+    senders = senders[order]
+    receivers = receivers[order]
 
     node_feats: dict[str, np.ndarray] = {}
     keys = graphs[0].node_feats.keys() if graphs else ()

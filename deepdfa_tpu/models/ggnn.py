@@ -77,11 +77,18 @@ class GatedGraphConv(nn.Module):
     replaced by closed-form segment ops, ``ops/union.py``). Union
     aggregation treats messages as soft membership bits, matching the
     reaching-definitions meet operator ∪.
+
+    ``edges_sorted``: whether edges arrive sorted by receiver. True is the
+    ``batch_np`` contract (every batch in this framework) and lets each
+    scatter-add take XLA's sorted-segment fast path. Callers feeding
+    hand-built edge lists that are NOT receiver-sorted MUST pass False —
+    a false promise makes TPU segment reductions silently wrong.
     """
 
     out_feats: int
     n_steps: int
     aggregation: str = "sum"
+    edges_sorted: bool = True
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -106,23 +113,18 @@ class GatedGraphConv(nn.Module):
                 if self.aggregation == "union_simple"
                 else segment_union_relu
             )
-        if self.aggregation == "sum":
-            # Sort edges by receiver ONCE per forward (receivers are constant
-            # across steps): every scatter-add in the unrolled chain then runs
-            # XLA's sorted-segment fast path instead of the general
-            # duplicate-index scatter. Sum is permutation-invariant, so the
-            # math is unchanged (addition order differs within a segment —
-            # float-level only).
-            order = jnp.argsort(receivers)
-            senders = jnp.take(senders, order)
-            receivers = jnp.take(receivers, order)
+        # Edges arrive sorted by receiver — the ``batch_np`` contract (see
+        # ``BatchedGraphs``) — so every scatter-add in the unrolled chain runs
+        # XLA's sorted-segment fast path with NO device-side argsort: the
+        # O(E log² E) bitonic sort this used to pay per jitted forward now
+        # happens once per batch as a numpy argsort on the host.
         # Python loop, unrolled by trace: n_steps is small (5) and static;
         # unrolling lets XLA pipeline the matmuls instead of a lax.scan barrier.
         for _ in range(self.n_steps):
             msg_src = edge_linear(h)
             if self.aggregation == "sum":
                 agg = segment_sum(gather(msg_src, senders), receivers, n_nodes,
-                                  indices_are_sorted=True)
+                                  indices_are_sorted=self.edges_sorted)
             else:
                 # union space is [0,1] soft membership: messages AND the
                 # node's own state map through sigmoid (the reference fold
@@ -131,7 +133,8 @@ class GatedGraphConv(nn.Module):
                 # union algebra valid for our unconstrained GRU state and
                 # matches exactly at saturation)
                 msgs = nn.sigmoid(msg_src)
-                agg = union(nn.sigmoid(h), msgs, senders, receivers)
+                agg = union(nn.sigmoid(h), msgs, senders, receivers,
+                            indices_are_sorted=self.edges_sorted)
             h = gru(agg, h)
         return h
 

@@ -43,15 +43,18 @@ def relu_union(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return 1.0 - jnp.maximum(1.0 - (a + b), 0.0)
 
 
-def _segment_prod(data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+def _segment_prod(data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int,
+                  indices_are_sorted: bool = False) -> jnp.ndarray:
     """Product per segment via exp/sum-of-logs is unstable at 0; use the
     complement-log trick only where safe — here a direct scatter-multiply:
     log-free product via ``segment_sum`` of ``log`` is avoided by computing
     ``exp(Σ log(max(x, eps)))`` with an exact-zero mask."""
     eps = jnp.finfo(data.dtype).tiny
     logs = jnp.log(jnp.maximum(data, eps))
-    log_prod = segment_sum(logs, segment_ids, num_segments)
-    has_zero = segment_sum((data <= 0).astype(data.dtype), segment_ids, num_segments)
+    log_prod = segment_sum(logs, segment_ids, num_segments,
+                           indices_are_sorted=indices_are_sorted)
+    has_zero = segment_sum((data <= 0).astype(data.dtype), segment_ids,
+                           num_segments, indices_are_sorted=indices_are_sorted)
     return jnp.where(has_zero > 0, 0.0, jnp.exp(log_prod))
 
 
@@ -60,11 +63,13 @@ def segment_union_simple(
     messages: jnp.ndarray,
     senders: jnp.ndarray,
     receivers: jnp.ndarray,
+    indices_are_sorted: bool = False,
 ) -> jnp.ndarray:
     """Fold ``simple_union`` over each node's incoming messages and its own
     state: ``1 - (1-h) · Π_incoming (1 - msg)``."""
     comp = 1.0 - gather(messages, senders)
-    prod = _segment_prod(comp, receivers, h.shape[0])
+    prod = _segment_prod(comp, receivers, h.shape[0],
+                         indices_are_sorted=indices_are_sorted)
     return 1.0 - (1.0 - h) * prod
 
 
@@ -73,8 +78,10 @@ def segment_union_relu(
     messages: jnp.ndarray,
     senders: jnp.ndarray,
     receivers: jnp.ndarray,
+    indices_are_sorted: bool = False,
 ) -> jnp.ndarray:
     """Fold ``relu_union`` over incoming messages + own state:
     ``min(1, h + Σ_incoming msg)`` (exact for inputs in [0,1])."""
-    total = segment_sum(gather(messages, senders), receivers, h.shape[0])
+    total = segment_sum(gather(messages, senders), receivers, h.shape[0],
+                        indices_are_sorted=indices_are_sorted)
     return 1.0 - jnp.maximum(1.0 - (h + total), 0.0)
