@@ -74,13 +74,21 @@ NOMINAL_BF16_TFLOPS = {
 }
 
 
-def build_batches(n_batches: int, input_dim: int, batch_graphs: int = 256):
+def build_corpus(n_graphs: int, input_dim: int):
+    """ONE synthetic Big-Vul-shaped corpus per bench run — every layout and
+    batch size packs (a prefix of) the same graphs, so segment-vs-dense and
+    batch-size comparisons are apples-to-apples by construction."""
+    from deepdfa_tpu.data.synthetic import random_dataset
+
+    return random_dataset(n_graphs, seed=0, input_dim=input_dim)
+
+
+def build_batches(corpus, n_batches: int, batch_graphs: int = 256):
     """Corpus-derived buckets; keep only batches of the main (largest) bucket
     shape so one compiled shape is timed at near-full occupancy."""
     from deepdfa_tpu.data.graphs import GraphBatcher, derive_buckets, padding_efficiency
-    from deepdfa_tpu.data.synthetic import random_dataset
 
-    graphs = random_dataset(int(n_batches * batch_graphs * 1.5), seed=0, input_dim=input_dim)
+    graphs = corpus[: int(n_batches * batch_graphs * 1.5)]
     buckets = derive_buckets(graphs, batch_graphs)
     main = buckets[-1]
     batcher = GraphBatcher(buckets)
@@ -204,6 +212,90 @@ def _stack_tiled(batches, k: int):
     return jax.tree.map(lambda x: jnp.take(x, idx, axis=0), stacked)
 
 
+def _time_chained_inference(apply_fn, params, batches, k: int, trials: int = 3):
+    """Shared chained-protocol inference timing for BOTH graph layouts: one
+    jitted ``lax.scan`` over a cycling batch index whose scalar readback
+    depends on every step. The distinct batches are device-resident ONCE
+    (len(batches) copies, k-independent memory — tiling k copies of a dense
+    adjacency stack would cost GBs); the scan body gathers batch ``i``, so
+    data still varies per step and XLA cannot hoist loop-invariant work.
+    Returns best-of-``trials`` wall seconds for the whole k-chain."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                           *batches)
+    idx = jnp.asarray(np.arange(k) % len(batches), jnp.int32)
+
+    @jax.jit
+    def chained(params, stacked, idx):
+        def body(acc, i):
+            batch = jax.tree.map(lambda x: x[i], stacked)
+            logits = apply_fn(params, batch)
+            return acc + jnp.sum(logits.astype(jnp.float32)), None
+
+        acc, _ = lax.scan(body, jnp.zeros((), jnp.float32), idx)
+        return acc
+
+    _sync(chained(params, stacked, idx))  # compile + warm
+    return min(
+        _time_once(lambda: _sync(chained(params, stacked, idx)))
+        for _ in range(trials)
+    )
+
+
+def build_dense_batches(corpus, n_batches: int, batch_graphs: int = 256):
+    """Dense-adjacency batches over the same corpus prefix as
+    :func:`build_batches`: each graph in its own ``nodes_per_graph`` slot
+    (p99-derived), message passing as batched matmuls. Returns
+    (batches, occupancy, n_dropped)."""
+    from deepdfa_tpu.data.dense import DenseBatcher, derive_dense_size
+
+    graphs = corpus[: int(n_batches * batch_graphs * 1.5)]
+    npg = derive_dense_size(graphs, quantile=0.99)
+    batcher = DenseBatcher(max_graphs=batch_graphs, nodes_per_graph=npg)
+    batches = []
+    for b in batcher.batches(graphs):
+        if int(b.graph_mask.sum()) == batch_graphs:  # full batches only
+            batches.append(b)
+        if len(batches) == n_batches:
+            break
+    if not batches:
+        raise RuntimeError(f"no full dense batches (nodes_per_graph={npg})")
+    return batches, batcher.occupancy(batches), batcher.n_dropped
+
+
+def bench_chained_dense(batches, k: int, dtype: str = "bfloat16", trials: int = 3):
+    """Chained protocol over the dense-adjacency forward (shared timing
+    helper — identical protocol to the segment layout by construction)."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import ExperimentConfig
+    from deepdfa_tpu.models.ggnn_dense import GGNNDense
+
+    cfg = ExperimentConfig()
+    cfg = _dc.replace(cfg, model=_dc.replace(cfg.model, dtype=dtype))
+    model = GGNNDense(cfg=cfg.model, input_dim=cfg.input_dim)
+    dev0 = jax.tree.map(jnp.asarray, batches[0])
+    params = jax.jit(lambda: model.init(jax.random.key(0), dev0)["params"])()
+    real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
+
+    apply_fn = lambda p, b: model.apply({"params": p}, b)
+    flops_step = _cost_flops(jax.jit(apply_fn), params, dev0)
+    wall = _time_chained_inference(apply_fn, params, batches, k, trials)
+    return {
+        "graphs_per_sec": k * real_graphs / wall,
+        "step_ms": wall / k * 1e3,
+        "flops_per_step": flops_step,
+        "wall_s": wall,
+        "k": k,
+    }
+
+
 def _setup_model(dtype: str):
     import dataclasses
 
@@ -234,7 +326,6 @@ def bench_chained(batches, k: int, train: bool, dtype: str = "bfloat16",
     from deepdfa_tpu.train.metrics import ConfusionState
 
     model, trainer = _setup_model(dtype)
-    stacked = _stack_tiled(batches, k)
     dev0 = jax.tree.map(jnp.asarray, batches[0])
     state = trainer.init_state(dev0)
     real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
@@ -244,6 +335,7 @@ def bench_chained(batches, k: int, train: bool, dtype: str = "bfloat16",
     # trip count, so analysing the chained fn and dividing by k would
     # under-report by ~k× and neuter the roofline refusal gate.
     if train:
+        stacked = _stack_tiled(batches, k)
         step = trainer.train_step  # nested jit inlines under trace
         metrics0 = ConfusionState.zeros()
         flops_step = _cost_flops(step, state, dev0, metrics0)
@@ -263,24 +355,15 @@ def bench_chained(batches, k: int, train: bool, dtype: str = "bfloat16",
             )
             return jnp.sum(losses) + 0.0 * checksum, st
 
-        args = (state, stacked)
+        _sync(chained(state, stacked))  # compile + warm
+        wall = min(
+            _time_once(lambda: _sync(chained(state, stacked)))
+            for _ in range(trials)
+        )
     else:
-        fwd = jax.jit(lambda p, b: model.apply({"params": p}, b))
-        flops_step = _cost_flops(fwd, state.params, dev0)
-
-        @jax.jit
-        def chained(params, stacked):
-            def body(acc, batch):
-                logits = model.apply({"params": params}, batch)
-                return acc + jnp.sum(logits.astype(jnp.float32)), None
-
-            acc, _ = lax.scan(body, jnp.zeros((), jnp.float32), stacked)
-            return acc
-
-        args = (state.params, stacked)
-
-    _sync(chained(*args))  # compile + warm
-    wall = min(_time_once(lambda: _sync(chained(*args))) for _ in range(trials))
+        apply_fn = lambda p, b: model.apply({"params": p}, b)
+        flops_step = _cost_flops(jax.jit(apply_fn), state.params, dev0)
+        wall = _time_chained_inference(apply_fn, state.params, batches, k, trials)
     return {
         "graphs_per_sec": k * real_graphs / wall,
         "step_ms": wall / k * 1e3,
@@ -527,7 +610,9 @@ def main():
     from deepdfa_tpu.config import FeatureConfig
 
     _progress("building corpus batches (host)")
-    batches, occupancy = build_batches(args.batches, FeatureConfig().input_dim)
+    # one corpus sized for the largest consumer (superbatch-2048 peak)
+    corpus = build_corpus(int(2 * 2048 * 1.5), FeatureConfig().input_dim)
+    batches, occupancy = build_batches(corpus, args.batches)
     real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
 
     backend, device_kind = _init_backend_with_retry()
@@ -535,7 +620,19 @@ def main():
     roofline = measure_roofline()
     _progress(f"roofline {roofline / 1e12:.1f} TFLOP/s; chained inference (k={args.chain})")
     chained = bench_chained(batches, args.chain, train=False)
-    _progress(f"chained: {chained['graphs_per_sec']:.0f} g/s; chained train")
+    _progress(f"chained: {chained['graphs_per_sec']:.0f} g/s; dense-adjacency chained")
+    dense = dense_occ = dense_real = None
+    dense_error = dense_dropped = None
+    try:
+        dense_batches, dense_occ, dense_dropped = build_dense_batches(
+            corpus, args.batches
+        )
+        dense_real = float(np.mean([int(b.graph_mask.sum()) for b in dense_batches]))
+        dense = bench_chained_dense(dense_batches, args.chain)
+        _progress(f"dense: {dense['graphs_per_sec']:.0f} g/s; chained train")
+    except Exception as e:  # recorded verbatim, never swallowed
+        dense_error = f"{type(e).__name__}: {e}"
+        _progress(f"dense path failed: {dense_error}; chained train")
     chained_train = bench_chained(batches, max(args.chain // 4, 8), train=True)
     _progress("single-dispatch strict/pipelined")
     strict = bench_jax(batches, args.steps, train=False)
@@ -548,7 +645,7 @@ def main():
     for bg in (1024, 2048):
         _progress(f"superbatch-{bg} peak")
         try:
-            peak_batches, _ = build_batches(2, FeatureConfig().input_dim, batch_graphs=bg)
+            peak_batches, _ = build_batches(corpus, 2, batch_graphs=bg)
             pr = float(np.mean([int(b.graph_mask.sum()) for b in peak_batches]))
             peak_runs[str(bg)] = (
                 bench_chained(peak_batches, max(args.chain // 4, 8), train=False),
@@ -562,8 +659,21 @@ def main():
     base_gps = None if args.skip_baseline else bench_torch_cpu(batches, args.baseline_steps)
 
     refused: dict[str, str] = {}
-    value = _validate("value", chained["graphs_per_sec"], chained["flops_per_step"],
-                      real_graphs, roofline, refused)
+    seg_value = _validate("segment_graphs_per_sec", chained["graphs_per_sec"],
+                          chained["flops_per_step"], real_graphs, roofline, refused)
+    dense_value = None
+    if dense is not None:
+        dense_value = _validate("dense_graphs_per_sec", dense["graphs_per_sec"],
+                                dense["flops_per_step"], dense_real, roofline,
+                                refused)
+    # Headline: the faster of the two validated layouts of the SAME model
+    # (identical parameters; parity-tested forwards).
+    if dense_value is not None and (seg_value is None or dense_value > seg_value):
+        value, layout = dense_value, "dense_adjacency"
+        head_flops_per_graph = (dense["flops_per_step"] or 0.0) / dense_real
+    else:
+        value, layout = seg_value, "segment"
+        head_flops_per_graph = (chained["flops_per_step"] or 0.0) / real_graphs
     train_gps = _validate("train_graphs_per_sec", chained_train["graphs_per_sec"],
                           chained_train["flops_per_step"], real_graphs, roofline, refused)
     strict_gps = _validate("strict_graphs_per_sec", strict["graphs_per_sec"],
@@ -576,16 +686,19 @@ def main():
     peak_valid = [v for v in peak_by_size.values() if v is not None]
     peak_gps = max(peak_valid) if peak_valid else None
 
-    flops_per_graph = (chained["flops_per_step"] or 0.0) / real_graphs
     # a refused headline must not fabricate implied/MFU numbers — keep null
     implied_tflops = (
-        value * flops_per_graph / 1e12 if value is not None else None
+        value * head_flops_per_graph / 1e12 if value is not None else None
     )
     nominal = _nominal_peak_tflops()
     # North-star bound: what 1×A100 would do on the same model at a generous
-    # MFU. The A100/DGL reference runs ragged batches, paying only real-graph
-    # FLOPs — so its per-graph cost excludes our padding share.
-    real_flops_per_graph = flops_per_graph * occupancy["nodes"]
+    # MFU. The A100/DGL reference runs ragged SPARSE batches, paying only
+    # real-graph segment-layout FLOPs — so its per-graph cost is the segment
+    # path's, excluding our padding share (and never the dense layout's
+    # deliberately larger n² matmul FLOPs).
+    real_flops_per_graph = (
+        (chained["flops_per_step"] or 0.0) / real_graphs * occupancy["nodes"]
+    )
     a100_est_gps = (
         A100_BF16_PEAK_TFLOPS * 1e12 * A100_ASSUMED_MFU / real_flops_per_graph
         if real_flops_per_graph else None
@@ -599,13 +712,25 @@ def main():
         "backend": backend,
         "device_kind": device_kind,
         "dtype": "bfloat16",
+        "layout": layout,
         "timing": (
             f"chained: one jitted scan over k={chained['k']} device-resident "
-            "batches, scalar readback depends on every step; best of 3"
+            "batches, scalar readback depends on every step; best of 3; "
+            "headline = faster of segment / dense-adjacency layouts "
+            "(same parameters, parity-tested forwards)"
         ),
+        "segment_graphs_per_sec": seg_value,
         "step_ms": round(chained["step_ms"], 3),
         "chain_wall_s": round(chained["wall_s"], 3),
         "flops_per_step": chained["flops_per_step"],
+        "dense_graphs_per_sec": dense_value,
+        "dense_step_ms": round(dense["step_ms"], 3) if dense else None,
+        "dense_flops_per_step": dense["flops_per_step"] if dense else None,
+        "dense_occupancy": (
+            {k: round(v, 3) for k, v in dense_occ.items()} if dense_occ else None
+        ),
+        "dense_dropped_oversize": dense_dropped,
+        "dense_error": dense_error,
         "implied_tflops": round(implied_tflops, 2) if implied_tflops is not None else None,
         "roofline_tflops": round(roofline / 1e12, 1),
         "roofline_note": ("parallel independent bf16 matmul chains — the "

@@ -1,0 +1,180 @@
+"""GGNN over dense per-graph adjacency: message passing as batched matmuls.
+
+Same model as :class:`deepdfa_tpu.models.ggnn.GGNN` — identical parameter
+tree (submodule names match, so checkpoints are interchangeable between the
+two forwards) — but the graph is a ``[G, n, n]`` dense adjacency instead of
+flat edge lists, and one step of message passing is
+
+    ``agg = einsum('gji,gjd->gid', adj, msg)``
+
+a batched matmul the MXU executes at full tilt, replacing the
+gather + scatter-add chain (which on TPU runs through the VPU scatter path
+and bounded the segment-layout bench at ~3% of the matmul ceiling). The
+union-lattice aggregators become matmuls too:
+
+- ``union_relu``:   ``min(1, σh + adj^T σm)`` — same einsum;
+- ``union_simple``: ``1 - (1-σh) · exp(adj^T log(1-σm))`` — the iterated
+  product over incoming edges turns into a matmul in log space (duplicate
+  edges contribute their count, exactly like repeated segment entries).
+
+Reference semantics preserved (DGL ``GatedGraphConv`` + attention pooling,
+``flow_gnn/ggnn.py:22-109``, union fold ``clipper.py:50-77``); parity with
+the segment-layout forward is asserted by ``tests/test_ggnn_dense.py`` on
+shared parameters. Trade-off: O(n²d) FLOPs instead of O(Ed) — a dozen
+extra MFLOPs per graph at n≈64, bought at matmul speed; padding nodes are
+inert (zero adjacency rows/cols, masked out of pooling).
+
+The dense-block pattern follows the public sparse-GNN-on-dense-hardware
+recipe (arXiv:1906.11786), applied per-graph because CFGs are tiny.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepdfa_tpu.config import ALL_SUBKEYS, GGNNConfig
+from deepdfa_tpu.data.dense import DenseBatch
+from deepdfa_tpu.models.ggnn import GRUCell
+
+__all__ = ["GGNNDense"]
+
+
+class GatedGraphConvDense(nn.Module):
+    """n_steps of (linear → adjacency matmul → GRU) on ``[G, n, d]`` states."""
+
+    out_feats: int
+    n_steps: int
+    aggregation: str = "sum"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: jnp.ndarray, adj: jnp.ndarray) -> jnp.ndarray:
+        if h.shape[-1] > self.out_feats:
+            raise ValueError("in_feats must be <= out_feats (DGL contract)")
+        if h.shape[-1] < self.out_feats:
+            pad = jnp.zeros((*h.shape[:-1], self.out_feats - h.shape[-1]), h.dtype)
+            h = jnp.concatenate([h, pad], axis=-1)
+        if self.aggregation not in ("sum", "union_simple", "union_relu"):
+            raise ValueError(f"unknown aggregation {self.aggregation!r}")
+        edge_linear = nn.Dense(self.out_feats, dtype=self.dtype, name="edge_linear")
+        gru = GRUCell(self.out_feats, dtype=self.dtype, name="gru")
+        adj = adj.astype(self.dtype)
+        for _ in range(self.n_steps):
+            msg = edge_linear(h)
+            if self.aggregation == "sum":
+                agg = jnp.einsum("gji,gjd->gid", adj, msg)
+            elif self.aggregation == "union_relu":
+                total = jnp.einsum("gji,gjd->gid", adj, nn.sigmoid(msg))
+                agg = 1.0 - jnp.maximum(1.0 - (nn.sigmoid(h) + total), 0.0)
+            else:  # union_simple
+                m = nn.sigmoid(msg)
+                tiny = jnp.finfo(jnp.float32).tiny
+                logs = jnp.log(jnp.maximum(1.0 - m, tiny).astype(jnp.float32))
+                prod = jnp.exp(
+                    jnp.einsum("gji,gjd->gid", adj.astype(jnp.float32), logs)
+                ).astype(h.dtype)
+                agg = 1.0 - (1.0 - nn.sigmoid(h)) * prod
+            h = gru(agg, h)
+        return h
+
+
+class GlobalAttentionPoolingDense(nn.Module):
+    """Masked softmax attention readout over the node axis of ``[G, n, d]``."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: jnp.ndarray, node_mask: jnp.ndarray) -> jnp.ndarray:
+        gate_logit = nn.Dense(1, dtype=self.dtype, name="gate")(h)[..., 0]
+        neg = jnp.asarray(-jnp.inf, gate_logit.dtype)
+        gate_logit = jnp.where(node_mask, gate_logit, neg)
+        gate_logit = gate_logit - jnp.max(
+            jnp.where(node_mask, gate_logit, -1e30), axis=1, keepdims=True
+        )
+        exp = jnp.where(node_mask, jnp.exp(gate_logit), 0.0)
+        denom = jnp.sum(exp, axis=1, keepdims=True)
+        gate = exp / jnp.where(denom == 0, 1.0, denom)
+        return jnp.einsum("gn,gnd->gd", gate.astype(h.dtype), h)
+
+
+class GGNNDense(nn.Module):
+    """Dense-layout forward of the flagship model. Parameter tree is
+    identical to :class:`GGNN` — init either module and apply with the
+    other's params."""
+
+    cfg: GGNNConfig
+    input_dim: int
+
+    def setup(self):
+        cfg = self.cfg
+        self.compute_dtype = jnp.dtype(cfg.dtype)
+        embed_dim = cfg.hidden_dim
+        if cfg.concat_all_absdf:
+            self.embeddings = {
+                sk: nn.Embed(
+                    self.input_dim, embed_dim, dtype=self.compute_dtype,
+                    name=f"embed_{sk}",
+                )
+                for sk in ALL_SUBKEYS
+            }
+            embed_dim *= len(ALL_SUBKEYS)
+            hidden_dim = cfg.hidden_dim * len(ALL_SUBKEYS)
+        else:
+            self.embedding = nn.Embed(
+                self.input_dim, embed_dim, dtype=self.compute_dtype, name="embed"
+            )
+            hidden_dim = cfg.hidden_dim
+        self.ggnn = GatedGraphConvDense(
+            out_feats=hidden_dim,
+            n_steps=cfg.n_steps,
+            aggregation=cfg.aggregation,
+            dtype=self.compute_dtype,
+        )
+        out_in = embed_dim + hidden_dim
+        if cfg.label_style == "graph":
+            self.pooling = GlobalAttentionPoolingDense(dtype=self.compute_dtype)
+        if not cfg.encoder_mode:
+            self.head = [
+                nn.Dense(
+                    1 if i == cfg.num_output_layers - 1 else out_in,
+                    dtype=self.compute_dtype,
+                    name=f"out_{i}",
+                )
+                for i in range(cfg.num_output_layers)
+            ]
+
+    def embed_nodes(self, batch: DenseBatch) -> jnp.ndarray:
+        if self.cfg.concat_all_absdf:
+            # fused single gather across the 4 stacked subkey tables (same
+            # trick as GGNN.embed_nodes, shapes [G, n] instead of [N])
+            table = jnp.concatenate(
+                [self.embeddings[sk].embedding for sk in ALL_SUBKEYS], axis=0
+            ).astype(self.compute_dtype)
+            ids = jnp.stack(
+                [
+                    batch.node_feats[f"_ABS_DATAFLOW_{sk}"] + i * self.input_dim
+                    for i, sk in enumerate(ALL_SUBKEYS)
+                ],
+                axis=-1,
+            )
+            out = jnp.take(table, ids, axis=0)
+            return out.reshape(*ids.shape[:-1], -1)
+        return self.embedding(batch.node_feats["_ABS_DATAFLOW"])
+
+    def __call__(self, batch: DenseBatch) -> jnp.ndarray:
+        cfg = self.cfg
+        feat_embed = self.embed_nodes(batch)  # [G, n, e]
+        ggnn_out = self.ggnn(feat_embed, jnp.asarray(batch.adj))
+        out = jnp.concatenate([ggnn_out, feat_embed], axis=-1)
+        if cfg.label_style == "graph":
+            out = self.pooling(out, jnp.asarray(batch.node_mask))
+        if cfg.encoder_mode:
+            return out
+        for i, layer in enumerate(self.head):
+            out = layer(out)
+            if i != len(self.head) - 1:
+                out = nn.relu(out)
+        return out[..., 0].astype(jnp.float32)

@@ -1,0 +1,135 @@
+"""Dense-adjacency GGNN: parameter-tree compatibility and numerical parity
+with the segment-layout forward on SHARED parameters. The dense path is the
+TPU fast path (message passing as batched matmuls); the segment path is the
+semantics anchor (itself parity-tested against the torch/DGL reference in
+``test_ggnn_parity.py``), so agreement here chains the dense forward to the
+reference semantics."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepdfa_tpu.config import GGNNConfig
+from deepdfa_tpu.data.dense import DenseBatcher, batch_dense, derive_dense_size
+from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+from deepdfa_tpu.data.synthetic import random_dataset
+from deepdfa_tpu.models.ggnn import GGNN
+from deepdfa_tpu.models.ggnn_dense import GGNNDense
+
+INPUT_DIM = 52
+
+
+def _corpus(n=6, seed=0):
+    return random_dataset(n, seed=seed, input_dim=INPUT_DIM, mean_nodes=12)
+
+
+def _both_batches(graphs):
+    sparse = next(
+        GraphBatcher([BucketSpec(len(graphs) + 1, 512, 1024)]).batches(graphs)
+    )
+    n = max(g.n_nodes for g in graphs)
+    dense = batch_dense(graphs, max_graphs=len(graphs), nodes_per_graph=n)
+    return sparse, dense
+
+
+@pytest.mark.parametrize("aggregation", ["sum", "union_relu", "union_simple"])
+def test_dense_matches_segment_forward(aggregation):
+    graphs = _corpus()
+    sparse, dense = _both_batches(graphs)
+    cfg = GGNNConfig(hidden_dim=8, n_steps=3, num_output_layers=2,
+                     aggregation=aggregation)
+    sparse_model = GGNN(cfg=cfg, input_dim=INPUT_DIM)
+    dense_model = GGNNDense(cfg=cfg, input_dim=INPUT_DIM)
+
+    sb = jax.tree.map(jnp.asarray, sparse)
+    db = jax.tree.map(jnp.asarray, dense)
+    params = sparse_model.init(jax.random.key(0), sb)["params"]
+
+    out_sparse = np.asarray(sparse_model.apply({"params": params}, sb))
+    out_dense = np.asarray(dense_model.apply({"params": params}, db))
+    n_real = len(graphs)
+    np.testing.assert_allclose(out_dense[:n_real], out_sparse[:n_real],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_param_trees_interchange_both_directions():
+    graphs = _corpus(4, seed=1)
+    sparse, dense = _both_batches(graphs)
+    cfg = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=3)
+    sb = jax.tree.map(jnp.asarray, sparse)
+    db = jax.tree.map(jnp.asarray, dense)
+    p_sparse = GGNN(cfg=cfg, input_dim=INPUT_DIM).init(jax.random.key(1), sb)["params"]
+    p_dense = GGNNDense(cfg=cfg, input_dim=INPUT_DIM).init(jax.random.key(2), db)["params"]
+    s_paths = {jax.tree_util.keystr(k): v.shape
+               for k, v in jax.tree_util.tree_leaves_with_path(p_sparse)}
+    d_paths = {jax.tree_util.keystr(k): v.shape
+               for k, v in jax.tree_util.tree_leaves_with_path(p_dense)}
+    assert s_paths == d_paths
+
+
+def test_encoder_mode_parity():
+    graphs = _corpus(3, seed=2)
+    sparse, dense = _both_batches(graphs)
+    cfg = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2,
+                     encoder_mode=True)
+    sb = jax.tree.map(jnp.asarray, sparse)
+    db = jax.tree.map(jnp.asarray, dense)
+    model_s = GGNN(cfg=cfg, input_dim=INPUT_DIM)
+    params = model_s.init(jax.random.key(3), sb)["params"]
+    emb_s = np.asarray(model_s.apply({"params": params}, sb))
+    emb_d = np.asarray(
+        GGNNDense(cfg=cfg, input_dim=INPUT_DIM).apply({"params": params}, db)
+    )
+    np.testing.assert_allclose(emb_d[: len(graphs)], emb_s[: len(graphs)],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_duplicate_edges_accumulate_like_segments():
+    """adj counts duplicate edges; segment_sum adds duplicate entries —
+    forwards must agree on a multigraph."""
+    g = _corpus(1, seed=4)[0]
+    g = dataclasses.replace(
+        g,
+        senders=np.concatenate([g.senders, g.senders[:3]]),
+        receivers=np.concatenate([g.receivers, g.receivers[:3]]),
+    )
+    sparse, dense = _both_batches([g])
+    cfg = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2)
+    sb = jax.tree.map(jnp.asarray, sparse)
+    db = jax.tree.map(jnp.asarray, dense)
+    model_s = GGNN(cfg=cfg, input_dim=INPUT_DIM)
+    params = model_s.init(jax.random.key(5), sb)["params"]
+    out_s = np.asarray(model_s.apply({"params": params}, sb))
+    out_d = np.asarray(
+        GGNNDense(cfg=cfg, input_dim=INPUT_DIM).apply({"params": params}, db)
+    )
+    np.testing.assert_allclose(out_d[:1], out_s[:1], rtol=1e-4, atol=1e-4)
+
+
+def test_dense_batcher_packs_and_drops():
+    graphs = _corpus(10, seed=6) + [
+        dataclasses.replace(_corpus(1, seed=7)[0], gid=99)
+    ]
+    big = max(g.n_nodes for g in graphs[:10])
+    batcher = DenseBatcher(max_graphs=4, nodes_per_graph=big)
+    # make the extra graph oversize
+    graphs[-1].node_feats = {
+        k: np.concatenate([v] * ((big // max(len(v), 1)) + 2))
+        for k, v in graphs[-1].node_feats.items()
+    }
+    batches = list(batcher.batches(graphs))
+    assert batcher.n_dropped == 1
+    total_real = sum(int(b.graph_mask.sum()) for b in batches)
+    assert total_real == 10
+    occ = batcher.occupancy(batches)
+    assert 0 < occ["nodes"] <= 1 and 0 < occ["graphs"] <= 1
+
+
+def test_derive_dense_size_rounds_up():
+    graphs = _corpus(20, seed=8)
+    n = derive_dense_size(graphs)
+    assert n % 8 == 0
+    assert n >= int(np.quantile([g.n_nodes for g in graphs], 0.99))
