@@ -247,28 +247,35 @@ def _time_chained_inference(apply_fn, params, batches, k: int, trials: int = 3):
 
 def build_dense_batches(corpus, n_batches: int, batch_graphs: int = 256):
     """Dense-adjacency batches over the same corpus prefix as
-    :func:`build_batches`: each graph in its own ``nodes_per_graph`` slot
-    (p99-derived), message passing as batched matmuls. Returns
-    (batches, occupancy, n_dropped)."""
-    from deepdfa_tpu.data.dense import DenseBatcher, derive_dense_size
+    :func:`build_batches`, size-bucketed ({p50, p99} per-graph node budgets —
+    slot cost scales n², so routing median graphs to the small shape roughly
+    halves wasted matmul FLOPs at one extra compile). Returns
+    (groups, occupancy, n_dropped): ``groups`` maps nodes_per_graph → up to
+    ``n_batches`` full batches of that compiled shape."""
+    from deepdfa_tpu.data.dense import DenseBatcher, derive_dense_sizes
 
     graphs = corpus[: int(n_batches * batch_graphs * 1.5)]
-    npg = derive_dense_size(graphs, quantile=0.99)
-    batcher = DenseBatcher(max_graphs=batch_graphs, nodes_per_graph=npg)
-    batches = []
-    for b in batcher.batches(graphs):
-        if int(b.graph_mask.sum()) == batch_graphs:  # full batches only
-            batches.append(b)
-        if len(batches) == n_batches:
-            break
-    if not batches:
-        raise RuntimeError(f"no full dense batches (nodes_per_graph={npg})")
-    return batches, batcher.occupancy(batches), batcher.n_dropped
+    sizes = derive_dense_sizes(graphs, quantiles=(0.5, 0.99))
+    batcher = DenseBatcher(max_graphs=batch_graphs, nodes_per_graph=sizes)
+    groups: dict[int, list] = {}
+    for b in batcher.batches(graphs, limit_per_size=n_batches):
+        groups.setdefault(b.nodes_per_graph, []).append(b)
+    if not groups:
+        raise RuntimeError(f"no full dense batches (sizes={sizes})")
+    all_batches = [b for g in groups.values() for b in g]
+    return groups, batcher.occupancy(all_batches), batcher.n_dropped
 
 
-def bench_chained_dense(batches, k: int, dtype: str = "bfloat16", trials: int = 3):
+def bench_chained_dense(groups, k: int, dtype: str = "bfloat16", trials: int = 3):
     """Chained protocol over the dense-adjacency forward (shared timing
-    helper — identical protocol to the segment layout by construction)."""
+    helper — identical protocol to the segment layout by construction).
+
+    ``groups`` maps nodes_per_graph → batches of that compiled shape. Each
+    shape gets its own chained scan with ``k`` split ∝ how much of the
+    corpus that shape carries; the quoted rate is the mixture
+    ``Σ graphs / Σ wall`` — large-graph batches are NOT quietly skipped.
+    ``flops_per_step`` is the k-weighted mean so the roofline gate checks
+    the same mixture it validates."""
     import dataclasses as _dc
 
     import jax
@@ -280,19 +287,39 @@ def bench_chained_dense(batches, k: int, dtype: str = "bfloat16", trials: int = 
     cfg = ExperimentConfig()
     cfg = _dc.replace(cfg, model=_dc.replace(cfg.model, dtype=dtype))
     model = GGNNDense(cfg=cfg.model, input_dim=cfg.input_dim)
-    dev0 = jax.tree.map(jnp.asarray, batches[0])
-    params = jax.jit(lambda: model.init(jax.random.key(0), dev0)["params"])()
-    real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
-
     apply_fn = lambda p, b: model.apply({"params": p}, b)
-    flops_step = _cost_flops(jax.jit(apply_fn), params, dev0)
-    wall = _time_chained_inference(apply_fn, params, batches, k, trials)
+
+    weights = {s: len(g) for s, g in groups.items()}
+    total_w = sum(weights.values())
+    ks = {s: max(round(k * w / total_w), 1) for s, w in weights.items()}
+
+    total_graphs = total_wall = total_flops = 0.0
+    flops_unknown = False
+    params = None
+    for s, batches in sorted(groups.items()):
+        dev0 = jax.tree.map(jnp.asarray, batches[0])
+        if params is None:
+            params = jax.jit(lambda: model.init(jax.random.key(0), dev0)["params"])()
+        real = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
+        flops = _cost_flops(jax.jit(apply_fn), params, dev0)
+        wall = _time_chained_inference(apply_fn, params, batches, ks[s], trials)
+        total_graphs += ks[s] * real
+        total_wall += wall
+        if flops is None:
+            # zeroing would understate the mixture and weaken the roofline
+            # refusal gate — propagate None so the gate visibly skips
+            flops_unknown = True
+        else:
+            total_flops += flops * ks[s]
+    k_total = sum(ks.values())
     return {
-        "graphs_per_sec": k * real_graphs / wall,
-        "step_ms": wall / k * 1e3,
-        "flops_per_step": flops_step,
-        "wall_s": wall,
-        "k": k,
+        "graphs_per_sec": total_graphs / total_wall,
+        "step_ms": total_wall / k_total * 1e3,
+        "flops_per_step": None if flops_unknown else total_flops / k_total,
+        "wall_s": total_wall,
+        "k": k_total,
+        "graphs_per_step": total_graphs / k_total,
+        "shapes": {str(s): ks[s] for s in sorted(groups)},
     }
 
 
@@ -610,8 +637,12 @@ def main():
     from deepdfa_tpu.config import FeatureConfig
 
     _progress("building corpus batches (host)")
-    # one corpus sized for the largest consumer (superbatch-2048 peak)
-    corpus = build_corpus(int(2 * 2048 * 1.5), FeatureConfig().input_dim)
+    # one corpus sized for the largest consumer (superbatch-2048 peak, or a
+    # bigger-than-default --batches request)
+    corpus = build_corpus(
+        max(int(2 * 2048 * 1.5), int(args.batches * 256 * 1.5)),
+        FeatureConfig().input_dim,
+    )
     batches, occupancy = build_batches(corpus, args.batches)
     real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
 
@@ -624,12 +655,13 @@ def main():
     dense = dense_occ = dense_real = None
     dense_error = dense_dropped = None
     try:
-        dense_batches, dense_occ, dense_dropped = build_dense_batches(
+        dense_groups, dense_occ, dense_dropped = build_dense_batches(
             corpus, args.batches
         )
-        dense_real = float(np.mean([int(b.graph_mask.sum()) for b in dense_batches]))
-        dense = bench_chained_dense(dense_batches, args.chain)
-        _progress(f"dense: {dense['graphs_per_sec']:.0f} g/s; chained train")
+        dense = bench_chained_dense(dense_groups, args.chain)
+        dense_real = dense["graphs_per_step"]
+        _progress(f"dense: {dense['graphs_per_sec']:.0f} g/s "
+                  f"(shapes {dense['shapes']}); chained train")
     except Exception as e:  # recorded verbatim, never swallowed
         dense_error = f"{type(e).__name__}: {e}"
         _progress(f"dense path failed: {dense_error}; chained train")
@@ -670,10 +702,16 @@ def main():
     # (identical parameters; parity-tested forwards).
     if dense_value is not None and (seg_value is None or dense_value > seg_value):
         value, layout = dense_value, "dense_adjacency"
-        head_flops_per_graph = (dense["flops_per_step"] or 0.0) / dense_real
+        head_flops_per_graph = (
+            dense["flops_per_step"] / dense_real
+            if dense["flops_per_step"] else None
+        )
     else:
         value, layout = seg_value, "segment"
-        head_flops_per_graph = (chained["flops_per_step"] or 0.0) / real_graphs
+        head_flops_per_graph = (
+            chained["flops_per_step"] / real_graphs
+            if chained["flops_per_step"] else None
+        )
     train_gps = _validate("train_graphs_per_sec", chained_train["graphs_per_sec"],
                           chained_train["flops_per_step"], real_graphs, roofline, refused)
     strict_gps = _validate("strict_graphs_per_sec", strict["graphs_per_sec"],
@@ -688,7 +726,8 @@ def main():
 
     # a refused headline must not fabricate implied/MFU numbers — keep null
     implied_tflops = (
-        value * head_flops_per_graph / 1e12 if value is not None else None
+        value * head_flops_per_graph / 1e12
+        if (value is not None and head_flops_per_graph is not None) else None
     )
     nominal = _nominal_peak_tflops()
     # North-star bound: what 1×A100 would do on the same model at a generous
@@ -726,6 +765,7 @@ def main():
         "dense_graphs_per_sec": dense_value,
         "dense_step_ms": round(dense["step_ms"], 3) if dense else None,
         "dense_flops_per_step": dense["flops_per_step"] if dense else None,
+        "dense_shapes": dense["shapes"] if dense else None,
         "dense_occupancy": (
             {k: round(v, 3) for k, v in dense_occ.items()} if dense_occ else None
         ),

@@ -31,7 +31,8 @@ import numpy as np
 
 from deepdfa_tpu.data.graphs import Graph
 
-__all__ = ["DenseBatch", "batch_dense", "DenseBatcher", "derive_dense_size"]
+__all__ = ["DenseBatch", "batch_dense", "DenseBatcher", "derive_dense_size",
+           "derive_dense_sizes"]
 
 
 class DenseBatch(NamedTuple):
@@ -111,42 +112,89 @@ def derive_dense_size(graphs: Sequence[Graph], quantile: float = 0.99,
     return int(-(-max(q, 1.0) // round_to) * round_to)
 
 
+def derive_dense_sizes(
+    graphs: Sequence[Graph],
+    quantiles: Sequence[float] = (0.5, 0.99),
+    round_to: int = 8,
+) -> list[int]:
+    """Several per-graph node budgets (one compiled shape each). Slot cost
+    scales n² in the adjacency matmuls, so a single p99 budget pads median
+    graphs ~4× in FLOPs; a {p50, p99} pair routes each graph to the smallest
+    shape that fits and roughly halves wasted matmul work at the price of
+    one extra XLA compilation."""
+    sizes = sorted({derive_dense_size(graphs, q, round_to) for q in quantiles})
+    return sizes
+
+
 class DenseBatcher:
-    """Greedy fixed-shape packer for the dense layout: emits batches of
-    ``max_graphs`` graphs, each padded to ``nodes_per_graph``. Oversize
-    graphs are dropped (counted in ``n_dropped``) or raise, matching
+    """Greedy fixed-shape packer for the dense layout: each graph goes to the
+    smallest of ``sizes`` (per-graph node budgets; one compiled shape each)
+    that fits, and full batches of ``max_graphs`` are emitted per size.
+    Oversize graphs are dropped (counted in ``n_dropped``) or raise, matching
     :class:`deepdfa_tpu.data.graphs.GraphBatcher`."""
 
-    def __init__(self, max_graphs: int, nodes_per_graph: int,
+    def __init__(self, max_graphs: int, nodes_per_graph: int | Sequence[int],
                  drop_oversize: bool = True):
-        if max_graphs < 1 or nodes_per_graph < 1:
-            raise ValueError("max_graphs and nodes_per_graph must be >= 1")
+        sizes = ([nodes_per_graph] if isinstance(nodes_per_graph, int)
+                 else sorted(nodes_per_graph))
+        if max_graphs < 1 or not sizes or min(sizes) < 1:
+            raise ValueError("max_graphs and every size must be >= 1")
         self.max_graphs = max_graphs
-        self.nodes_per_graph = nodes_per_graph
+        self.sizes = sizes
+        self.nodes_per_graph = sizes[-1]  # largest; single-size back-compat
         self.drop_oversize = drop_oversize
         self.n_dropped = 0
 
-    def batches(self, graphs: Sequence[Graph]) -> Iterator[DenseBatch]:
+    def _size_for(self, g: Graph) -> int | None:
+        for s in self.sizes:
+            if g.n_nodes <= s:
+                return s
+        return None
+
+    def batches(
+        self, graphs: Sequence[Graph], limit_per_size: int | None = None
+    ) -> Iterator[DenseBatch]:
+        """With ``limit_per_size``, emit at most that many FULL batches per
+        size, skip routing graphs to already-full sizes (a [G,n,n] adjacency
+        is several MB — packing batches only to discard them is real work),
+        and stop entirely once every size is full. Partial batches are only
+        flushed in the unlimited mode."""
         self.n_dropped = 0
-        pending: list[Graph] = []
+        pending: dict[int, list[Graph]] = {s: [] for s in self.sizes}
+        emitted: dict[int, int] = {s: 0 for s in self.sizes}
         for g in graphs:
-            if g.n_nodes > self.nodes_per_graph:
+            s = self._size_for(g)
+            if s is None:
                 if self.drop_oversize:
                     self.n_dropped += 1
                     continue
                 raise ValueError(
-                    f"graph gid={g.gid} ({g.n_nodes} nodes) exceeds "
-                    f"nodes_per_graph={self.nodes_per_graph}"
+                    f"graph gid={g.gid} ({g.n_nodes} nodes) exceeds the "
+                    f"largest dense size {self.sizes[-1]}"
                 )
-            pending.append(g)
-            if len(pending) == self.max_graphs:
-                yield batch_dense(pending, self.max_graphs, self.nodes_per_graph)
-                pending = []
-        if pending:
-            yield batch_dense(pending, self.max_graphs, self.nodes_per_graph)
+            if limit_per_size is not None and emitted[s] >= limit_per_size:
+                continue
+            pending[s].append(g)
+            if len(pending[s]) == self.max_graphs:
+                yield batch_dense(pending[s], self.max_graphs, s)
+                pending[s] = []
+                emitted[s] += 1
+                if (limit_per_size is not None
+                        and all(n >= limit_per_size for n in emitted.values())):
+                    return
+        if limit_per_size is None:
+            for s, left in pending.items():
+                if left:
+                    yield batch_dense(left, self.max_graphs, s)
 
     def occupancy(self, batches: Sequence[DenseBatch]) -> dict[str, float]:
-        """Fraction of node slots / graph slots holding real data."""
-        nodes = float(np.mean([b.node_mask.mean() for b in batches])) if batches else 0.0
-        graphs_ = float(np.mean([b.graph_mask.mean() for b in batches])) if batches else 0.0
-        return {"nodes": nodes, "graphs": graphs_}
+        """Fraction of node slots / graph slots holding real data,
+        slot-weighted (batches of different shapes hold different slot
+        counts — an unweighted per-batch mean would overstate packing)."""
+        if not batches:
+            return {"nodes": 0.0, "graphs": 0.0}
+        node_full = sum(int(b.node_mask.sum()) for b in batches)
+        node_slots = sum(b.node_mask.size for b in batches)
+        graph_full = sum(int(b.graph_mask.sum()) for b in batches)
+        graph_slots = sum(b.graph_mask.size for b in batches)
+        return {"nodes": node_full / node_slots, "graphs": graph_full / graph_slots}

@@ -128,6 +128,29 @@ def test_dense_batcher_packs_and_drops():
     assert 0 < occ["nodes"] <= 1 and 0 < occ["graphs"] <= 1
 
 
+def test_multi_size_bucketing_routes_to_smallest_fit():
+    from deepdfa_tpu.data.dense import derive_dense_sizes
+
+    graphs = _corpus(40, seed=9)
+    sizes = derive_dense_sizes(graphs, quantiles=(0.5, 0.99))
+    assert sizes == sorted(set(sizes)) and len(sizes) >= 1
+    batcher = DenseBatcher(max_graphs=8, nodes_per_graph=sizes)
+    batches = list(batcher.batches(graphs))
+    assert sum(int(b.graph_mask.sum()) for b in batches) == 40 - batcher.n_dropped
+    for b in batches:
+        assert b.nodes_per_graph in sizes
+        # every graph sits in the smallest size that fits it
+        per_graph = b.node_mask.sum(axis=1)
+        smaller = [s for s in sizes if s < b.nodes_per_graph]
+        if smaller:
+            assert per_graph[b.graph_mask].max() > max(smaller)
+    # multi-size occupancy beats single-p99 occupancy on the same corpus
+    single = DenseBatcher(max_graphs=8, nodes_per_graph=sizes[-1])
+    single_b = list(single.batches(graphs))
+    assert (batcher.occupancy(batches)["nodes"]
+            >= single.occupancy(single_b)["nodes"])
+
+
 def test_derive_dense_size_rounds_up():
     graphs = _corpus(20, seed=8)
     n = derive_dense_size(graphs)
