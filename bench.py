@@ -254,8 +254,12 @@ def build_dense_batches(corpus, n_batches: int, batch_graphs: int = 256):
     ``n_batches`` full batches of that compiled shape."""
     from deepdfa_tpu.data.dense import DenseBatcher, derive_dense_sizes
 
-    graphs = corpus[: int(n_batches * batch_graphs * 1.5)]
-    sizes = derive_dense_sizes(graphs, quantiles=(0.5, 0.99))
+    sizes = derive_dense_sizes(
+        corpus[: int(n_batches * batch_graphs * 1.5)], quantiles=(0.5, 0.99)
+    )
+    # the stream splits across len(sizes) buckets — scale the slice so each
+    # bucket can still fill n_batches full batches
+    graphs = corpus[: int(n_batches * batch_graphs * 1.5 * len(sizes))]
     batcher = DenseBatcher(max_graphs=batch_graphs, nodes_per_graph=sizes)
     groups: dict[int, list] = {}
     for b in batcher.batches(graphs, limit_per_size=n_batches):
@@ -640,7 +644,7 @@ def main():
     # one corpus sized for the largest consumer (superbatch-2048 peak, or a
     # bigger-than-default --batches request)
     corpus = build_corpus(
-        max(int(2 * 2048 * 1.5), int(args.batches * 256 * 1.5)),
+        max(int(2 * 2048 * 1.5), int(args.batches * 256 * 1.5 * 2)),
         FeatureConfig().input_dim,
     )
     batches, occupancy = build_batches(corpus, args.batches)

@@ -54,11 +54,18 @@ class TrainState(NamedTuple):
     step: jnp.ndarray
 
 
-def graph_labels(batch: BatchedGraphs) -> jnp.ndarray:
+def graph_labels(batch) -> jnp.ndarray:
     """Graph-level label = max of node ``_VULN`` per graph
     (``base_module.py:86-88``). Empty padded slots → 0 (they carry 0 weight
-    anyway, but a finite value keeps the loss NaN-free)."""
+    anyway, but a finite value keeps the loss NaN-free).
+
+    Works on both layouts: segment (:class:`BatchedGraphs`, flat nodes +
+    ``node_gidx``) and dense (:class:`deepdfa_tpu.data.dense.DenseBatch`,
+    ``[G, n]`` nodes + ``node_mask``) — the only layout-specific piece of
+    the train/eval steps, so :class:`Trainer` drives either forward."""
     vuln = batch.node_feats["_VULN"].astype(jnp.float32)
+    if not hasattr(batch, "node_gidx"):  # dense layout
+        return jnp.max(jnp.where(batch.node_mask, vuln, 0.0), axis=1)
     # _VULN ∈ {0,1}; empty-segment identity is -inf, so clamp at 0.
     return jnp.maximum(segment_max(vuln, batch.node_gidx, batch.max_graphs), 0.0)
 
