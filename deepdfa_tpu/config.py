@@ -101,6 +101,11 @@ class GGNNConfig:
     # (the differentiable DFA-lattice aggregators, ``clipper.py:50-77``)
     aggregation: str = "sum"
     dtype: str = "float32"  # compute dtype; bfloat16 for TPU speed runs
+    # graph layout: segment (flat edge lists, gather/scatter) | dense
+    # (per-graph [n,n] adjacency, message passing as batched MXU matmuls —
+    # the TPU fast path; models/ggnn_dense.py). Same parameter tree either
+    # way: checkpoints interchange between layouts.
+    layout: str = "segment"
 
     @property
     def out_dim(self) -> int:

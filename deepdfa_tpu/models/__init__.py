@@ -1,1 +1,20 @@
 """Flax models: GGNN encoder/classifier, fusion heads, Llama-family LLM."""
+
+from deepdfa_tpu.config import GGNNConfig
+
+__all__ = ["make_model"]
+
+
+def make_model(cfg: GGNNConfig, input_dim: int):
+    """The flagship model in the configured graph layout. Both layouts share
+    one parameter tree (parity-tested), so a checkpoint trained in either
+    restores into the other."""
+    if cfg.layout == "dense":
+        from deepdfa_tpu.models.ggnn_dense import GGNNDense
+
+        return GGNNDense(cfg=cfg, input_dim=input_dim)
+    if cfg.layout != "segment":
+        raise ValueError(f"unknown layout {cfg.layout!r} (segment | dense)")
+    from deepdfa_tpu.models.ggnn import GGNN
+
+    return GGNN(cfg=cfg, input_dim=input_dim)

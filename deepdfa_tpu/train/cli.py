@@ -45,7 +45,7 @@ from deepdfa_tpu import utils
 from deepdfa_tpu.config import ExperimentConfig, load_config
 from deepdfa_tpu.data.graphs import BucketSpec, Graph, GraphBatcher, load_shards
 from deepdfa_tpu.data.sampler import epoch_indices, positive_weight
-from deepdfa_tpu.models.ggnn import GGNN
+from deepdfa_tpu.models import make_model
 from deepdfa_tpu.train import metrics as M
 from deepdfa_tpu.train.checkpoint import CheckpointManager
 from deepdfa_tpu.train.loop import Trainer, _weighted_mean
@@ -112,11 +112,29 @@ def load_corpus(cfg: ExperimentConfig) -> dict[str, list[Graph]]:
     return _synthetic_corpus(cfg)
 
 
-def _batcher(cfg: ExperimentConfig, graphs: list[Graph] | None = None) -> GraphBatcher:
-    """Fixed-shape batcher. With ``auto_buckets`` and a corpus to measure,
-    budgets come from corpus statistics (capped by the configured ceilings)
-    instead of the worst-case constants — padding is wasted FLOPs on TPU."""
+def _batcher(cfg: ExperimentConfig, graphs: list[Graph] | None = None):
+    """Fixed-shape batcher for the configured graph layout. With
+    ``auto_buckets`` and a corpus to measure, budgets come from corpus
+    statistics (capped by the configured ceilings) instead of the worst-case
+    constants — padding is wasted FLOPs on TPU."""
     b = cfg.data.batch
+    if cfg.model.layout == "dense":
+        from deepdfa_tpu.data.dense import DenseBatcher, derive_dense_sizes
+
+        # per-graph ceiling from the configured TOTAL node budget: a batch
+        # never holds more than max_nodes slots, so adjacency memory stays
+        # bounded on heavy-tailed corpora (bigger graphs are dropped and
+        # counted, the standard drop_oversize semantics)
+        cap = max(b.max_nodes // max(b.batch_graphs, 1), 8)
+        if b.auto_buckets and graphs:
+            sizes = sorted({min(s, cap) for s in derive_dense_sizes(graphs)})
+        else:
+            sizes = [cap]
+        return DenseBatcher(
+            max_graphs=b.batch_graphs,
+            nodes_per_graph=sizes,
+            drop_oversize=b.drop_oversize,
+        )
     if b.auto_buckets and graphs:
         from deepdfa_tpu.data.graphs import derive_buckets
 
@@ -172,7 +190,7 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
         len(train), len(val), len(corpus["test"]), pos_weight,
     )
 
-    model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
+    model = make_model(cfg.model, cfg.input_dim)
     trainer = Trainer(model, cfg, pos_weight=pos_weight)
     batcher = _batcher(cfg, train + val)
     example = jax.tree.map(jnp.asarray, next(batcher.batches(train[: cfg.data.batch.batch_graphs])))
@@ -226,7 +244,7 @@ def test(
 ) -> dict[str, float]:
     corpus = load_corpus(cfg)
     test_graphs = corpus["test"]
-    model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
+    model = make_model(cfg.model, cfg.input_dim)
     trainer = Trainer(model, cfg)
     batcher = _batcher(cfg, test_graphs)
     example = jax.tree.map(jnp.asarray, next(batcher.batches(test_graphs)))
@@ -289,12 +307,20 @@ def test(
         all_probs.append(np.asarray(probs)[keep])
         all_labels.append(np.asarray(labels)[keep])
         if cfg.model.label_style == "node":
-            gidx = np.asarray(batch.node_gidx)
             p_np, l_np, k_np = np.asarray(probs), np.asarray(labels), keep
-            for gi in range(n_real):
-                sel = (gidx == gi) & k_np
-                if sel.any():
-                    statement_items.append((p_np[sel], l_np[sel].astype(int)))
+            if hasattr(batch, "node_gidx"):  # segment layout: flat nodes
+                gidx = np.asarray(batch.node_gidx)
+                for gi in range(n_real):
+                    sel = (gidx == gi) & k_np
+                    if sel.any():
+                        statement_items.append((p_np[sel], l_np[sel].astype(int)))
+            else:  # dense layout: [G, n] rows are per-graph already
+                for gi in range(n_real):
+                    sel = k_np[gi]
+                    if sel.any():
+                        statement_items.append(
+                            (p_np[gi][sel], l_np[gi][sel].astype(int))
+                        )
 
     if cfg.trace:
         jax.profiler.stop_trace()

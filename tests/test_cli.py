@@ -52,6 +52,38 @@ def test_fit_then_test_and_profile(storage, tmp_path):
     assert res["profile_ms_per_example"] > 0
 
 
+def test_dense_layout_fit_test_and_checkpoint_interchange(storage, tmp_path):
+    """model.layout=dense drives fit/test end-to-end, and a dense-trained
+    checkpoint restores into a segment-layout test run (shared param tree)."""
+    run_dir = tmp_path / "run_dense"
+    # raise the node budget so the per-graph cap (max_nodes/batch_graphs)
+    # clears the corpus p99 — both layouts then evaluate the SAME graphs and
+    # the cross-layout metric comparison is apples-to-apples
+    dense = [*SMALL, "--set", "model.layout=dense",
+             "--set", "data.batch.max_nodes=16384"]
+    out = cli.main(["fit", "--run-dir", str(run_dir), *dense])
+    assert np.isfinite(out["val_F1Score"])
+    res = cli.main(["test", "--run-dir", str(run_dir),
+                    "--ckpt-dir", str(run_dir / "checkpoints"), *dense])
+    assert np.isfinite(res["test_F1Score"])
+    # cross-layout restore: same checkpoint, segment-layout eval
+    res_seg = cli.main(["test", "--run-dir", str(tmp_path / "run_seg"),
+                        "--ckpt-dir", str(run_dir / "checkpoints"), *SMALL])
+    assert np.isfinite(res_seg["test_F1Score"])
+    # same model, same test split, layouts only differ in padding-population:
+    # metrics should agree closely
+    assert abs(res_seg["test_F1Score"] - res["test_F1Score"]) < 0.05
+
+
+def test_dense_layout_node_style_ranking(storage, tmp_path):
+    run_dir = tmp_path / "run_dense_node"
+    overrides = [*SMALL, "--set", "model.layout=dense",
+                 "--set", "model.label_style=node"]
+    cli.main(["fit", "--run-dir", str(run_dir), *overrides])
+    out = cli.main(["test", "--run-dir", str(run_dir), *overrides])
+    assert any(k.startswith("statement_hit@") for k in out)
+
+
 def test_analyze_coverage(storage, tmp_path):
     run_dir = tmp_path / "run"
     out = cli.main(["analyze", "--run-dir", str(run_dir), *SMALL])
