@@ -31,9 +31,12 @@ from deepdfa_tpu.train.metrics import ConfusionState, update_confusion
 __all__ = ["stack_batches", "make_dp_train_step", "make_dp_eval_step", "dp_init_state"]
 
 
-def stack_batches(batches: list[BatchedGraphs]) -> BatchedGraphs:
-    """Stack ``dp`` same-shape batches along a new leading device axis."""
-    shapes = {tuple(b.node_gidx.shape) for b in batches}
+def stack_batches(batches: list) -> BatchedGraphs:
+    """Stack ``dp`` same-shape batches along a new leading device axis.
+    Works on either layout (:class:`BatchedGraphs` or
+    :class:`deepdfa_tpu.data.dense.DenseBatch` — both carry ``node_mask``,
+    whose shape identifies the compiled bucket)."""
+    shapes = {tuple(np.shape(b.node_mask)) for b in batches}
     if len(shapes) != 1:
         raise ValueError(f"all stacked batches must share one bucket shape, got {shapes}")
     return jax.tree.map(lambda *xs: np.stack(xs, axis=0), *batches)
