@@ -508,6 +508,22 @@ def _validate(name: str, graphs_per_sec, flops_per_step, real_graphs, roofline, 
     return round(graphs_per_sec, 1)
 
 
+def _git_rev() -> str | None:
+    """Code provenance for the artifact: which commit produced this number."""
+    import os
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
 def _nominal_peak_tflops() -> float | None:
     import jax
 
@@ -805,6 +821,7 @@ def main():
         "est_vs_a100": round(value / a100_est_gps, 4) if (a100_est_gps and value) else None,
         "a100_assumption": f"{A100_BF16_PEAK_TFLOPS:.0f} TFLOP/s bf16 peak × {A100_ASSUMED_MFU} MFU",
         "config": "hidden32_steps5_concat4_batch256",
+        "git_rev": _git_rev(),
     }
     print(json.dumps(result))
 
