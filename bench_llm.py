@@ -34,6 +34,7 @@ import numpy as np
 
 from bench import (  # shared protocol
     _cost_flops,
+    _git_rev,
     _init_backend_with_retry,
     _sync,
     _time_once,
@@ -252,6 +253,7 @@ def main():
         ),
         "extrapolation": f"t({args.layers}) + slope x ({FULL_LAYERS}-{args.layers}) layers",
         "refused": refused or None,
+        "git_rev": _git_rev(),
     }
     print(json.dumps(result))
 

@@ -40,6 +40,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from bench import (  # noqa: E402  (shared protocol)
     _cost_flops,
+    _git_rev,
     _init_backend_with_retry,
     _progress,
     _sync,
@@ -172,6 +173,7 @@ def main():
         "roofline_tflops": round(roofline / 1e12, 1),
         "mfu": round(implied / roofline, 4) if (flops and roofline) else None,
         "refused": refused,
+        "git_rev": _git_rev(),
     }
     print(json.dumps(result))
 
