@@ -190,6 +190,19 @@ _GNU_TOKEN_MAP = [
     (re.compile(r"\b__extension__\b"), ""),
 ]
 _CASE_RANGE_RE = re.compile(r"(\bcase\b[^:\n]*?)\.\.\.[^:\n]*(:)")
+_GENERIC_RE = re.compile(r"\b_Generic\s*(?=\()")
+# `goto *expr;` — dynamic target, statically unresolvable even for Joern;
+# degraded to an empty statement (the labels themselves parse fine)
+_COMPUTED_GOTO_RE = re.compile(r"\bgoto\s*\*[^;\n]*;")
+# address-of-label `&&lbl` in unary position ONLY: immediately after = ( ,
+# or `return` — anywhere else `&&` is the binary operator and must survive
+_ADDR_LABEL_RE = re.compile(r"([=(,]\s*|\breturn\s+)&&\s*\w+")
+# digraphs are alternative spellings of { } [ ] (C11 6.4.6); replace outside
+# string/char literals, column-padded
+_DIGRAPH_OR_LITERAL_RE = re.compile(
+    r"\"(?:\\.|[^\"\\])*\"|'(?:\\.|[^'\\])*'|<%|%>|<:|:>"
+)
+_DIGRAPH_MAP = {"<%": "{ ", "%>": "} ", "<:": "[ ", ":>": "] "}
 # an ALL-CAPS call alone on a line with the block opener on the next line —
 # the `LIST_FOREACH(x, list)\n{` shape of statement-like macros; appending a
 # `;` turns it into a call statement followed by a plain block, keeping the
@@ -211,6 +224,19 @@ def _scrub_gnu_extensions(code: str) -> str:
     code = _scrub_kw_parens(code, _ATTR_RE, "")
     code = _scrub_kw_parens(code, _ASM_RE, "")
     code = _scrub_kw_parens(code, _TYPEOF_RE, "int")
+    # `_Generic(...)` selections degrade to 0 — extraction cares about the
+    # CFG/def-use shape, not the type-dispatched value
+    code = _scrub_kw_parens(code, _GENERIC_RE, "0")
+    code = _DIGRAPH_OR_LITERAL_RE.sub(
+        lambda m: _DIGRAPH_MAP.get(m.group(0), m.group(0)), code
+    )
+    code = _COMPUTED_GOTO_RE.sub(
+        lambda m: _blank_span(m.group(0)[:-1]) + ";", code
+    )
+    code = _ADDR_LABEL_RE.sub(
+        lambda m: m.group(1) + "0" + " " * (len(m.group(0)) - len(m.group(1)) - 1),
+        code,
+    )
     for pat, repl in _GNU_TOKEN_MAP:
         code = pat.sub(lambda m, r=repl: r + " " * (len(m.group(0)) - len(r)), code)
     code = _CASE_RANGE_RE.sub(

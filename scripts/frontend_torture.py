@@ -220,6 +220,63 @@ int f(int a) {
     return a * scale;
 }
 """),
+    # -- round-3 extension: deeper GNU/C99 hostility -------------------------
+    ("gnu_ext", "computed_goto", """
+int f(int n) {
+    void *tgt = &&out;
+    if (n > 0) goto *tgt;
+    n = -n;
+out:
+    return n;
+}
+"""),
+    ("gnu_ext", "statement_expression", """
+int f(int a) {
+    int x = ({ int t = a * 2; t + 1; });
+    return x;
+}
+"""),
+    ("gnu_ext", "nested_function", """
+int f(int a) {
+    int sq(int v) { return v * v; }
+    return sq(a);
+}
+"""),
+    ("c11", "generic_selection", """
+int f(int a) {
+    int r = _Generic(a, int: 1, default: 0);
+    return r + a;
+}
+"""),
+    ("c99", "vla_param", """
+int f(int n, int arr[n]) {
+    int s = 0;
+    for (int i = 0; i < n; i++) s += arr[i];
+    return s;
+}
+"""),
+    ("c99", "compound_literal", """
+struct pt { int x; int y; };
+int f(int a) {
+    struct pt p = (struct pt){ a, a + 1 };
+    return p.x + p.y;
+}
+"""),
+    ("misc", "digraphs", """
+int f(int a) <%
+    int b<:2:>;
+    b<:0:> = a;
+    b<:1:> = a + 1;
+    return b<:0:> + b<:1:>;
+%>
+"""),
+    ("misc", "flexible_array_member", """
+struct buf { int n; int data[]; };
+int f(struct buf *b) {
+    if (b->n > 0) return b->data[0];
+    return 0;
+}
+"""),
 ]
 
 
