@@ -195,8 +195,9 @@ _GENERIC_RE = re.compile(r"\b_Generic\s*(?=\()")
 # degraded to an empty statement (the labels themselves parse fine)
 _COMPUTED_GOTO_RE = re.compile(r"\bgoto\s*\*[^;\n]*;")
 # address-of-label `&&lbl` in unary position ONLY: immediately after = ( ,
-# or `return` — anywhere else `&&` is the binary operator and must survive
-_ADDR_LABEL_RE = re.compile(r"([=(,]\s*|\breturn\s+)&&\s*\w+")
+# { ? : (brace-initialized label tables, ternary arms) or `return` —
+# anywhere else `&&` is the binary operator and must survive
+_ADDR_LABEL_RE = re.compile(r"([=(,{?:]\s*|\breturn\s+)&&\s*\w+")
 # digraphs are alternative spellings of { } [ ] (C11 6.4.6); replace outside
 # string/char literals, column-padded
 _DIGRAPH_OR_LITERAL_RE = re.compile(

@@ -270,6 +270,20 @@ int f(int a) <%
     return b<:0:> + b<:1:>;
 %>
 """),
+    ("gnu_ext", "computed_goto_label_table", """
+int f(int i) {
+    static void *tab[] = { &&a, &&b };
+    int r = 0;
+    goto *tab[i];
+a:
+    r = 1;
+    goto done;
+b:
+    r = 2;
+done:
+    return r;
+}
+"""),
     ("misc", "flexible_array_member", """
 struct buf { int n; int data[]; };
 int f(struct buf *b) {

@@ -22,7 +22,7 @@ def test_torture_corpus_failed_rate():
     ]
     assert not unexpected, unexpected
     assert len(result["failures"]) <= len(KNOWN_UNSUPPORTED)
-    assert result["cases"] >= 34
+    assert result["cases"] >= 35
 
 
 def test_round3_scrub_extensions_parse():
