@@ -204,7 +204,13 @@ def _weighted_mean(losses: list, wsums: list) -> float:
 @dataclasses.dataclass
 class Trainer:
     """Minimal epoch driver; the full-featured CLI trainer (checkpointing,
-    logging, profiling — parity with ``main_cli.py``) composes this."""
+    logging, profiling — parity with ``main_cli.py``) composes this.
+
+    Layout-polymorphic: ``model`` may be the segment-layout :class:`GGNN`
+    fed :class:`BatchedGraphs`, or the dense-layout
+    :class:`~deepdfa_tpu.models.ggnn_dense.GGNNDense` fed
+    :class:`~deepdfa_tpu.data.dense.DenseBatch` — label extraction is the
+    only layout-aware step (:func:`graph_labels`)."""
 
     model: GGNN
     cfg: ExperimentConfig
