@@ -74,10 +74,15 @@ def main(argv=None) -> dict:
                 "profile_gflops_per_example": results.get("profile_gflops_per_example"),
             }
         )
-        print(json.dumps(runs[-1]))
+        # progress to stderr: under the watchdog, stdout is the captured
+        # artifact channel (one JSON line relayed at the end)
+        print(json.dumps(runs[-1]), file=sys.stderr, flush=True)
+
+    import jax
 
     f1s = [r["test_F1Score"] for r in runs if r["test_F1Score"] is not None]
     agg = {
+        "backend": jax.default_backend(),
         "runs": runs,
         "mean_fit_seconds": sum(r["fit_seconds"] for r in runs) / len(runs),
         "mean_test_seconds": sum(r["test_seconds"] for r in runs) / len(runs),
@@ -125,4 +130,32 @@ def main(argv=None) -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    import os
+
+    if os.environ.get("_BENCH_CHILD") == "1":
+        main()
+    else:
+        # Same guaranteed-artifact orchestration as bench.py: a wedged
+        # remote-TPU tunnel grant can hang backend init for 25+ minutes
+        # inside cli.fit — run the protocol in a budgeted child and fall
+        # back to an honestly-labelled CPU run if the device env is dead
+        # (the reference's own protocol has a CPU leg,
+        # performance_evaluation_cpu.sh). The fallback runs a MINIMAL fixed
+        # protocol into a FRESH out dir: replaying the user's full argv
+        # could blow the same budget on CPU, and reusing the killed TPU
+        # attempt's run dirs would let its stale checkpoints leak into the
+        # cpu-labelled metrics.
+        from deepdfa_tpu import utils
+
+        from bench import run_with_device_watchdog
+
+        # unique per invocation — a reused dir would let a PREVIOUS
+        # fallback's checkpoints leak into this one's metrics
+        fb_out = (utils.storage_dir() / "perf_eval_cpu_fallback"
+                  / utils.get_run_id(["perf"]))
+        raise SystemExit(run_with_device_watchdog(
+            __file__, sys.argv[1:],
+            fallback_argv=["--runs", "1", "--out", str(fb_out),
+                           "--set", "data.sample=true",
+                           "--set", "optim.max_epochs=2"],
+        ))
