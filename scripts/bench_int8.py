@@ -47,7 +47,15 @@ def main(argv=None) -> dict:
     ap = argparse.ArgumentParser()
     ap.add_argument("--m", type=int, nargs="+", default=[8, 128, 1024])
     ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--tiny", action="store_true",
+                    help="small shapes (CPU interpret-mode smoke: 7B-dims "
+                         "interpret runs take many minutes)")
     args = ap.parse_args(argv)
+    if args.tiny:
+        global SHAPES
+        SHAPES = [("tiny_proj", 256, 512)]
+        args.m = [min(m, 8) for m in args.m[:1]]
+        args.trials = min(args.trials, 2)
 
     import jax
     import jax.numpy as jnp
@@ -106,4 +114,16 @@ def main(argv=None) -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    import os
+    import sys
+    from pathlib import Path
+
+    if os.environ.get("_BENCH_CHILD") == "1":
+        main()
+    else:
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        from bench import run_with_device_watchdog
+
+        raise SystemExit(run_with_device_watchdog(
+            __file__, sys.argv[1:], fallback_argv=["--tiny"],
+        ))
