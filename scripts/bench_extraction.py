@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Extraction-pipeline throughput: the offline data "compiler", measured.
+
+The reference's preprocessing is JVM-bound — Joern per-function CPG export
+sharded over a 0-99 SLURM array (``DDFA/scripts/run_getgraphs.sh:6,21``)
+with multi-minute JVM boots and pexpect round trips; extraction is its
+wall-clock bottleneck by design. This framework's native frontend
+(pycparser CFG/AST + reaching-definitions + abstract-dataflow features,
+no JVM) makes the whole pipeline a measurable Python/C++ hot path:
+this script times it per stage on a generated Big-Vul-shaped corpus and
+prints ONE JSON line (functions/sec end-to-end, ms/function per stage,
+solver speedups, multi-worker scaling via ``dfmp``).
+
+Pure host-side — imports no jax, needs no device, no watchdog.
+
+Usage: python scripts/bench_extraction.py [--n 300] [--workers 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _corpus(n: int) -> list[str]:
+    from deepdfa_tpu.data.codegen import generate_function, generate_hard_function
+
+    rng = np.random.default_rng(0)
+    out = []
+    for i in range(n):
+        if i % 4 == 3:  # mix in the dataflow-hard shape (diamonds, re-defs)
+            out.append(generate_hard_function(i, vul=bool(i % 2), rng=rng,
+                                              chain_depth=int(i % 3) * 2)["before"])
+        else:
+            out.append(generate_function(i, bool(i % 2), rng)["before"])
+    return out
+
+
+def _extract_one(src: str):
+    """The per-function pipeline: parse → RD fixpoint (C++ solver) →
+    abstract-dataflow features. Returns (n_nodes, n_defs)."""
+    from deepdfa_tpu.cpg.dataflow import ReachingDefinitions, solve_native
+    from deepdfa_tpu.cpg.features import extract_features
+    from deepdfa_tpu.cpg.frontend import parse_function
+
+    cpg = parse_function(src)
+    rd = ReachingDefinitions(cpg)
+    solve_native(rd)
+    feats = extract_features(cpg, 0)
+    return len(cpg.nodes), len(feats)
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=300)
+    ap.add_argument("--workers", type=int, default=6)
+    args = ap.parse_args(argv)
+
+    import pandas as pd
+
+    from deepdfa_tpu import utils
+    from deepdfa_tpu.cpg.dataflow import ReachingDefinitions, solve_bitvec, solve_native
+    from deepdfa_tpu.cpg.features import extract_features
+    from deepdfa_tpu.cpg.frontend import parse_function
+
+    sources = _corpus(args.n)
+
+    # per-stage timing, single process
+    cpgs = []
+    t0 = time.perf_counter()
+    for s in sources:
+        cpgs.append(parse_function(s))
+    parse_s = time.perf_counter() - t0
+
+    rds = [ReachingDefinitions(c) for c in cpgs]
+    stage = {}
+    for name, solver in (("rd_python", None), ("rd_bitvec", solve_bitvec),
+                         ("rd_native_cpp", solve_native)):
+        t0 = time.perf_counter()
+        for rd in rds:
+            if solver is None:
+                rd.solve()
+            else:
+                solver(rd)
+        stage[name] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for i, c in enumerate(cpgs):
+        extract_features(c, i)
+    feats_s = time.perf_counter() - t0
+
+    # end-to-end single process (parse+native solve+features, fresh)
+    t0 = time.perf_counter()
+    for s in sources:
+        _extract_one(s)
+    e2e_s = time.perf_counter() - t0
+
+    # multi-worker scaling through the real dfmp fan-out
+    df = pd.DataFrame({"before": sources})
+    t0 = time.perf_counter()
+    utils.dfmp(df, _extract_one, columns="before", workers=args.workers,
+               desc="extract: ")
+    par_s = time.perf_counter() - t0
+
+    # solver gap at a REALISTIC-worst-case domain: tiny demo functions hide
+    # the C++ solver's advantage behind per-call overhead; a 140-definition
+    # function (the big-function tail of Big-Vul) shows the asymptotics
+    big_lines = [f"  int v{i} = {i};" for i in range(70)]
+    big_lines += [f"  v{i} = v{i} + 1;" for i in range(70)]
+    big_src = "int big(void) {\n" + "\n".join(big_lines) + "\n  return v0;\n}"
+    big_rd = ReachingDefinitions(parse_function(big_src))
+    big = {}
+    for name, solver in (("rd_python", None), ("rd_bitvec", solve_bitvec),
+                         ("rd_native_cpp", solve_native)):
+        t0 = time.perf_counter()
+        for _ in range(5):
+            if solver is None:
+                big_rd.solve()
+            else:
+                solver(big_rd)
+        big[name] = (time.perf_counter() - t0) / 5
+
+    import os
+
+    n = len(sources)
+    nodes = sum(len(c.nodes) for c in cpgs)
+    result = {
+        "metric": "extraction_functions_per_sec",
+        "value": round(n / e2e_s, 1),
+        "unit": "functions/sec",
+        "vs_baseline": None,  # reference publishes no extraction rate; its
+        # protocol is a 100-shard SLURM array around a JVM (run_getgraphs.sh)
+        "n_functions": n,
+        "mean_nodes_per_function": round(nodes / n, 1),
+        "single_process": {
+            "end_to_end_ms_per_function": round(e2e_s / n * 1e3, 3),
+            "parse_ms_per_function": round(parse_s / n * 1e3, 3),
+            "features_ms_per_function": round(feats_s / n * 1e3, 3),
+            "rd_solve_ms_per_function": {
+                k: round(v / n * 1e3, 3) for k, v in stage.items()
+            },
+            "cpp_speedup_vs_python_sets": round(
+                stage["rd_python"] / stage["rd_native_cpp"], 1
+            ) if stage["rd_native_cpp"] else None,
+        },
+        "large_function_140_defs": {
+            "rd_solve_ms": {k: round(v * 1e3, 3) for k, v in big.items()},
+            "cpp_speedup_vs_python_sets": round(
+                big["rd_python"] / big["rd_native_cpp"], 1
+            ) if big["rd_native_cpp"] else None,
+        },
+        "parallel": {
+            "workers": args.workers,
+            "host_cpus": os.cpu_count(),
+            "functions_per_sec": round(n / par_s, 1),
+            "scaling_efficiency": round((n / par_s) / (n / e2e_s) / args.workers, 2),
+            "note": ("scaling is bounded by host cores — on a 1-2 core box "
+                     "process fan-out only adds overhead; the number is the "
+                     "honest measurement on THIS host"),
+        },
+        "pipeline": "parse(native C frontend) -> RD fixpoint -> abstract-dataflow features",
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Contract test for the extraction-throughput bench (host-side, jax-free)."""
+
+from scripts.bench_extraction import main
+
+
+def test_emits_valid_artifact():
+    d = main(["--n", "24", "--workers", "2"])
+    assert d["metric"] == "extraction_functions_per_sec"
+    assert d["value"] > 0
+    sp = d["single_process"]
+    assert sp["end_to_end_ms_per_function"] > 0
+    assert set(sp["rd_solve_ms_per_function"]) == {
+        "rd_python", "rd_bitvec", "rd_native_cpp"
+    }
+    big = d["large_function_140_defs"]["rd_solve_ms"]
+    assert all(v > 0 for v in big.values())
+    assert d["parallel"]["host_cpus"] >= 1
+    assert d["parallel"]["functions_per_sec"] > 0
