@@ -46,7 +46,8 @@ def _corpus(n: int) -> list[str]:
 
 def _extract_one(src: str):
     """The per-function pipeline: parse → RD fixpoint (C++ solver) →
-    abstract-dataflow features. Returns (n_nodes, n_defs)."""
+    abstract-dataflow features. Returns (n_nodes, n_feature_rows) — one
+    row per (definition, subkey), not per definition."""
     from deepdfa_tpu.cpg.dataflow import ReachingDefinitions, solve_native
     from deepdfa_tpu.cpg.features import extract_features
     from deepdfa_tpu.cpg.frontend import parse_function
@@ -81,16 +82,23 @@ def main(argv=None) -> dict:
     parse_s = time.perf_counter() - t0
 
     rds = [ReachingDefinitions(c) for c in cpgs]
-    stage = {}
-    for name, solver in (("rd_python", None), ("rd_bitvec", solve_bitvec),
-                         ("rd_native_cpp", solve_native)):
-        t0 = time.perf_counter()
-        for rd in rds:
-            if solver is None:
-                rd.solve()
-            else:
-                solver(rd)
-        stage[name] = time.perf_counter() - t0
+    solve_native(rds[0])  # warm: first call pays make + dlopen of the .so
+
+    def _time_solvers(rd_list, reps: int = 1) -> dict[str, float]:
+        out = {}
+        for name, solver in (("rd_python", None), ("rd_bitvec", solve_bitvec),
+                             ("rd_native_cpp", solve_native)):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                for rd in rd_list:
+                    if solver is None:
+                        rd.solve()
+                    else:
+                        solver(rd)
+            out[name] = (time.perf_counter() - t0) / reps
+        return out
+
+    stage = _time_solvers(rds)
 
     t0 = time.perf_counter()
     for i, c in enumerate(cpgs):
@@ -117,16 +125,7 @@ def main(argv=None) -> dict:
     big_lines += [f"  v{i} = v{i} + 1;" for i in range(70)]
     big_src = "int big(void) {\n" + "\n".join(big_lines) + "\n  return v0;\n}"
     big_rd = ReachingDefinitions(parse_function(big_src))
-    big = {}
-    for name, solver in (("rd_python", None), ("rd_bitvec", solve_bitvec),
-                         ("rd_native_cpp", solve_native)):
-        t0 = time.perf_counter()
-        for _ in range(5):
-            if solver is None:
-                big_rd.solve()
-            else:
-                solver(big_rd)
-        big[name] = (time.perf_counter() - t0) / 5
+    big = _time_solvers([big_rd], reps=5)
 
     import os
 

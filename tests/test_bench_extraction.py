@@ -4,7 +4,10 @@ from scripts.bench_extraction import main
 
 
 def test_emits_valid_artifact():
-    d = main(["--n", "24", "--workers", "2"])
+    # workers=1 → dfmp's serial path: forking a pytest parent that already
+    # initialized the XLA backend (conftest imports jax) is a known
+    # fork-after-threads deadlock hazard
+    d = main(["--n", "24", "--workers", "1"])
     assert d["metric"] == "extraction_functions_per_sec"
     assert d["value"] > 0
     sp = d["single_process"]
