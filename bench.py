@@ -585,6 +585,41 @@ def run_with_device_watchdog(
     timeout_s = float(os.environ.get("BENCH_TPU_TIMEOUT_S", "1500"))
     cmd = [sys.executable, script_path, *argv]
     reason = None
+
+    # Cheap bounded probe BEFORE committing the full device budget: a dead
+    # tunnel hangs init indefinitely, and burning timeout_s on the doomed
+    # attempt can push the attempt+fallback total past the caller's own
+    # deadline — leaving NO artifact. A healthy backend passes in seconds.
+    # Skipped when the env already pins CPU (fallback == primary there).
+    probe_s = float(os.environ.get("BENCH_DEVICE_PROBE_TIMEOUT_S", "120"))
+    if env.get("JAX_PLATFORMS", "") != "cpu" and probe_s > 0:
+        _progress(f"probing device backend (budget {probe_s:.0f}s)")
+        # the probe retries transient UNAVAILABLE in-process (same policy as
+        # _init_backend_with_retry) — a flake here must not divert the
+        # round's one shot to the CPU fallback when a retry would recover
+        probe_code = (
+            "import time, jax\n"
+            "for a in range(3):\n"
+            "    try:\n"
+            "        jax.devices(); break\n"
+            "    except RuntimeError as e:\n"
+            "        if 'UNAVAILABLE' not in str(e) or a == 2: raise\n"
+            "        time.sleep(15)\n"
+        )
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", probe_code],
+                env=env, timeout=probe_s, capture_output=True,
+            )
+            if probe.returncode != 0:
+                tail = probe.stderr.decode(errors="replace")[-200:].strip()
+                reason = f"device probe exited rc={probe.returncode}: {tail}"
+        except subprocess.TimeoutExpired:
+            reason = (f"device probe exceeded {probe_s:.0f}s "
+                      "(dead tunnel relay / wedged grant)")
+        if reason is not None:
+            return _fallback_cpu(script_path, argv, fallback_argv, env,
+                                 timeout_s, reason)
     try:
         proc = subprocess.run(cmd, env=env, timeout=timeout_s,
                               stdout=subprocess.PIPE, text=True)
@@ -607,7 +642,15 @@ def run_with_device_watchdog(
     except subprocess.TimeoutExpired:
         reason = (f"device bench exceeded {timeout_s:.0f}s "
                   "(wedged tunnel grant hangs device init)")
+    return _fallback_cpu(script_path, argv, fallback_argv, env, timeout_s, reason)
+
+
+def _fallback_cpu(script_path, argv, fallback_argv, env, timeout_s, reason) -> int:
+    """Re-run on CPU with the tunnel env dropped; emit the labelled artifact."""
+    import subprocess
+
     _progress(f"{reason}; falling back to a CPU-labelled artifact")
+    env = dict(env)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     # the fallback gets CPU-sized args: the device-sized workload on a single

@@ -91,6 +91,7 @@ def test_nominal_peak_lookup(monkeypatch):
 def test_watchdog_falls_back_to_labelled_cpu_artifact(tmp_path, monkeypatch):
     """A failing device child must yield a CPU-labelled artifact carrying the
     TPU attempt's fate — never an empty file."""
+    monkeypatch.setenv("BENCH_DEVICE_PROBE_TIMEOUT_S", "0")
     import contextlib
     import io
     import json
@@ -118,6 +119,7 @@ def test_watchdog_falls_back_to_labelled_cpu_artifact(tmp_path, monkeypatch):
 def test_watchdog_propagates_usage_errors(tmp_path, monkeypatch):
     """rc=2 (argparse usage error) is a deterministic caller mistake: the
     watchdog must propagate it, not mask it under a green CPU fallback."""
+    monkeypatch.setenv("BENCH_DEVICE_PROBE_TIMEOUT_S", "0")
     fake = tmp_path / "fake_bench.py"
     fake.write_text("import sys\nsys.exit(2)\n")
     monkeypatch.setattr(bench, "_progress", lambda *_: None)
@@ -127,6 +129,7 @@ def test_watchdog_propagates_usage_errors(tmp_path, monkeypatch):
 def test_watchdog_relays_full_non_json_stdout(tmp_path, monkeypatch):
     """A healthy child whose stdout isn't the one-JSON-line contract (e.g.
     --help usage text) is relayed whole, not truncated to its last line."""
+    monkeypatch.setenv("BENCH_DEVICE_PROBE_TIMEOUT_S", "0")
     import contextlib
     import io
 
@@ -141,6 +144,7 @@ def test_watchdog_relays_full_non_json_stdout(tmp_path, monkeypatch):
 
 
 def test_watchdog_passes_through_healthy_device_run(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_DEVICE_PROBE_TIMEOUT_S", "0")
     import contextlib
     import io
     import json
@@ -157,3 +161,41 @@ def test_watchdog_passes_through_healthy_device_run(tmp_path, monkeypatch):
         rc = bench.run_with_device_watchdog(str(fake), [])
     out = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert rc == 0 and out["backend"] == "tpu" and "tpu_unavailable" not in out
+
+
+def test_watchdog_probe_short_circuits_dead_tunnel(tmp_path, monkeypatch):
+    """A failing device probe must route STRAIGHT to the CPU fallback without
+    spending the full device budget on a doomed attempt (attempt+fallback
+    past the caller's deadline = no artifact at all)."""
+    import contextlib
+    import io
+    import json
+    import subprocess
+
+    fake = tmp_path / "fake_bench.py"
+    fake.write_text(
+        "import json, os, sys\n"
+        "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
+        "    print(json.dumps({'metric': 'm', 'value': 1.0, 'unit': 'u',\n"
+        "                      'vs_baseline': None, 'backend': 'cpu'}))\n"
+        "else:\n"
+        "    raise SystemExit('device child must not run when the probe fails')\n"
+    )
+    monkeypatch.setenv("BENCH_DEVICE_PROBE_TIMEOUT_S", "5")
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")  # non-cpu → probe runs
+    monkeypatch.setattr(bench, "_progress", lambda *_: None)
+    real_run = subprocess.run
+
+    def fake_run(cmd, **kw):
+        if cmd[1] == "-c" and "jax.devices()" in cmd[2]:
+            raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+        return real_run(cmd, **kw)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = bench.run_with_device_watchdog(str(fake), [])
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rc == 0
+    assert out["backend"] == "cpu"
+    assert "probe exceeded" in out["tpu_unavailable"]
