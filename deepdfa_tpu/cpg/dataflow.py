@@ -221,6 +221,13 @@ def _native_lib() -> ctypes.CDLL:
         return _LIB
     root = Path(__file__).resolve().parent.parent.parent / "native"
     so = root / "libdfa_solver.so"
+    if not (root / "dfa_solver.cpp").exists():
+        raise RuntimeError(
+            "the C++ reaching-definitions solver needs a source checkout "
+            f"(native/dfa_solver.cpp not found under {root}); installed-"
+            "package users: call rd.solve() (Python sets) or solve_bitvec "
+            "instead — identical fixpoints, cross-checked by the test suite"
+        )
     # Always invoke make: it is a no-op when up to date and rebuilds after
     # source edits (a stale .so would otherwise be loaded silently).
     subprocess.run(["make", "-C", str(root), "-s"], check=True)
