@@ -592,7 +592,8 @@ def run_with_device_watchdog(
     # deadline — leaving NO artifact. A healthy backend passes in seconds.
     # Skipped when the env already pins CPU (fallback == primary there).
     probe_s = float(os.environ.get("BENCH_DEVICE_PROBE_TIMEOUT_S", "120"))
-    if env.get("JAX_PLATFORMS", "") != "cpu" and probe_s > 0:
+    wants_help = any(a in ("-h", "--help") for a in argv)
+    if env.get("JAX_PLATFORMS", "") != "cpu" and probe_s > 0 and not wants_help:
         _progress(f"probing device backend (budget {probe_s:.0f}s)")
         # the probe retries transient UNAVAILABLE in-process (same policy as
         # _init_backend_with_retry) — a flake here must not divert the
