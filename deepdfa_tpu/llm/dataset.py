@@ -243,6 +243,10 @@ class GraphJoin:
     max_nodes: int = 4096
     max_edges: int = 8192
     num_missing: int = 0
+    # graph layout fed to the fusion encoder: "segment" (flat BatchedGraphs)
+    # or "dense" (per-graph adjacency, the MXU fast path). Must match the
+    # fusion model's GGNNConfig.layout.
+    layout: str = "segment"
 
     @classmethod
     def from_list(cls, graphs: Sequence[Graph], **kw) -> "GraphJoin":
@@ -281,5 +285,20 @@ class GraphJoin:
                 if batch.mask[i]:
                     self.num_missing += 1
         b = len(picked)
-        graphs = batch_np(picked, b + 1, self.max_nodes, self.max_edges)
+        if self.layout == "dense":
+            from deepdfa_tpu.data.dense import batch_dense
+
+            # slot i MUST hold example i (the fusion contract), so graphs
+            # cannot be dropped for size — the per-graph budget is the store
+            # maximum (computed once), keeping every join shape-stable
+            graphs = batch_dense(picked, b, self._dense_npg())
+        else:
+            graphs = batch_np(picked, b + 1, self.max_nodes, self.max_edges)
         return JoinedBatch(text=batch, graphs=graphs, mask=batch.mask & found)
+
+    def _dense_npg(self) -> int:
+        npg = getattr(self, "_npg_cache", None)
+        if npg is None:
+            biggest = max((g.n_nodes for g in self.graphs.values()), default=1)
+            npg = self._npg_cache = max(-(-biggest // 8) * 8, 8)
+        return npg

@@ -107,8 +107,12 @@ class FusionModel(nn.Module):
         if self.use_gnn:
             import dataclasses
 
+            from deepdfa_tpu.models import make_model
+
             cfg = dataclasses.replace(self.gnn_cfg, encoder_mode=True, label_style="graph")
-            self.flowgnn_encoder = GGNN(cfg=cfg, input_dim=self.input_dim)
+            # layout-aware (cfg.layout segment|dense): both forwards share
+            # one parameter tree, so the joint checkpoint is layout-portable
+            self.flowgnn_encoder = make_model(cfg, self.input_dim)
         self.classifier = ClassificationHead(
             hidden_size=self.llm_hidden_size,
             dropout_rate=self.dropout_rate,

@@ -383,3 +383,80 @@ def test_presets_cover_reference_launch_scripts():
     assert long.mesh.sp == -1  # long blocks shard the sequence axis
     for name in ("pb_ft_pb_noexpl", "pretrained_pb"):
         assert PRESETS[name].joint.use_gnn is False  # --no_flowgnn parity
+
+
+def test_fusion_dense_layout_parity():
+    """FusionModel with a dense-layout encoder matches the segment-layout
+    encoder on SHARED parameters (one tree, two forwards), and GraphJoin
+    emits the matching dense batches."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.llm.dataset import GraphJoin, HashTokenizer, encode_functions, text_batches
+    from deepdfa_tpu.llm.fusion import FusionModel
+
+    graphs = random_dataset(6, seed=1, input_dim=INPUT_DIM, mean_nodes=8)
+    funcs = [f"int f{i}(int x) {{ return x + {i}; }}" for i in range(6)]
+    ex = encode_functions(funcs, [i % 2 for i in range(6)],
+                          HashTokenizer(vocab_size=64), 16, indices=range(6))
+    tb = next(text_batches(ex, 6))
+
+    cfg = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2)
+    h = jnp.zeros((6, 16, 32), jnp.float32)
+    tmask = jnp.asarray(tb.pad_mask)
+
+    def build(layout):
+        join = GraphJoin.from_list(graphs, max_nodes=512, max_edges=1024,
+                                   layout=layout)
+        batch = join.join(tb)
+        model = FusionModel(
+            gnn_cfg=dataclasses.replace(cfg, layout=layout),
+            input_dim=INPUT_DIM, llm_hidden_size=32,
+        )
+        return model, batch
+
+    m_seg, b_seg = build("segment")
+    params = m_seg.init(jax.random.key(0), h, b_seg.graphs,
+                        deterministic=True, token_mask=tmask)["params"]
+    out_seg = np.asarray(m_seg.apply({"params": params}, h, b_seg.graphs,
+                                     deterministic=True, token_mask=tmask))
+    m_den, b_den = build("dense")
+    out_den = np.asarray(m_den.apply({"params": params}, h, b_den.graphs,
+                                     deterministic=True, token_mask=tmask))
+    np.testing.assert_allclose(out_den, out_seg, rtol=1e-4, atol=1e-4)
+
+
+def test_fusion_dense_missing_graph_embeds_zero():
+    """A missing graph's placeholder (0 nodes) must produce a zero embedding
+    in the dense layout too (masked softmax over an empty row)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.llm.dataset import GraphJoin, TextBatch
+    from deepdfa_tpu.llm.fusion import FusionModel
+
+    graphs = random_dataset(2, seed=2, input_dim=INPUT_DIM, mean_nodes=6)
+    join = GraphJoin.from_list(graphs, layout="dense")
+    tb = TextBatch(
+        input_ids=np.zeros((3, 8), np.int32),
+        labels=np.zeros(3, np.int32),
+        indices=np.array([0, 999, 1]),  # 999 missing
+        mask=np.ones(3, bool),
+        pad_mask=np.ones((3, 8), bool),
+    )
+    jb = join.join(tb)
+    assert join.num_missing == 1 and not jb.mask[1]
+    cfg = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2,
+                     layout="dense", encoder_mode=True, label_style="graph")
+    from deepdfa_tpu.models import make_model
+
+    enc = make_model(cfg, INPUT_DIM)
+    db = jax.tree.map(jnp.asarray, jb.graphs)
+    params = enc.init(jax.random.key(1), db)["params"]
+    emb = np.asarray(enc.apply({"params": params}, db))
+    assert np.allclose(emb[1], 0.0), emb[1]
+    assert np.abs(emb[0]).max() > 0
