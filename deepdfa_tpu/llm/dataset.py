@@ -35,6 +35,7 @@ from typing import Iterator, NamedTuple, Protocol, Sequence
 
 import numpy as np
 
+from deepdfa_tpu.data.dense import DenseBatch
 from deepdfa_tpu.data.graphs import BatchedGraphs, Graph, batch_np
 from deepdfa_tpu.data.tokenise import tokenise
 
@@ -224,7 +225,7 @@ def text_batches(
 
 class JoinedBatch(NamedTuple):
     text: TextBatch
-    graphs: BatchedGraphs
+    graphs: BatchedGraphs | DenseBatch  # layout follows GraphJoin.layout
     # mask — example is real AND its graph was found; what the loss sees.
     mask: np.ndarray  # [b] bool
 
@@ -243,10 +244,19 @@ class GraphJoin:
     max_nodes: int = 4096
     max_edges: int = 8192
     num_missing: int = 0
+    num_oversize: int = 0
     # graph layout fed to the fusion encoder: "segment" (flat BatchedGraphs)
     # or "dense" (per-graph adjacency, the MXU fast path). Must match the
     # fusion model's GGNNConfig.layout.
     layout: str = "segment"
+
+    def __post_init__(self):
+        if self.layout not in ("segment", "dense"):
+            raise ValueError(
+                f"unknown layout {self.layout!r} (segment | dense) — a typo "
+                "here would otherwise surface as an obscure shape error deep "
+                "inside the jitted fusion forward"
+            )
 
     @classmethod
     def from_list(cls, graphs: Sequence[Graph], **kw) -> "GraphJoin":
@@ -288,10 +298,18 @@ class GraphJoin:
         if self.layout == "dense":
             from deepdfa_tpu.data.dense import batch_dense
 
-            # slot i MUST hold example i (the fusion contract), so graphs
-            # cannot be dropped for size — the per-graph budget is the store
-            # maximum (computed once), keeping every join shape-stable
-            graphs = batch_dense(picked, b, self._dense_npg())
+            # slot i MUST hold example i (the fusion contract), so a graph
+            # over the per-graph budget becomes a placeholder with
+            # mask=False — exactly the missing-graph treatment — instead of
+            # blowing every batch's n² adjacency up to the store's single
+            # largest outlier. Budget: store p99, capped by max_nodes.
+            npg = self._dense_npg()
+            for i, g in enumerate(picked):
+                if g.n_nodes > npg:
+                    picked[i] = placeholder
+                    found[i] = False
+                    self.num_oversize += 1
+            graphs = batch_dense(picked, b, npg)
         else:
             graphs = batch_np(picked, b + 1, self.max_nodes, self.max_edges)
         return JoinedBatch(text=batch, graphs=graphs, mask=batch.mask & found)
@@ -299,6 +317,8 @@ class GraphJoin:
     def _dense_npg(self) -> int:
         npg = getattr(self, "_npg_cache", None)
         if npg is None:
-            biggest = max((g.n_nodes for g in self.graphs.values()), default=1)
-            npg = self._npg_cache = max(-(-biggest // 8) * 8, 8)
+            from deepdfa_tpu.data.dense import derive_dense_size
+
+            npg = derive_dense_size(list(self.graphs.values()), quantile=0.99)
+            npg = self._npg_cache = min(npg, max(self.max_nodes, 8))
         return npg

@@ -30,7 +30,7 @@ import optax
 
 from deepdfa_tpu.config import GGNNConfig
 from deepdfa_tpu.data.graphs import BatchedGraphs
-from deepdfa_tpu.models.ggnn import GGNN
+from deepdfa_tpu.data.dense import DenseBatch
 
 __all__ = ["ClassificationHead", "FusionModel", "fusion_loss"]
 
@@ -123,7 +123,7 @@ class FusionModel(nn.Module):
     def __call__(
         self,
         llm_hidden_states: jnp.ndarray,  # [b, s, h]
-        graphs: BatchedGraphs | None,
+        graphs: BatchedGraphs | DenseBatch | None,  # layout per gnn_cfg.layout
         deterministic: bool = True,
         token_mask: jnp.ndarray | None = None,  # [b, s] True = real token
     ) -> jnp.ndarray:

@@ -460,3 +460,37 @@ def test_fusion_dense_missing_graph_embeds_zero():
     emb = np.asarray(enc.apply({"params": params}, db))
     assert np.allclose(emb[1], 0.0), emb[1]
     assert np.abs(emb[0]).max() > 0
+
+
+def test_fusion_dense_oversize_graph_becomes_placeholder():
+    """A graph over the dense per-graph budget is treated like a missing one
+    (placeholder + mask=False, slot alignment preserved) instead of blowing
+    every batch's adjacency up to the outlier's size."""
+    import dataclasses as dc
+
+    from deepdfa_tpu.llm.dataset import GraphJoin, TextBatch
+
+    graphs = random_dataset(40, seed=3, input_dim=INPUT_DIM, mean_nodes=8)
+    # one outlier far beyond p99 of the store
+    big = random_dataset(1, seed=4, input_dim=INPUT_DIM, mean_nodes=200)[0]
+    graphs.append(dc.replace(big, gid=777))
+    join = GraphJoin.from_list(graphs, layout="dense")
+    tb = TextBatch(
+        input_ids=np.zeros((2, 8), np.int32),
+        labels=np.zeros(2, np.int32),
+        indices=np.array([0, 777]),
+        mask=np.ones(2, bool),
+        pad_mask=np.ones((2, 8), bool),
+    )
+    jb = join.join(tb)
+    assert big.n_nodes > jb.graphs.nodes_per_graph  # budget excludes outlier
+    assert join.num_oversize == 1
+    assert jb.mask[0] and not jb.mask[1]
+
+
+def test_graph_join_layout_whitelist():
+    import pytest
+
+    graphs = random_dataset(2, seed=5, input_dim=INPUT_DIM, mean_nodes=6)
+    with pytest.raises(ValueError, match="unknown layout"):
+        GraphJoin.from_list(graphs, layout="Dense")
