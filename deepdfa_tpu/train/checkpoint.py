@@ -106,6 +106,9 @@ class CheckpointManager:
         self._ckptr.save(path / "state", state)
         meta = dict(step=int(step), epoch=epoch, metrics=metrics, reasons=reasons)
         (path / "meta.json").write_text(json.dumps(meta))
+        # overwriting a step (e.g. a re-run resuming at the same step) must
+        # replace its bookkeeping entry, not duplicate it
+        self._saved = [m for m in self._saved if m["step"] != int(step)]
         self._saved.append(meta)
         self._saved.sort(key=lambda m: m["step"])
         self._retain()

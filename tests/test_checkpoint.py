@@ -140,3 +140,19 @@ def test_encoder_partial_load_and_freeze():
                 assert float(np.abs(np.asarray(leaf)).max()) > 0
             else:
                 assert float(np.abs(np.asarray(leaf)).max()) == 0
+
+
+def test_resave_same_step_replaces_bookkeeping(tmp_path):
+    """Saving the same step twice (a resumed run re-hitting its save point)
+    replaces the entry — steps stay unique, retention counts stay right."""
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import CheckpointConfig
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ck", CheckpointConfig())
+    state = {"w": jnp.ones((2,))}
+    assert mgr.save(5, state, metrics={"val_loss": 1.0})
+    assert mgr.save(5, state, metrics={"val_loss": 0.5})
+    assert mgr.steps == [5]
+    assert mgr.meta(5)["metrics"]["val_loss"] == 0.5
