@@ -123,7 +123,11 @@ class BatchConfig:
     batch_graphs: int = 256  # graphs per batch (``config_bigvul.yaml`` batch 256)
     max_nodes: int = 40960  # node budget incl. 1 padding node
     max_edges: int = 81920  # edge budget
-    drop_oversize: bool = True  # drop graphs that alone exceed the budget
+    # True: graphs that alone exceed the budget are routed through a
+    # dedicated overflow bucket (trainer paths score them via the segment
+    # forward — nothing silently lost; bare batchers outside the CLI still
+    # drop-and-count). False: raise on the first oversize graph.
+    drop_oversize: bool = True
     # derive bucket budgets from corpus statistics (data/graphs.derive_buckets),
     # capped by the max_nodes/max_edges ceilings above — padded FLOPs are the
     # direct multiplier on step time, a worst-case constant budget wastes ~3x

@@ -103,8 +103,8 @@ def batch_dense(
 def derive_dense_size(graphs: Sequence[Graph], quantile: float = 0.99,
                       round_to: int = 8) -> int:
     """Per-graph node budget from the corpus size distribution: the
-    ``quantile`` node count rounded up to ``round_to`` (graphs above it are
-    dropped by the batcher and counted, mirroring ``GraphBatcher``)."""
+    ``quantile`` node count rounded up to ``round_to`` (graphs above it take
+    the batcher's oversize route — collect/drop/raise)."""
     if not graphs:
         raise ValueError("empty corpus")
     sizes = np.array([g.n_nodes for g in graphs])
@@ -130,11 +130,20 @@ class DenseBatcher:
     """Greedy fixed-shape packer for the dense layout: each graph goes to the
     smallest of ``sizes`` (per-graph node budgets; one compiled shape each)
     that fits, and full batches of ``max_graphs`` are emitted per size.
-    Oversize graphs are dropped (counted in ``n_dropped``) or raise, matching
-    :class:`deepdfa_tpu.data.graphs.GraphBatcher`."""
+
+    Graphs over the largest size have three routes:
+
+    - ``collect_oversize=True`` (how the trainer runs it): kept in
+      ``oversize_graphs`` for the caller to score through the segment-layout
+      forward (same parameter tree, parity-tested) — every graph in the
+      corpus gets a prediction; nothing is silently dropped.
+    - ``drop_oversize=True``: dropped and counted in ``n_dropped`` (bench
+      subsetting only — a classifier must not evaluate this way).
+    - otherwise: raise, matching :class:`deepdfa_tpu.data.graphs.GraphBatcher`.
+    """
 
     def __init__(self, max_graphs: int, nodes_per_graph: int | Sequence[int],
-                 drop_oversize: bool = True):
+                 drop_oversize: bool = True, collect_oversize: bool = False):
         sizes = ([nodes_per_graph] if isinstance(nodes_per_graph, int)
                  else sorted(nodes_per_graph))
         if max_graphs < 1 or not sizes or min(sizes) < 1:
@@ -143,7 +152,9 @@ class DenseBatcher:
         self.sizes = sizes
         self.nodes_per_graph = sizes[-1]  # largest; single-size back-compat
         self.drop_oversize = drop_oversize
+        self.collect_oversize = collect_oversize
         self.n_dropped = 0
+        self.oversize_graphs: list[Graph] = []
 
     def _size_for(self, g: Graph) -> int | None:
         for s in self.sizes:
@@ -160,11 +171,15 @@ class DenseBatcher:
         and stop entirely once every size is full. Partial batches are only
         flushed in the unlimited mode."""
         self.n_dropped = 0
+        self.oversize_graphs = []
         pending: dict[int, list[Graph]] = {s: [] for s in self.sizes}
         emitted: dict[int, int] = {s: 0 for s in self.sizes}
         for g in graphs:
             s = self._size_for(g)
             if s is None:
+                if self.collect_oversize:
+                    self.oversize_graphs.append(g)
+                    continue
                 if self.drop_oversize:
                     self.n_dropped += 1
                     continue

@@ -215,7 +215,8 @@ class GraphBatcher:
     graph list host-side each epoch (see ``data/sampler.py``).
     """
 
-    def __init__(self, buckets: Sequence[BucketSpec], drop_oversize: bool = True):
+    def __init__(self, buckets: Sequence[BucketSpec], drop_oversize: bool = True,
+                 collect_oversize: bool = False):
         if not buckets:
             raise ValueError("need at least one bucket")
         for b in buckets:
@@ -229,14 +230,23 @@ class GraphBatcher:
         self.buckets = sorted(buckets, key=lambda b: (b.max_nodes, b.max_edges, b.max_graphs))
         self.big = self.buckets[-1]
         self.drop_oversize = drop_oversize
+        self.collect_oversize = collect_oversize
         self.n_dropped = 0
+        self.oversize_graphs: list[Graph] = []
 
     def batches(self, graphs: Sequence[Graph]) -> Iterator[BatchedGraphs]:
-        self.n_dropped = 0  # per-pass count (batches() is re-run every epoch)
+        # per-pass counters (batches() is re-run every epoch)
+        self.n_dropped = 0
+        self.oversize_graphs = []
         pending: list[Graph] = []
         nn = ne = 0
         for g in graphs:
             if not self.big.fits(1, g.n_nodes, g.n_edges):
+                if self.collect_oversize:
+                    # kept for the caller to rescue through a dedicated
+                    # overflow bucket (trainer route) — nothing silently lost
+                    self.oversize_graphs.append(g)
+                    continue
                 if self.drop_oversize:
                     self.n_dropped += 1
                     continue

@@ -123,17 +123,26 @@ def _batcher(cfg: ExperimentConfig, graphs: list[Graph] | None = None):
 
         # per-graph ceiling from the configured TOTAL node budget: a batch
         # never holds more than max_nodes slots, so adjacency memory stays
-        # bounded on heavy-tailed corpora (bigger graphs are dropped and
-        # counted, the standard drop_oversize semantics)
+        # bounded on heavy-tailed corpora (bigger graphs route through the
+        # segment-fallback overflow below)
         cap = max(b.max_nodes // max(b.batch_graphs, 1), 8)
         if b.auto_buckets and graphs:
             sizes = sorted({min(s, cap) for s in derive_dense_sizes(graphs)})
         else:
             sizes = [cap]
-        return DenseBatcher(
-            max_graphs=b.batch_graphs,
-            nodes_per_graph=sizes,
-            drop_oversize=b.drop_oversize,
+        # drop_oversize=True means "don't error on oversize" — but a trainer
+        # must never silently truncate its corpus, so oversize graphs are
+        # COLLECTED and routed through the segment-layout fallback forward
+        # (same params) by _batch_stream; drop_oversize=False keeps its
+        # strict raise semantics.
+        return _with_overflow_bucket(
+            DenseBatcher(
+                max_graphs=b.batch_graphs,
+                nodes_per_graph=sizes,
+                drop_oversize=False,
+                collect_oversize=b.drop_oversize,
+            ),
+            graphs,
         )
     if b.auto_buckets and graphs:
         from deepdfa_tpu.data.graphs import derive_buckets
@@ -146,11 +155,71 @@ def _batcher(cfg: ExperimentConfig, graphs: list[Graph] | None = None):
             )
             for s in derive_buckets(graphs, b.batch_graphs)
         ]
-        return GraphBatcher(buckets, drop_oversize=b.drop_oversize)
-    return GraphBatcher(
-        [BucketSpec(b.batch_graphs + 1, b.max_nodes, b.max_edges)],
-        drop_oversize=b.drop_oversize,
-    )
+        batcher = GraphBatcher(buckets, drop_oversize=False,
+                               collect_oversize=b.drop_oversize)
+    else:
+        batcher = GraphBatcher(
+            [BucketSpec(b.batch_graphs + 1, b.max_nodes, b.max_edges)],
+            drop_oversize=False,
+            collect_oversize=b.drop_oversize,
+        )
+    return _with_overflow_bucket(batcher, graphs)
+
+
+def _overflow_bucket_for(graphs: Sequence[Graph]) -> BucketSpec:
+    from deepdfa_tpu.data.graphs import _round_up
+
+    mn = _round_up(max(g.n_nodes for g in graphs) + 2)
+    me = max(_round_up(max(g.n_edges for g in graphs)), 128)
+    return BucketSpec(max_graphs=5, max_nodes=4 * mn, max_edges=4 * me)
+
+
+def _with_overflow_bucket(batcher, graphs):
+    """Pre-size the oversize rescue bucket from the FULL corpus so its
+    compiled shape is fixed across epochs/splits (per-pass re-derivation
+    would churn XLA compiles as undersampling includes/excludes the largest
+    graphs)."""
+    if graphs:
+        if hasattr(batcher, "big"):  # segment layout
+            over = [g for g in graphs
+                    if not batcher.big.fits(1, g.n_nodes, g.n_edges)]
+        else:  # dense layout: per-graph node budget
+            over = [g for g in graphs if g.n_nodes > batcher.nodes_per_graph]
+        if over:
+            batcher.overflow_bucket = _overflow_bucket_for(over)
+    return batcher
+
+
+def _batch_stream(batcher, graphs: list[Graph]):
+    """All batches for one pass: the primary layout's batches, then the
+    oversize overflow as segment-layout batches through a dedicated big
+    bucket, so every graph is scored (for the dense layout the Trainer
+    routes overflow through the segment twin of the same params; for the
+    segment layout it is simply one more compiled shape). The overflow list
+    only fills while the primary generator runs, hence the sequential
+    yield-from."""
+    yield from batcher.batches(graphs)
+    leftover = list(getattr(batcher, "oversize_graphs", None) or ())
+    if leftover:
+        bucket = getattr(batcher, "overflow_bucket", None)
+        if bucket is None or not all(
+            bucket.fits(1, g.n_nodes, g.n_edges) for g in leftover
+        ):
+            bucket = _overflow_bucket_for(leftover)
+        seg = GraphBatcher([bucket], drop_oversize=False)
+        yield from seg.batches(leftover)
+
+
+def _oversize_stats(batcher, suffix: str = "") -> dict[str, int]:
+    """Routing counters for the last-consumed pass (ADVICE r03: surfaced in
+    metrics JSON, not just attributes): n_dropped must stay 0 in trainer
+    configurations. ``suffix`` names the pass (e.g. ``_train``/``_val``)
+    because the counters reset every ``batches()`` call."""
+    return {
+        f"n_dropped{suffix}": int(getattr(batcher, "n_dropped", 0)),
+        f"n_oversize_fallback{suffix}":
+            len(getattr(batcher, "oversize_graphs", ()) or ()),
+    }
 
 
 def _epoch_graphs(
@@ -193,21 +262,32 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
     model = make_model(cfg.model, cfg.input_dim)
     trainer = Trainer(model, cfg, pos_weight=pos_weight)
     batcher = _batcher(cfg, train + val)
-    example = jax.tree.map(jnp.asarray, next(batcher.batches(train[: cfg.data.batch.batch_graphs])))
+    example = jax.tree.map(
+        jnp.asarray,
+        next(_batch_stream(batcher, train[: cfg.data.batch.batch_graphs])),
+    )
     state = trainer.init_state(example)
     ckpts = CheckpointManager(run_dir / "checkpoints", cfg.checkpoint)
     tuning_file = run_dir / "tuning.jsonl"
     tb = _tb_writer(run_dir)
 
     last_val: dict[str, float] = {}
+    route: dict[str, int] = {}
     for epoch in range(cfg.optim.max_epochs):
         epoch_gs = _epoch_graphs(train, train_labels, cfg, epoch)
-        state, train_m, train_loss = trainer.train_epoch(state, batcher.batches(epoch_gs))
-        val_m, val_loss = trainer.evaluate(state.params, batcher.batches(val))
+        state, train_m, train_loss = trainer.train_epoch(
+            state, _batch_stream(batcher, epoch_gs)
+        )
+        route = _oversize_stats(batcher, "_train")
+        val_m, val_loss = trainer.evaluate(state.params, _batch_stream(batcher, val))
+        route |= _oversize_stats(batcher, "_val")
         last_val = val_m
         logger.info(
-            "epoch %d: train_loss=%.4f train_F1=%.4f val_loss=%.4f val_F1=%.4f",
+            "epoch %d: train_loss=%.4f train_F1=%.4f val_loss=%.4f val_F1=%.4f"
+            " oversize_fallback=%d/%d dropped=%d/%d (train/val)",
             epoch, train_loss, train_m["train_F1Score"], val_loss, val_m["val_F1Score"],
+            route["n_oversize_fallback_train"], route["n_oversize_fallback_val"],
+            route["n_dropped_train"], route["n_dropped_val"],
         )
         if tb is not None:
             for k, v in {"train_loss": train_loss, "val_loss": val_loss,
@@ -225,7 +305,7 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
     best_step = ckpts.best_step()
     if best_step is not None:
         best = ckpts.restore(best_step, template={"params": state.params})
-        final_m, final_loss = trainer.evaluate(best["params"], batcher.batches(val))
+        final_m, final_loss = trainer.evaluate(best["params"], _batch_stream(batcher, val))
         logger.info(
             "best ckpt step=%d: val_loss=%.4f val_F1=%.4f",
             best_step, final_loss, final_m["val_F1Score"],
@@ -233,6 +313,10 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
         last_val = final_m
     with open(tuning_file, "a") as f:
         f.write(json.dumps({"final": True, "val_F1Score": last_val["val_F1Score"]}) + "\n")
+    # per-pass routing counters: the last train epoch's and the final val
+    # pass's, under distinct keys — "n_dropped must stay 0" is then checked
+    # against the corpus the trainer actually consumed, not just val
+    last_val = dict(last_val) | route
     (run_dir / "final_metrics.json").write_text(json.dumps(last_val, indent=2))
     if tb is not None:
         tb.close()
@@ -247,7 +331,7 @@ def test(
     model = make_model(cfg.model, cfg.input_dim)
     trainer = Trainer(model, cfg)
     batcher = _batcher(cfg, test_graphs)
-    example = jax.tree.map(jnp.asarray, next(batcher.batches(test_graphs)))
+    example = jax.tree.map(jnp.asarray, next(_batch_stream(batcher, test_graphs)))
     state = trainer.init_state(example)
 
     ckpts = CheckpointManager(ckpt_dir or run_dir / "checkpoints", cfg.checkpoint)
@@ -271,30 +355,40 @@ def test(
     # node-style runs additionally rank statements per function (IVDetect
     # top-k protocol, ``helpers/evaluate.py:262-322``)
     statement_items: list[tuple[np.ndarray, np.ndarray]] = []
+    n_graphs_scored = 0  # must equal len(test_graphs): no silent truncation
 
     profiler = None
-    flops = None
-    flops_known = False
+    # FLOPs are a property of (compiled step, batch shapes): the dense
+    # primary step, each dense size, and the segment fallback all differ —
+    # cache per key, never attribute one step's FLOPs to another's batches
+    flops_cache: dict[tuple, float | None] = {}
     if cfg.profile or cfg.time:
         from deepdfa_tpu.train.profiling import StepProfiler
 
         profiler = StepProfiler(run_dir)
 
-    # one jitted step shared with fit-time validation — same label/mask
-    # semantics, one compile
-    eval_step = trainer.eval_step
-
     if cfg.trace:
         jax.profiler.start_trace(str(run_dir / "trace"))
-    for batch in batcher.batches(test_graphs):
+    for batch in _batch_stream(batcher, test_graphs):
         batch = jax.tree.map(jnp.asarray, batch)
+        # per-batch step: the primary layout's jitted eval step (shared with
+        # fit-time validation — one compile), or the segment fallback for
+        # dense-layout oversize overflow batches
+        eval_step = trainer.steps_for(batch)[1]
         n_real = int(np.asarray(batch.graph_mask).sum())
+        n_graphs_scored += n_real
         if profiler is not None:
-            if cfg.profile and not flops_known:
-                # exact FLOPs of the compiled step, computed once per shape
-                cost = eval_step.lower(params, batch, overall).compile().cost_analysis()
-                flops = float(cost.get("flops", 0.0)) or None if cost else None
-                flops_known = True
+            flops = None
+            if cfg.profile:
+                key = (id(eval_step), tuple(
+                    (tuple(x.shape), str(x.dtype)) for x in jax.tree.leaves(batch)
+                ))
+                if key not in flops_cache:
+                    # exact FLOPs of the compiled step, once per (step, shape)
+                    # — jit caches the executable, so this lowers-and-looks-up
+                    cost = eval_step.lower(params, batch, overall).compile().cost_analysis()
+                    flops_cache[key] = (float(cost.get("flops", 0.0)) or None) if cost else None
+                flops = flops_cache[key]
             overall, loss, probs, labels, weights = profiler.step(
                 eval_step, params, batch, overall, batch_size=n_real, flops=flops
             )
@@ -329,6 +423,13 @@ def test(
     probs = np.concatenate(all_probs)
     labels = np.concatenate(all_labels)
     results = {"test_loss": _weighted_mean(losses, wsums)}
+    results |= _oversize_stats(batcher)
+    results["n_graphs_scored"] = n_graphs_scored
+    if n_graphs_scored != len(test_graphs):
+        logger.warning(
+            "scored %d of %d test graphs — the batcher truncated the corpus",
+            n_graphs_scored, len(test_graphs),
+        )
     results |= M.compute_metrics(overall, "test_")
     results |= M.compute_metrics(pos, "test_pos_")
     results |= M.compute_metrics(neg, "test_neg_")

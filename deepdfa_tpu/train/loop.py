@@ -234,11 +234,52 @@ class Trainer:
             label_style=self.cfg.model.label_style,
             pos_weight=self.pos_weight if o.use_weighted_loss else None,
         )
+        # dense layout: graphs over the per-graph node budget are scored by
+        # the segment-layout twin with the SAME params (identical tree,
+        # parity-tested) — eval completeness, not a second model. jit is
+        # lazy, so the fallback steps cost nothing unless an oversize batch
+        # actually arrives.
+        self.fallback_train_step = self.fallback_eval_step = None
+        self._seg_twin = None
+        if self.cfg.model.layout == "dense":
+            import dataclasses as _dc
+
+            from deepdfa_tpu.models import make_model
+
+            seg_twin = self._seg_twin = make_model(
+                _dc.replace(self.cfg.model, layout="segment"),
+                input_dim=self.model.input_dim,
+            )
+            self.fallback_train_step = make_train_step(
+                seg_twin,
+                self.optimizer,
+                label_style=self.cfg.model.label_style,
+                pos_weight=self.pos_weight if o.use_weighted_loss else None,
+                undersample_node_on_loss_factor=o.undersample_node_on_loss_factor,
+            )
+            self.fallback_eval_step = make_eval_step(
+                seg_twin,
+                label_style=self.cfg.model.label_style,
+                pos_weight=self.pos_weight if o.use_weighted_loss else None,
+            )
+
+    def steps_for(self, batch) -> tuple[Callable, Callable]:
+        """(train_step, eval_step) for this batch's layout."""
+        is_segment = hasattr(batch, "node_gidx")
+        if is_segment and self.fallback_train_step is not None:
+            return self.fallback_train_step, self.fallback_eval_step
+        return self.train_step, self.eval_step
 
     def init_state(self, example_batch: BatchedGraphs) -> TrainState:
         rng = jax.random.key(self.cfg.seed)
         rng, init_rng = jax.random.split(rng)
-        params = self.model.init(init_rng, example_batch)["params"]
+        model = self.model
+        if hasattr(example_batch, "node_gidx") and self._seg_twin is not None:
+            # layouts share one param tree, so a segment example initialises
+            # the dense model too (possible when every sampled graph was
+            # oversize and only the fallback route produced a batch)
+            model = self._seg_twin
+        params = model.init(init_rng, example_batch)["params"]
         return TrainState(params, self.optimizer.init(params), rng, jnp.zeros((), jnp.int32))
 
     def train_epoch(
@@ -248,7 +289,8 @@ class Trainer:
         losses, wsums = [], []
         for batch in batches:
             batch = jax.tree.map(jnp.asarray, batch)
-            state, metrics, loss, wsum = self.train_step(state, batch, metrics)
+            step, _ = self.steps_for(batch)
+            state, metrics, loss, wsum = step(state, batch, metrics)
             losses.append(loss)
             wsums.append(wsum)
         return state, compute_metrics(metrics, "train_"), _weighted_mean(losses, wsums)
@@ -260,7 +302,8 @@ class Trainer:
         losses, wsums = [], []
         for batch in batches:
             batch = jax.tree.map(jnp.asarray, batch)
-            metrics, loss, _probs, _labels, weights = self.eval_step(params, batch, metrics)
+            _, estep = self.steps_for(batch)
+            metrics, loss, _probs, _labels, weights = estep(params, batch, metrics)
             losses.append(loss)
             wsums.append(jnp.sum(weights))
         mean_loss = _weighted_mean(losses, wsums)
