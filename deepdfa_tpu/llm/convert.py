@@ -31,7 +31,12 @@ import numpy as np
 
 from deepdfa_tpu.llm.llama import LlamaConfig
 
-__all__ = ["convert_state_dict", "load_hf_checkpoint", "load_hf_config"]
+__all__ = [
+    "convert_state_dict",
+    "load_hf_checkpoint",
+    "load_hf_config",
+    "load_torch_state",
+]
 
 
 def _assign(tree: dict, path: list[str], value: np.ndarray) -> None:
@@ -95,11 +100,11 @@ def load_hf_config(ckpt_dir: str | Path) -> LlamaConfig:
         return LlamaConfig.from_hf_dict(json.load(f))
 
 
-def load_hf_checkpoint(
-    ckpt_dir: str | Path, dtype=np.float32, bare: bool = False
-) -> dict:
-    """Convert a local HF checkpoint directory (safetensors preferred,
-    torch .bin fallback) into a Flax params tree."""
+def load_torch_state(ckpt_dir: str | Path) -> dict:
+    """Raw HF ``state_dict`` from a local checkpoint dir (safetensors
+    preferred, torch .bin fallback; torch imported only when needed).
+    Architecture-agnostic — the llama and roberta converters both feed on
+    it."""
     ckpt_dir = Path(ckpt_dir)
     state: dict = {}
     st_files = sorted(ckpt_dir.glob("*.safetensors"))
@@ -109,13 +114,21 @@ def load_hf_checkpoint(
         for f in st_files:
             state.update(load_file(str(f)))
     else:
-        import torch
-
         bin_files = sorted(ckpt_dir.glob("pytorch_model*.bin")) or sorted(
             ckpt_dir.glob("*.pt")
         )
         if not bin_files:
             raise FileNotFoundError(f"no weights found under {ckpt_dir}")
+        import torch
+
         for f in bin_files:
             state.update(torch.load(f, map_location="cpu", weights_only=True))
-    return convert_state_dict(state, dtype=dtype, bare=bare)
+    return state
+
+
+def load_hf_checkpoint(
+    ckpt_dir: str | Path, dtype=np.float32, bare: bool = False
+) -> dict:
+    """Convert a local HF checkpoint directory (safetensors preferred,
+    torch .bin fallback) into a Flax params tree."""
+    return convert_state_dict(load_torch_state(ckpt_dir), dtype=dtype, bare=bare)

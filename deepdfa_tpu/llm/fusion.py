@@ -47,9 +47,19 @@ def pool_tokens(
     ``features[:, 0, :]`` "CLS" read (``model.py:21``) — under a *causal*
     decoder position 0 attends only to itself, so that slot is a constant
     vector for every input (a CodeBERT-ism that defeats the LLM branch);
-    ``pool="first"`` keeps it available for strict parity comparisons."""
+    ``pool="first"`` keeps it available for strict parity comparisons.
+
+    ``pool="cls"``: the first *real* token — the right read for
+    bidirectional encoders (CodeBERT/LineVul, config #3), where ``<s>`` IS a
+    summary of the whole sequence; mask-aware so the framework's left-pad
+    convention works (with right padding or no pads it equals "first")."""
     if pool == "first":
         return features[:, 0, :]
+    if pool == "cls":
+        if token_mask is None:
+            return features[:, 0, :]
+        first = jnp.argmax(token_mask.astype(jnp.int32), axis=1)
+        return jnp.take_along_axis(features, first[:, None, None], axis=1)[:, 0, :]
     if pool != "last":
         raise ValueError(f"unknown pool {pool!r}")
     if token_mask is None:

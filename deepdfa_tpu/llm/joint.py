@@ -78,6 +78,14 @@ class JointConfig:
     # "bigvul" → macro avg (imbalanced); anything else → weighted avg
     dataset_style: str = "bigvul"
     use_gnn: bool = True  # False = --no_flowgnn presets
+    # LineVul-combined mode (BASELINE config #3): fine-tune the encoder
+    # end-to-end (CodeBERT is 125M params — trainable on one chip) while the
+    # pretrained GGNN is frozen — the exact mirror of the MSIVD freeze
+    # direction (frozen LLM, trained GNN). ``freeze_gnn`` zeroes updates to
+    # the ``flowgnn_encoder`` subtree (``main_cli.py:136-145``'s
+    # freeze_graph_weights).
+    train_llm: bool = False
+    freeze_gnn: bool = False
 
     @property
     def report_avg(self) -> str:
@@ -121,10 +129,23 @@ def cosine_warmup_schedule(lr: float, warmup_steps: int, total_steps: int):
     )
 
 
+def gnn_freeze_labels(params: Any) -> Any:
+    """"train"/"freeze" label pytree: every leaf under a ``flowgnn_encoder``
+    scope is frozen (``freeze_graph_weights`` parity) — works on both the
+    bare fusion tree and the combined ``{"fusion", "llm"}`` tree."""
+
+    def lab(path: tuple, _leaf) -> str:
+        keys = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+        return "freeze" if "flowgnn_encoder" in keys else "train"
+
+    return jax.tree_util.tree_map_with_path(lab, params)
+
+
 def joint_optimizer(cfg: JointConfig, steps_per_epoch: int, params: Any):
     """clip → AdamW(no-decay mask) → cosine-warmup, wrapped in MultiSteps for
     gradient accumulation (micro-step semantics identical to ``train.py``:
-    update every ``gradient_accumulation_steps`` batches)."""
+    update every ``gradient_accumulation_steps`` batches). With
+    ``cfg.freeze_gnn`` the ``flowgnn_encoder`` subtree gets zero updates."""
     opt_steps = (cfg.epochs * steps_per_epoch) // cfg.gradient_accumulation_steps
     warmup = opt_steps // 50  # train.py:238 "args.warmup_steps = max_steps // 50"
     schedule = cosine_warmup_schedule(cfg.learning_rate, warmup, opt_steps)
@@ -137,6 +158,11 @@ def joint_optimizer(cfg: JointConfig, steps_per_epoch: int, params: Any):
             mask=weight_decay_mask(params),
         ),
     )
+    if cfg.freeze_gnn:
+        tx = optax.multi_transform(
+            {"train": tx, "freeze": optax.set_to_zero()},
+            gnn_freeze_labels(params),
+        )
     if cfg.gradient_accumulation_steps > 1:
         tx = optax.MultiSteps(tx, cfg.gradient_accumulation_steps)
     return tx
@@ -155,9 +181,16 @@ def make_joint_steps(
     llm: LlamaModel,
     fusion: FusionModel,
     tx: optax.GradientTransformation,
+    train_llm: bool = False,
 ) -> tuple[Callable, Callable]:
     """(train_step, eval_step), both jitted. ``llm_params`` is an input, not a
-    capture, so sharded placements propagate and the tree is donated-free."""
+    capture, so sharded placements propagate and the tree is donated-free.
+
+    ``train_llm=False`` (MSIVD): the LLM forward runs on the constant
+    ``llm_params`` input with no backward built through the stack.
+    ``train_llm=True`` (LineVul-combined): the trained tree is
+    ``{"fusion": ..., "llm": ...}`` and gradients flow through the encoder;
+    the ``llm_params`` step argument is ignored (pass ``None``)."""
 
     def hidden_states(llm_params, batch: JoinedBatch):
         ids = jnp.asarray(batch.text.input_ids)
@@ -166,15 +199,20 @@ def make_joint_steps(
         # ``attention_mask = input_ids.ne(1)`` (model.py:50) masks *bos*
         # instead of pads; we carry the truth from tokenization time. RoPE is
         # relative, so arange positions over a left-padded row preserve all
-        # real-token distances (a uniform shift).
+        # real-token distances (a uniform shift); the RoBERTa encoder builds
+        # mask-aware absolute positions itself.
         return llm.apply(
             {"params": llm_params}, ids, jnp.asarray(batch.text.pad_mask)
         )
 
     def loss_fn(params, llm_params, batch: JoinedBatch, rng):
+        if train_llm:
+            fusion_params, llm_params = params["fusion"], params["llm"]
+        else:
+            fusion_params = params
         hidden = hidden_states(llm_params, batch)
         logits = fusion.apply(
-            {"params": params},
+            {"params": fusion_params},
             hidden,
             batch.graphs if fusion.use_gnn else None,
             deterministic=False,
@@ -198,9 +236,13 @@ def make_joint_steps(
 
     @jax.jit
     def eval_step(params, llm_params, batch: JoinedBatch):
+        if train_llm:
+            fusion_params, llm_params = params["fusion"], params["llm"]
+        else:
+            fusion_params = params
         hidden = hidden_states(llm_params, batch)
         logits = fusion.apply(
-            {"params": params},
+            {"params": fusion_params},
             hidden,
             batch.graphs if fusion.use_gnn else None,
             deterministic=True,
@@ -230,6 +272,13 @@ class JointTrainer:
         self.num_missing = 0
         self.history: list[dict] = []
 
+    @property
+    def _llm_arg(self):
+        """The frozen-encoder step argument: in ``train_llm`` mode the
+        encoder lives inside ``state.params`` and the argument is unused —
+        don't ship a second copy of the weights into every step."""
+        return None if self.cfg.train_llm else self.llm_params
+
     def _joined(self, batch) -> JoinedBatch:
         if self.join is not None:
             return self.join.join(batch)
@@ -258,8 +307,14 @@ class JointTrainer:
                 deterministic=True,
                 token_mask=jnp.asarray(example.text.pad_mask),
             )["params"]
+            if self.cfg.train_llm:
+                # LineVul-combined: the encoder joins the trained tree (and
+                # its checkpoint — the reference saves fine-tuned CodeBERT)
+                params = {"fusion": params, "llm": self.llm_params}
         self.tx = joint_optimizer(self.cfg, steps_per_epoch, params)
-        self._steps = make_joint_steps(self.llm, self.fusion, self.tx)
+        self._steps = make_joint_steps(
+            self.llm, self.fusion, self.tx, train_llm=self.cfg.train_llm
+        )
         if not fresh:
             return None
         return JointState(params, self.tx.init(params), rng, jnp.zeros((), jnp.int32))
@@ -290,7 +345,7 @@ class JointTrainer:
                     )
                     state = state if state is not None else built
                 train_step, _ = self._steps
-                state, loss, _probs = train_step(state, self.llm_params, jb)
+                state, loss, _probs = train_step(state, self._llm_arg, jb)
                 tr_loss += float(loss)
                 tr_num += 1
                 if step in points:
@@ -315,7 +370,7 @@ class JointTrainer:
             if self._steps is None:  # standalone eval (test-only runs)
                 self._build(1, jb, params=params)
             _, eval_step = self._steps
-            loss, probs = eval_step(params, self.llm_params, jb)
+            loss, probs = eval_step(params, self._llm_arg, jb)
             losses.append(float(loss))
             keep = np.asarray(jb.mask)
             probs_all.append(np.asarray(probs)[keep])

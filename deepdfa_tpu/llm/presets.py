@@ -1,7 +1,9 @@
 """Joint-training launch presets.
 
 The five MSIVD launch scripts (``MSIVD/msivd/scripts/*.sh``) as structured
-configs. ``finetuned`` marks presets that start from a LoRA-finetuned model
+configs, plus the two LineVul configs of BASELINE config #3
+(``scripts/performance_evaluation.sh:7-9``: LineVul alone and
+DeepDFA+LineVul combined, ``encoder_family="roberta"``). ``finetuned`` marks presets that start from a LoRA-finetuned model
 (the reference's ``--finetuned_path`` / ``PeftInference`` load path,
 ``train.py:863-869`` — here: convert HF weights, apply LoRA adapters, see
 ``deepdfa_tpu/llm/{convert,lora}.py``). Mesh suggestions are TPU-side design
@@ -17,6 +19,7 @@ import dataclasses
 from deepdfa_tpu.config import MeshConfig
 from deepdfa_tpu.llm.joint import JointConfig
 from deepdfa_tpu.llm.llama import LlamaConfig, codellama_7b, codellama_13b
+from deepdfa_tpu.llm.roberta import codebert_base
 
 __all__ = ["JointPreset", "PRESETS"]
 
@@ -24,11 +27,14 @@ __all__ = ["JointPreset", "PRESETS"]
 @dataclasses.dataclass(frozen=True)
 class JointPreset:
     name: str
-    llm: LlamaConfig
+    llm: "LlamaConfig | object"  # RobertaConfig for encoder_family="roberta"
     joint: JointConfig
     finetuned: bool  # load LoRA-finetuned weights first (--finetuned_path)
     mesh: MeshConfig
     dataset: str  # reference data family the preset targets
+    # which encoder stack drives the fusion head: "llama" (causal, MSIVD) or
+    # "roberta" (bidirectional CodeBERT — the LineVul configs)
+    encoder_family: str = "llama"
 
 
 PRESETS: dict[str, JointPreset] = {
@@ -93,6 +99,38 @@ PRESETS: dict[str, JointPreset] = {
             finetuned=False,
             mesh=MeshConfig(dp=-1, fsdp=2, tp=1, sp=1),
             dataset="precisebugs",
+        ),
+        # BASELINE config #3a — LineVul alone: fine-tuned CodeBERT classifier
+        # (msr_train_linevul.sh: block 512, batch 16, lr 2e-5, 10 epochs)
+        JointPreset(
+            name="linevul",
+            llm=codebert_base(),
+            joint=JointConfig(
+                block_size=512, epochs=10, train_batch_size=16,
+                eval_batch_size=16, learning_rate=2e-5, dataset_style="bigvul",
+                use_gnn=False, train_llm=True,
+            ),
+            finetuned=False,
+            mesh=MeshConfig(dp=-1, fsdp=1, tp=1, sp=1),
+            dataset="bigvul",
+            encoder_family="roberta",
+        ),
+        # BASELINE config #3b — DeepDFA + LineVul fused classifier
+        # (msr_train_combined.sh): CodeBERT fine-tuned end-to-end, pretrained
+        # GGNN embeddings frozen (main_cli.py:136-145 freeze-transfer), CLS ⊕
+        # pooled-graph concat head
+        JointPreset(
+            name="linevul_fusion",
+            llm=codebert_base(),
+            joint=JointConfig(
+                block_size=512, epochs=10, train_batch_size=16,
+                eval_batch_size=16, learning_rate=2e-5, dataset_style="bigvul",
+                use_gnn=True, train_llm=True, freeze_gnn=True,
+            ),
+            finetuned=False,
+            mesh=MeshConfig(dp=-1, fsdp=1, tp=1, sp=1),
+            dataset="bigvul",
+            encoder_family="roberta",
         ),
     ]
 }

@@ -46,6 +46,17 @@ def main(argv=None) -> dict:
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--output_dir", default=None)
     parser.add_argument("--sample", action="store_true")
+    parser.add_argument(
+        "--encoder", choices=["llama", "roberta"], default=None,
+        help="encoder stack (default: preset's encoder_family, else llama); "
+        "roberta = the CodeBERT/LineVul bidirectional path (config #3)",
+    )
+    parser.add_argument(
+        "--freeze-graph", default=None, metavar="CKPT_DIR",
+        help="checkpoint dir of a deepdfa-tpu fit run: load its GGNN encoder "
+        "weights into the fusion model and freeze them "
+        "(main_cli.py:136-145 freeze-transfer)",
+    )
     args = parser.parse_args(argv)
 
     import dataclasses
@@ -67,13 +78,23 @@ def main(argv=None) -> dict:
     from deepdfa_tpu.llm.llama import LlamaModel, tiny_llama
 
     # --- joint config: preset base, CLI overrides on top
+    encoder_family = args.encoder
     if args.preset:
         from deepdfa_tpu.llm.presets import PRESETS
 
         preset = PRESETS[args.preset]
         jcfg, llm_cfg = preset.joint, preset.llm
+        if encoder_family and encoder_family != preset.encoder_family:
+            # the preset's llm config is class-bound to its stack — crossing
+            # them builds LlamaModel(RobertaConfig) or vice versa
+            raise SystemExit(
+                f"--encoder {encoder_family} contradicts preset "
+                f"{args.preset!r} (encoder_family={preset.encoder_family})"
+            )
+        encoder_family = preset.encoder_family
     else:
         jcfg, llm_cfg = JointConfig(), tiny_llama(vocab_size=2048)
+    encoder_family = encoder_family or "llama"
     updates = {
         k: v
         for k, v in {
@@ -90,6 +111,23 @@ def main(argv=None) -> dict:
     if args.no_flowgnn:
         updates["use_gnn"] = False
     jcfg = dataclasses.replace(jcfg, **updates)
+    if encoder_family == "roberta" and not args.preset and not args.hf_checkpoint:
+        from deepdfa_tpu.llm.roberta import tiny_roberta
+
+        # hermetic default: tiny CodeBERT-architecture encoder, LineVul mode;
+        # built AFTER overrides so the position table covers --block_size
+        # (+2: RoBERTa positions start at pad_token_id + 1)
+        llm_cfg = tiny_roberta(
+            vocab_size=2048, max_position_embeddings=jcfg.block_size + 4
+        )
+        jcfg = dataclasses.replace(jcfg, train_llm=True)
+    if args.freeze_graph:
+        if not jcfg.use_gnn:
+            raise SystemExit(
+                "--freeze-graph requires the GNN branch (drop --no_flowgnn / "
+                "use a use_gnn preset)"
+            )
+        jcfg = dataclasses.replace(jcfg, freeze_gnn=True)
 
     # --- corpus: functions + labels from the demo generator or ingest table
     if args.dataset == "demo":
@@ -103,7 +141,37 @@ def main(argv=None) -> dict:
     funcs, labels, ids = df.before.tolist(), df.vul.tolist(), df.id.tolist()
 
     # --- model + tokenizer
-    if args.hf_checkpoint:
+    if encoder_family == "roberta":
+        from deepdfa_tpu.llm.roberta import RobertaEncoder
+
+        if args.hf_checkpoint:
+            from transformers import AutoTokenizer
+
+            from deepdfa_tpu.llm.convert import load_torch_state
+            from deepdfa_tpu.llm.roberta import RobertaConfig, convert_hf_roberta
+
+            with open(Path(args.hf_checkpoint) / "config.json") as f:
+                llm_cfg = RobertaConfig.from_hf_dict(json.load(f))
+            tokenizer = AutoTokenizer.from_pretrained(args.hf_checkpoint)
+            llm = RobertaEncoder(llm_cfg)
+            llm_params = convert_hf_roberta(load_torch_state(args.hf_checkpoint))
+        else:
+            import flax.linen as nn
+
+            tokenizer = HashTokenizer(vocab_size=llm_cfg.vocab_size)
+            llm = RobertaEncoder(llm_cfg)
+            # unbox: in train_llm mode these params join the trained tree,
+            # where boxed leaves would defeat the no-decay mask (its path
+            # check would see the box's 'value' leaf) and diverge from the
+            # unboxed HF-checkpoint tree shape
+            llm_params = nn.meta.unbox(
+                llm.init(
+                    jax.random.key(0),
+                    np.zeros((2, jcfg.block_size), np.int32),
+                    np.ones((2, jcfg.block_size), bool),
+                )["params"]
+            )
+    elif args.hf_checkpoint:
         from transformers import AutoTokenizer
 
         from deepdfa_tpu.llm.convert import load_hf_checkpoint, load_hf_config
@@ -162,6 +230,9 @@ def main(argv=None) -> dict:
         llm_hidden_size=llm_cfg.hidden_size,
         use_gnn=jcfg.use_gnn,
         dropout_rate=0.1,
+        # bidirectional encoders summarise into the CLS (first real) token;
+        # causal decoders into the last
+        pool="cls" if encoder_family == "roberta" else "last",
     )
     run_dir = Path(args.output_dir) if args.output_dir else utils.get_dir(
         utils.storage_dir() / "joint_runs" / utils.get_run_id()
@@ -173,8 +244,31 @@ def main(argv=None) -> dict:
 
     out: dict = {"run_dir": str(run_dir), "n_train": len(train_ex)}
     state = None
+    if args.freeze_graph:
+        # freeze-transfer (main_cli.py:136-145): pre-build the state, overlay
+        # the pretrained GGNN encoder weights (head keys keep fresh init),
+        # then train — the optimizer already zeroes flowgnn_encoder updates
+        from deepdfa_tpu.train.checkpoint import CheckpointManager, encoder_partial_load
+
+        n_batches = -(-len(train_ex) // jcfg.train_batch_size)
+        first = trainer._joined(next(text_batches(train_ex, jcfg.train_batch_size)))
+        state = trainer._build(n_batches, first)
+        ckpts = CheckpointManager(args.freeze_graph)
+        restored = (
+            ckpts.restore_best() if ckpts.best_step() is not None
+            else ckpts.restore_latest()
+        )["params"]
+        fusion_tree = dict(state.params["fusion"] if jcfg.train_llm else state.params)
+        fusion_tree["flowgnn_encoder"] = encoder_partial_load(
+            fusion_tree["flowgnn_encoder"], restored
+        )
+        new_params = (
+            {**state.params, "fusion": fusion_tree} if jcfg.train_llm else fusion_tree
+        )
+        state = state._replace(params=new_params)
+        out["freeze_graph"] = str(args.freeze_graph)
     if args.do_train:
-        state = trainer.train(train_ex, eval_ex)
+        state = trainer.train(train_ex, eval_ex, state=state)
         out["history"] = trainer.history[-3:]
         out["num_missing"] = trainer.num_missing
     if args.do_test:

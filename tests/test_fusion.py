@@ -370,9 +370,10 @@ def test_presets_cover_reference_launch_scripts():
     """One preset per MSIVD launch script (scripts/*.sh), golden values."""
     from deepdfa_tpu.llm.presets import PRESETS
 
+    # 5 MSIVD launch scripts + the 2 LineVul configs of BASELINE config #3
     assert set(PRESETS) == {
         "bigvul_ft_bigvul", "pretrained_bigvul", "pb_ft_pb",
-        "pb_ft_pb_noexpl", "pretrained_pb",
+        "pb_ft_pb_noexpl", "pretrained_pb", "linevul", "linevul_fusion",
     }
     p = PRESETS["bigvul_ft_bigvul"]
     assert p.llm.hidden_size == 4096 and p.joint.block_size == 256
