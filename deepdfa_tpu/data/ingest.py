@@ -297,6 +297,54 @@ def devign(
     return out
 
 
+def diversevul(
+    json_path: str | Path | None = None, cache: bool = True, sample: bool = False
+) -> pd.DataFrame:
+    """DiverseVul reader (config #4's corpus; the reference's finetuned
+    checkpoints are tuned on it — ``MSIVD/msivd/train.py:863-869`` consumes
+    them). Source: the published ``diversevul_*.json`` JSONL — one object
+    per function: ``func``, ``target``, ``cwe`` (list), ``project``,
+    ``commit_id``, ``message``. Keeps the explanation columns (``cwe``,
+    ``message``) that the self-instruct multitask builder supervises on."""
+    base = _cache_path("diversevul", sample)
+    if cache and json_path is None:
+        cached = _cache_load(base)
+        if cached is not None:
+            return cached
+    default_source = json_path is None
+    if json_path is None:
+        json_path = utils.external_dir() / "diversevul.json"
+    df = pd.read_json(json_path, lines=True)
+    df = df.rename_axis("id").reset_index()
+    df["dataset"] = "diversevul"
+    df["before"] = [remove_comments(c).replace("\n\n", "\n") for c in df["func"]]
+    df = df[~df.before.apply(_abnormal_ending)]
+    df["vul"] = df["target"].astype(int)
+
+    def _clean(v) -> str:
+        # null/NaN-safe: pd.read_json yields float NaN for missing values,
+        # and NaN is truthy — naive str(v or "") would supervise the literal
+        # answer "nan" in the explanation rounds
+        if isinstance(v, (list, tuple)):
+            return ",".join(str(x) for x in v)
+        if v is None or (isinstance(v, float) and pd.isna(v)):
+            return ""
+        return str(v)
+
+    cwe_col = df["cwe"] if "cwe" in df.columns else pd.Series("", index=df.index)
+    df["cwe"] = [_clean(v) for v in cwe_col]
+    msg_col = df["message"] if "message" in df.columns else pd.Series("", index=df.index)
+    df["message"] = [_clean(v) for v in msg_col]
+    if sample:
+        df = df.head(50)
+    out = df[
+        ["id", "dataset", "before", "target", "vul", "cwe", "message"]
+    ].reset_index(drop=True)
+    if cache and default_source:
+        _cache_save(out, base)
+    return out
+
+
 def mutated(
     subdataset: str, cache: bool = True, sample: bool = False
 ) -> pd.DataFrame:
@@ -320,6 +368,8 @@ def ds(dsname: str, cache: bool = True, sample: bool = False, **kw) -> pd.DataFr
         return bigvul(cache=cache, sample=sample, **kw)
     if dsname == "devign":
         return devign(cache=cache, sample=sample, **kw)
+    if dsname == "diversevul":
+        return diversevul(cache=cache, sample=sample, **kw)
     if dsname.startswith("mutated"):
         return mutated(dsname.split("_", maxsplit=1)[1], cache=cache, sample=sample)
     raise ValueError(f"unknown dataset {dsname!r}")

@@ -88,9 +88,13 @@ class HashTokenizer:
         h = int(hashlib.sha1(token.encode()).hexdigest(), 16)
         return self._floor + h % (self.vocab_size - self._floor)
 
+    def encode_raw(self, text: str) -> list[int]:
+        """Bare token ids, no specials/padding (dialogue-segment encoding —
+        the self-instruct builder owns bos/eos placement)."""
+        return [self._id(t) for t in tokenise(text).split()]
+
     def encode_block(self, text: str, block_size: int) -> tuple[np.ndarray, np.ndarray]:
-        toks = tokenise(text).split()
-        ids = [self.bos_token_id] + [self._id(t) for t in toks]
+        ids = [self.bos_token_id] + self.encode_raw(text)
         return _fit_block(np.array(ids, np.int32), block_size, self.eos_token_id)
 
 

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from deepdfa_tpu.llm.dataset import TextExamples, text_batches
+from deepdfa_tpu.llm.dataset import TextExamples
 from deepdfa_tpu.llm.joint import cosine_warmup_schedule
 from deepdfa_tpu.llm.llama import LlamaForCausalLM
 from deepdfa_tpu.llm.lora import lora_mask, split_lora
@@ -76,10 +76,14 @@ def lm_loss(
     logits: jnp.ndarray,  # [b, s, v]
     input_ids: jnp.ndarray,  # [b, s]
     pad_mask: jnp.ndarray,  # [b, s] True = real token
+    loss_mask: jnp.ndarray | None = None,  # [b, s] True = graded token
 ) -> jnp.ndarray:
-    """Next-token CE over positions whose *target* is a real token."""
+    """Next-token CE over positions whose *target* is a real token — or,
+    with ``loss_mask`` (self-instruct multitask tuning), only positions
+    whose target is a *response* token: the model is graded on its answers,
+    not on re-predicting the prompt."""
     targets = input_ids[:, 1:]
-    w = pad_mask[:, 1:].astype(jnp.float32)
+    w = (pad_mask if loss_mask is None else loss_mask)[:, 1:].astype(jnp.float32)
     ce = optax.softmax_cross_entropy_with_integer_labels(logits[:, :-1], targets)
     return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
 
@@ -87,13 +91,16 @@ def lm_loss(
 def make_lm_steps(
     model: LlamaForCausalLM, tx: optax.GradientTransformation
 ) -> tuple[Callable, Callable]:
-    def loss_fn(params, ids, mask):
+    """Steps take an optional response-only ``loss_mask`` (None = grade all
+    real tokens; attention always sees the full ``pad_mask``)."""
+
+    def loss_fn(params, ids, mask, loss_mask=None):
         logits = model.apply({"params": params}, ids, mask)
-        return lm_loss(logits, ids, mask)
+        return lm_loss(logits, ids, mask, loss_mask)
 
     @jax.jit
-    def train_step(state: FinetuneState, ids, mask):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, ids, mask)
+    def train_step(state: FinetuneState, ids, mask, loss_mask=None):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, ids, mask, loss_mask)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return FinetuneState(params, opt_state, state.rng, state.step + 1), loss
@@ -102,14 +109,43 @@ def make_lm_steps(
     return train_step, eval_step
 
 
+def _lm_batches(examples, batch_size: int, seed: int = 0):
+    """Fixed-shape ``(ids, pad_mask, loss_mask|None)`` batches over
+    :class:`TextExamples` or :class:`LMExamples`, delegating the static-
+    tail-batch contract to :func:`~deepdfa_tpu.llm.dataset.text_batches`
+    (one implementation of the no-recompile invariant); ``loss_mask`` rows
+    are re-joined by row position and zeroed on padded tail rows."""
+    from deepdfa_tpu.llm.dataset import text_batches
+
+    has_lm = hasattr(examples, "loss_mask")
+    n = len(examples)
+    te = TextExamples(
+        input_ids=examples.input_ids,
+        labels=np.zeros(n, np.int32),
+        indices=np.arange(n),  # row positions, the loss_mask join key
+        pad_mask=examples.pad_mask,
+    ) if has_lm else examples
+    for tb in text_batches(te, batch_size, shuffle=True, seed=seed):
+        lm = None
+        if has_lm:
+            rows = np.clip(tb.indices, 0, None).astype(np.intp)
+            lm = examples.loss_mask[rows].copy()
+            lm[~tb.mask] = False  # padded tail rows carry zero loss
+        yield tb.input_ids, tb.pad_mask, lm
+
+
 @dataclasses.dataclass
 class LoraFinetuner:
     model: LlamaForCausalLM
     cfg: FinetuneConfig
     run_dir: Path | None = None
 
-    def train(self, params: Any, examples: TextExamples) -> tuple[Any, list[float]]:
-        """Returns (params with tuned adapters, per-epoch mean losses)."""
+    def train(self, params: Any, examples) -> tuple[Any, list[float]]:
+        """Returns (params with tuned adapters, per-epoch mean losses).
+
+        ``examples`` is :class:`TextExamples` (plain causal-LM, loss on all
+        real tokens) or :class:`~deepdfa_tpu.llm.selfinstruct.LMExamples`
+        (multitask dialogues, loss on response tokens only)."""
         cfg = self.cfg
         n_batches = -(-len(examples) // cfg.batch_size)
         tx = lora_optimizer(cfg, params, total_steps=cfg.epochs * n_batches)
@@ -120,11 +156,12 @@ class LoraFinetuner:
         epoch_losses: list[float] = []
         for epoch in range(cfg.epochs):
             losses = []
-            for tb in text_batches(
-                examples, cfg.batch_size, shuffle=True, seed=cfg.seed + epoch
+            for ids, pad, loss_mask in _lm_batches(
+                examples, cfg.batch_size, seed=cfg.seed + epoch
             ):
                 state, loss = train_step(
-                    state, jnp.asarray(tb.input_ids), jnp.asarray(tb.pad_mask)
+                    state, jnp.asarray(ids), jnp.asarray(pad),
+                    None if loss_mask is None else jnp.asarray(loss_mask),
                 )
                 losses.append(float(loss))
             epoch_losses.append(float(np.mean(losses)))

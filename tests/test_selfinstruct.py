@@ -1,0 +1,164 @@
+"""Config #4: DiverseVul reader + self-instruct multitask tuning format."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from deepdfa_tpu.llm.dataset import HashTokenizer
+from deepdfa_tpu.llm.selfinstruct import (
+    FINETUNE_PRESETS,
+    encode_dialogue,
+    encode_multitask,
+    multitask_rounds,
+)
+
+TOK = HashTokenizer(vocab_size=256)
+
+
+def test_multitask_rounds_shape():
+    vul = multitask_rounds("int f(){}", 1, cwe="CWE-787", explanation="oob write")
+    assert [r.response for r in vul] == ["yes", "CWE-787", "oob write"]
+    nonvul = multitask_rounds("int f(){}", 0, cwe="CWE-787", explanation="x")
+    assert len(nonvul) == 1 and nonvul[0].response == "no"
+    # vulnerable but no metadata: detection round only
+    bare = multitask_rounds("int f(){}", 1)
+    assert len(bare) == 1 and bare[0].response == "yes"
+
+
+def test_encode_dialogue_loss_mask_covers_responses_only():
+    rounds = multitask_rounds("int f(int a){return a;}", 1, "CWE-79", "bad")
+    ids, pad, lm = encode_dialogue(TOK, rounds, block_size=64)
+    assert ids.shape == (64,) and pad.shape == (64,) and lm.shape == (64,)
+    # loss tokens are a strict non-empty subset of real tokens
+    assert lm.sum() > 0
+    assert np.all(pad[lm])
+    assert lm.sum() < pad.sum()
+    # left-padded: real tokens are a contiguous suffix
+    first_real = int(np.argmax(pad))
+    assert pad[first_real:].all()
+    # each response ends with eos carrying loss: the last real token is a
+    # graded eos
+    assert ids[-1] == TOK.eos_token_id and lm[-1]
+
+
+def test_encode_dialogue_truncation_preserves_responses():
+    """Over-long code truncates from the first prompt, not the answers."""
+    # distinct identifiers: the hash tokenizer keeps identifier subtokens,
+    # so this yields ~200 tokens and forces front-truncation
+    long_code = "int f(){" + "".join(f" var{i}qq = {i};" for i in range(200)) + "}"
+    rounds = multitask_rounds(long_code, 1, "CWE-787", "overflow")
+    ids, pad, lm = encode_dialogue(TOK, rounds, block_size=48)
+    assert pad.sum() == 48  # fully packed
+    # all three responses survive: yes, CWE-787, overflow + 3 eos
+    n_graded = int(lm.sum())
+    expect = (
+        len(TOK.encode_raw("yes")) + len(TOK.encode_raw("CWE-787"))
+        + len(TOK.encode_raw("overflow")) + 3
+    )
+    assert n_graded == expect
+
+
+def test_encode_multitask_batch():
+    ex = encode_multitask(
+        ["int a(){}", "int b(){}"], [1, 0], TOK, 32,
+        cwes=["CWE-1", ""], explanations=["boom", ""], indices=[7, 9],
+    )
+    assert len(ex) == 2
+    assert ex.input_ids.shape == (2, 32)
+    assert list(ex.indices) == [7, 9]
+    # the non-vul row grades fewer tokens (only "no" + eos)
+    assert ex.loss_mask[1].sum() < ex.loss_mask[0].sum()
+
+
+def test_lm_loss_response_masking_changes_loss():
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.llm.finetune import lm_loss
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(1, 8, 16)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, 16, size=(1, 8)).astype(np.int32))
+    pad = jnp.ones((1, 8), bool)
+    lm = jnp.asarray(np.array([[0, 0, 0, 0, 1, 1, 1, 1]], bool))
+    full = float(lm_loss(logits, ids, pad))
+    masked = float(lm_loss(logits, ids, pad, lm))
+    assert np.isfinite(full) and np.isfinite(masked)
+    assert abs(full - masked) > 1e-6
+
+
+def test_diversevul_reader(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    from deepdfa_tpu import utils
+
+    ext = utils.external_dir()
+    ext.mkdir(parents=True, exist_ok=True)
+    rows = [
+        {"func": "int f(){return 1;}\n", "target": 1, "cwe": ["CWE-787"],
+         "project": "p", "commit_id": "c1", "message": "fix oob write"},
+        {"func": "int g(){return 2;}\n", "target": 0, "cwe": [],
+         "project": "p", "commit_id": "c2", "message": "refactor"},
+    ]
+    path = ext / "diversevul.json"
+    path.write_text("\n".join(json.dumps(r) for r in rows))
+
+    from deepdfa_tpu.data import ingest
+
+    df = ingest.ds("diversevul", cache=False)
+    assert list(df.columns) == [
+        "id", "dataset", "before", "target", "vul", "cwe", "message"
+    ]
+    assert df.vul.tolist() == [1, 0]
+    assert df.cwe.tolist() == ["CWE-787", ""]
+    assert df.message.tolist()[0] == "fix oob write"
+    # flows straight into the multitask encoder
+    ex = encode_multitask(
+        df.before.tolist(), df.vul.tolist(), TOK, 48,
+        cwes=df.cwe.tolist(), explanations=df.message.tolist(),
+        indices=df.id.tolist(),
+    )
+    assert len(ex) == 2 and ex.loss_mask.any()
+
+
+def test_finetune_presets():
+    p = FINETUNE_PRESETS["diversevul_multitask"]
+    assert p.dataset == "diversevul" and p.lora_rank == 16
+    assert FINETUNE_PRESETS["bigvul_multitask"].dataset == "bigvul"
+
+
+@pytest.mark.slow
+def test_multitask_lora_tuning_end_to_end(tmp_path):
+    """Adapters move, base stays frozen, loss finite — the config-#4 smoke."""
+    import flax.linen as nn
+
+    from deepdfa_tpu.llm.finetune import FinetuneConfig, LoraFinetuner
+    from deepdfa_tpu.llm.llama import LlamaForCausalLM, tiny_llama
+    from deepdfa_tpu.llm.lora import split_lora
+
+    cfg = tiny_llama(vocab_size=256, lora_rank=2)
+    model = LlamaForCausalLM(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.key(0), np.zeros((1, 32), np.int32))["params"]
+    )
+    ex = encode_multitask(
+        [f"int f{i}(int a) {{ return a + {i}; }}" for i in range(8)],
+        [i % 2 for i in range(8)], TOK, 32,
+        cwes=["CWE-787" if i % 2 else "" for i in range(8)],
+        explanations=["overflow" if i % 2 else "" for i in range(8)],
+    )
+    tuner = LoraFinetuner(model=model, cfg=FinetuneConfig(epochs=1, batch_size=4))
+    tuned, losses = tuner.train(params, ex)
+    assert np.isfinite(losses[0])
+    ad_before, base_before = split_lora(params)
+    ad_after, base_after = split_lora(tuned)
+    d_base = sum(
+        float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+        for a, b in zip(jax.tree.leaves(base_before), jax.tree.leaves(base_after))
+    )
+    d_ad = sum(
+        float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+        for a, b in zip(jax.tree.leaves(ad_before), jax.tree.leaves(ad_after))
+    )
+    assert d_base == 0.0, "base weights must stay frozen"
+    assert d_ad > 0.0, "adapters must train"
