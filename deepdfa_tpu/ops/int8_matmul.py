@@ -60,19 +60,16 @@ def _kernel(x_ref, q_ref, s_ref, o_ref):
         o_ref[:] = o_ref[:] * s_ref[:]
 
 
-@functools.partial(
-    jax.jit, static_argnames=("block_m", "block_n", "block_k", "out_dtype", "interpret")
-)
-def int8_matmul(
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _int8_matmul(
     x: jnp.ndarray,
     q: jnp.ndarray,
     scale: jnp.ndarray,
-    *,
-    block_m: int = 128,
-    block_n: int = 128,
-    block_k: int = 512,
-    out_dtype=jnp.bfloat16,
-    interpret: bool = False,
+    block_m: int,
+    block_n: int,
+    block_k: int,
+    out_dtype,
+    interpret: bool,
 ) -> jnp.ndarray:
     """``x[..., K] @ (q[K, N]·scale[N])`` with int8-resident weights.
 
@@ -114,3 +111,63 @@ def int8_matmul(
         interpret=interpret,
     )(xp, qp, sp)
     return out[:M, :N].astype(out_dtype).reshape(*lead, N)
+
+
+def _int8_matmul_fwd(x, q, scale, block_m, block_n, block_k, out_dtype, interpret):
+    out = _int8_matmul(x, q, scale, block_m, block_n, block_k, out_dtype, interpret)
+    # residuals must be JAX values — carry x's dtype as a 0-sized sentinel
+    return out, (jnp.zeros((0,), x.dtype), q, scale)
+
+
+def _int8_matmul_bwd(block_m, block_n, block_k, out_dtype, interpret, res, g):
+    """Activation gradient through the frozen int8 weight:
+
+        dx[..., K] = (g[..., N] * scale[N]) @ q[K, N]^T
+
+    computed in bf16 on the MXU (XLA dequantises q tiles on the fly — one
+    transient bf16 copy of the layer's weight, never materialised for the
+    whole model). The weight-side cotangents are ZERO by definition: int8
+    weights are the frozen base of a LoRA/QLoRA-style fine-tune (reference:
+    NF4 base + LoRA adapters, ``MSIVD/msivd/train.py:873-885``) — the
+    quantised representation is not meaningfully differentiable, and the
+    training paths (``bench_llm.py``, ``llm/joint.py``) take gradients only
+    w.r.t. adapter/head params, so these zeros are dead code XLA removes."""
+    import numpy as np
+
+    x_sentinel, q, scale = res
+    gs = (g.astype(jnp.float32) * scale.astype(jnp.float32)).astype(jnp.bfloat16)
+    dx = jnp.dot(
+        gs, q.T.astype(jnp.bfloat16), preferred_element_type=jnp.float32
+    ).astype(x_sentinel.dtype)
+    # integer primals take float0 cotangents (JAX's tangent space for ints)
+    dq = np.zeros(q.shape, jax.dtypes.float0)
+    return dx, dq, jnp.zeros_like(scale)
+
+
+_int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_m", "block_n", "block_k", "out_dtype", "interpret")
+)
+def int8_matmul(
+    x: jnp.ndarray,
+    q: jnp.ndarray,
+    scale: jnp.ndarray,
+    *,
+    block_m: int = 128,
+    block_n: int = 128,
+    block_k: int = 512,
+    out_dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """``x[..., K] @ (q[K, N] * scale[N])`` with int8-resident weights.
+
+    ``x``: bf16/f32 activations (leading dims flattened to M); ``q``: int8
+    weights; ``scale``: per-output-channel f32 (``QuantizedLeaf`` layout,
+    ``llm/quant.py``). ``interpret=True`` runs the kernel in Pallas
+    interpret mode (CPU tests). Differentiable w.r.t. ``x`` (custom VJP;
+    the int8 weight/scale are frozen-base params and get zero cotangents),
+    so LoRA adapters can train through an int8-resident stack."""
+    return _int8_matmul(x, q, scale, block_m, block_n, block_k,
+                        jnp.dtype(out_dtype), interpret)

@@ -66,6 +66,9 @@ def _randomize_params(params, seed: int):
         name = jax.tree_util.keystr(path)
         if "scale" in name:
             return (1.0 + 0.1 * jax.random.normal(key, leaf.shape, jnp.float32)) * 1e-2
+        if "norm" in name.lower():
+            return leaf  # RMSNorm weights init to ones — randomising them
+            # ~N(0,.02) would suppress every residual branch ~50x
         return (0.02 * jax.random.normal(key, leaf.shape, jnp.float32)).astype(leaf.dtype)
 
     flat = [fresh(p, l, k) for (p, l), k in zip(leaves, keys)]
