@@ -56,41 +56,6 @@ from bench import (  # shared protocol
 FULL_LAYERS = 32  # CodeLlama-7B
 
 
-def _randomize_int8_base(base_p, seed: int):
-    """Value-randomise the int8 leaves of a frozen base tree (Int8Dense.init
-    zeroes q/scale — zero weights give zero logits and a degenerate loss).
-    int8 uniform in [-127, 127], scales ~N(1, 0.1)*1e-2, float embeddings
-    ~N(0, 0.02); leaf-by-leaf on device, never an f32 copy of the weights."""
-    import jax
-    import jax.numpy as jnp
-
-    leaves = [
-        (p, v) for p, v in jax.tree_util.tree_leaves_with_path(
-            base_p, is_leaf=lambda v: v is None
-        )
-    ]
-    keys = jax.random.split(jax.random.key(seed), max(len(leaves), 1))
-
-    def fresh(path, leaf, key):
-        if leaf is None:
-            return None
-        if leaf.dtype == jnp.int8:
-            return jax.random.randint(
-                key, leaf.shape, -127, 128, jnp.int32
-            ).astype(jnp.int8)
-        name = jax.tree_util.keystr(path)
-        if "scale" in name:
-            return (1.0 + 0.1 * jax.random.normal(key, leaf.shape, jnp.float32)) * 1e-2
-        if "norm" in name.lower():
-            return leaf  # RMSNorm weights init to ones — keep (N(0,.02) here
-            # would suppress every residual branch ~50x and flatten the loss)
-        return (0.02 * jax.random.normal(key, leaf.shape, jnp.float32)).astype(leaf.dtype)
-
-    flat = [fresh(p, v, k) for (p, v), k in zip(leaves, keys)]
-    treedef = jax.tree_util.tree_structure(base_p, is_leaf=lambda v: v is None)
-    return jax.tree_util.tree_unflatten(treedef, flat)
-
-
 def build_step(cfg, batch: int, seq: int, seed: int = 0, measure_strict: bool = True):
     """(run_once, make_chained, flops, params_info): one jitted LoRA train
     step — causal-LM loss, grads/updates on the LoRA adapters only — plus a
@@ -116,7 +81,9 @@ def build_step(cfg, batch: int, seq: int, seed: int = 0, measure_strict: bool = 
     # through every layer into earlier adapters, as they must).
     lora_p, base_p = split_lora(params)
     if cfg.int8_runtime:
-        base_p = _randomize_int8_base(base_p, seed=seed + 7)
+        from deepdfa_tpu.llm.quant import randomize_int8_runtime_params
+
+        base_p = randomize_int8_runtime_params(base_p, seed=seed + 7)
 
     def combine(lora, base):
         return jax.tree.map(

@@ -139,6 +139,18 @@ class FusionModel(nn.Module):
     ) -> jnp.ndarray:
         embed = None
         if self.use_gnn:
+            # Fail with a nameable error instead of an opaque jit shape
+            # mismatch when the GraphJoin was built for the other layout
+            # (round-3 advisor finding): the batch TYPE is the layout.
+            is_dense_batch = isinstance(graphs, DenseBatch)
+            want_dense = self.gnn_cfg.layout == "dense"
+            if is_dense_batch != want_dense:
+                raise TypeError(
+                    f"FusionModel(layout={self.gnn_cfg.layout!r}) got a "
+                    f"{'dense' if is_dense_batch else 'segment'}-layout graph "
+                    "batch — construct GraphJoin with the same layout as "
+                    "fusion.gnn_cfg.layout"
+                )
             pooled = self.flowgnn_encoder(graphs)  # [max_graphs, out_dim]
             b = llm_hidden_states.shape[0]
             embed = pooled[:b]  # slot i belongs to example i (GraphJoin contract)

@@ -192,7 +192,7 @@ def make_joint_steps(
     ``{"fusion": ..., "llm": ...}`` and gradients flow through the encoder;
     the ``llm_params`` step argument is ignored (pass ``None``)."""
 
-    def hidden_states(llm_params, batch: JoinedBatch):
+    def hidden_states(llm_params, batch: JoinedBatch, dropout_rng=None):
         ids = jnp.asarray(batch.text.input_ids)
         # Explicit pad mask from the dataset (TextBatch.pad_mask): pads share
         # the eos id, so value-sniffing can't find them — the reference's
@@ -201,8 +201,19 @@ def make_joint_steps(
         # relative, so arange positions over a left-padded row preserve all
         # real-token distances (a uniform shift); the RoBERTa encoder builds
         # mask-aware absolute positions itself.
+        #
+        # ``dropout_rng`` (train_llm steps only): enables the encoder's HF
+        # training regularisation — RobertaEncoder reads hidden/attention
+        # dropout rates off its config; the frozen Llama path never uses
+        # dropout, matching the reference's frozen-LLM forward.
+        kwargs = {}
+        if dropout_rng is not None and hasattr(llm, "cfg") and hasattr(
+            llm.cfg, "hidden_dropout_prob"
+        ):
+            kwargs = {"deterministic": False, "rngs": {"dropout": dropout_rng}}
         return llm.apply(
-            {"params": llm_params}, ids, jnp.asarray(batch.text.pad_mask)
+            {"params": llm_params}, ids, jnp.asarray(batch.text.pad_mask),
+            **kwargs,
         )
 
     def loss_fn(params, llm_params, batch: JoinedBatch, rng):
@@ -210,7 +221,10 @@ def make_joint_steps(
             fusion_params, llm_params = params["fusion"], params["llm"]
         else:
             fusion_params = params
-        hidden = hidden_states(llm_params, batch)
+        rng, enc_rng = jax.random.split(rng)
+        hidden = hidden_states(
+            llm_params, batch, dropout_rng=enc_rng if train_llm else None
+        )
         logits = fusion.apply(
             {"params": fusion_params},
             hidden,

@@ -109,3 +109,38 @@ def tree_nbytes(params: Any) -> int:
         else:
             total += int(np.asarray(leaf).nbytes)
     return total
+
+
+def randomize_int8_runtime_params(params: Any, seed: int) -> Any:
+    """Value-randomise an int8-runtime param tree (for benchmarking:
+    ``Int8Dense.init`` zeroes q/scale, and zero weights give zero logits /
+    degenerate losses). int8 leaves go uniform in [-127, 127], per-channel
+    scales ~N(1, 0.1)*1e-2, float embeddings ~N(0, 0.02); RMSNorm weights
+    (path contains "norm") KEEP their ones-init — randomising them would
+    suppress every residual branch ~50x. Leaf-by-leaf on device, never an
+    f32 copy of the weights; ``None`` leaves (split LoRA/base trees) pass
+    through. Shared by ``bench_llm.py`` and ``scripts/bench_int8_llm.py`` so
+    the two int8 benches measure identically-initialised models."""
+    import jax
+
+    is_none = lambda v: v is None
+    leaves = jax.tree_util.tree_leaves_with_path(params, is_leaf=is_none)
+    keys = jax.random.split(jax.random.key(seed), max(len(leaves), 1))
+
+    def fresh(path, leaf, key):
+        if leaf is None:
+            return None
+        if leaf.dtype == jnp.int8:
+            return jax.random.randint(
+                key, leaf.shape, -127, 128, jnp.int32
+            ).astype(jnp.int8)
+        name = jax.tree_util.keystr(path)
+        if "scale" in name:
+            return (1.0 + 0.1 * jax.random.normal(key, leaf.shape, jnp.float32)) * 1e-2
+        if "norm" in name.lower():
+            return leaf
+        return (0.02 * jax.random.normal(key, leaf.shape, jnp.float32)).astype(leaf.dtype)
+
+    flat = [fresh(p, v, k) for (p, v), k in zip(leaves, keys)]
+    treedef = jax.tree_util.tree_structure(params, is_leaf=is_none)
+    return jax.tree_util.tree_unflatten(treedef, flat)

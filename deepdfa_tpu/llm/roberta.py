@@ -65,6 +65,11 @@ class RobertaConfig:
     type_vocab_size: int = 1
     layer_norm_eps: float = 1e-5
     pad_token_id: int = 1
+    # HF training regularisation (LineVul fine-tunes CodeBERT end-to-end
+    # with these at 0.1): applied only when a caller passes
+    # ``deterministic=False`` — inference/parity paths are unaffected
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
     dtype: str = "float32"  # bfloat16 on TPU; f32 for parity tests
 
     @property
@@ -135,7 +140,10 @@ class _SelfAttention(nn.Module):
     cfg: RobertaConfig
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, pad_mask: jnp.ndarray | None) -> jnp.ndarray:
+    def __call__(
+        self, x: jnp.ndarray, pad_mask: jnp.ndarray | None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         b, s, _ = x.shape
@@ -151,6 +159,8 @@ class _SelfAttention(nn.Module):
             bias = jnp.where(pad_mask[:, None, None, :], 0.0, -1e9)
             scores = scores + bias
         probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        probs = nn.Dropout(cfg.attention_probs_dropout_prob,
+                           deterministic=deterministic)(probs)
         out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         return out.reshape(b, s, h * d)
 
@@ -161,21 +171,28 @@ class _AttentionBlock(nn.Module):
     cfg: RobertaConfig
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, pad_mask: jnp.ndarray | None) -> jnp.ndarray:
-        attn = _SelfAttention(self.cfg, name="self")(x, pad_mask)
+    def __call__(
+        self, x: jnp.ndarray, pad_mask: jnp.ndarray | None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        attn = _SelfAttention(self.cfg, name="self")(x, pad_mask, deterministic)
         # HF nests output.dense + output.LayerNorm under attention.output —
         # the tree shape is attention/{self,output}/...
-        return _AttnOutput(self.cfg, name="output")(attn, x)
+        return _AttnOutput(self.cfg, name="output")(attn, x, deterministic)
 
 
 class _AttnOutput(nn.Module):
     cfg: RobertaConfig
 
     @nn.compact
-    def __call__(self, attn: jnp.ndarray, residual: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self, attn: jnp.ndarray, residual: jnp.ndarray,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         y = _dense(cfg.hidden_size, "heads", "embed", dtype, "dense")(attn)
+        y = nn.Dropout(cfg.hidden_dropout_prob, deterministic=deterministic)(y)
         return _layer_norm(cfg.layer_norm_eps)(y + residual).astype(dtype)
 
 
@@ -183,10 +200,14 @@ class _FFNOutput(nn.Module):
     cfg: RobertaConfig
 
     @nn.compact
-    def __call__(self, ff: jnp.ndarray, residual: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self, ff: jnp.ndarray, residual: jnp.ndarray,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         y = _dense(cfg.hidden_size, "mlp", "embed", dtype, "dense")(ff)
+        y = nn.Dropout(cfg.hidden_dropout_prob, deterministic=deterministic)(y)
         return _layer_norm(cfg.layer_norm_eps)(y + residual).astype(dtype)
 
 
@@ -206,15 +227,19 @@ class RobertaLayer(nn.Module):
     cfg: RobertaConfig
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, pad_mask: jnp.ndarray | None) -> jnp.ndarray:
-        x = _AttentionBlock(self.cfg, name="attention")(x, pad_mask)
+    def __call__(
+        self, x: jnp.ndarray, pad_mask: jnp.ndarray | None,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        x = _AttentionBlock(self.cfg, name="attention")(x, pad_mask, deterministic)
         ff = _Intermediate(self.cfg, name="intermediate")(x)
-        x = _FFNOutput(self.cfg, name="output")(ff, x)
+        x = _FFNOutput(self.cfg, name="output")(ff, x, deterministic)
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
 
 class _Embeddings(nn.Module):
     cfg: RobertaConfig
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
@@ -236,7 +261,9 @@ class _Embeddings(nn.Module):
         x = x + emb(cfg.type_vocab_size, "token_type_embeddings")(
             jnp.zeros_like(input_ids)
         )
-        return _layer_norm(cfg.layer_norm_eps)(x).astype(dtype)
+        x = _layer_norm(cfg.layer_norm_eps)(x).astype(dtype)
+        return nn.Dropout(cfg.hidden_dropout_prob,
+                          deterministic=self.deterministic)(x)
 
 
 class RobertaEncoder(nn.Module):
@@ -253,6 +280,7 @@ class RobertaEncoder(nn.Module):
         input_ids: jnp.ndarray,
         pad_mask: jnp.ndarray | None = None,
         positions: jnp.ndarray | None = None,
+        deterministic: bool = True,
     ) -> jnp.ndarray:
         cfg = self.cfg
         if positions is None:
@@ -263,10 +291,10 @@ class RobertaEncoder(nn.Module):
                 )
             else:
                 positions = roberta_position_ids(pad_mask, cfg.pad_token_id)
-        x = _Embeddings(cfg, name="embeddings")(input_ids, positions)
+        x = _Embeddings(cfg, deterministic, name="embeddings")(input_ids, positions)
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         for i in range(cfg.num_hidden_layers):
-            x = RobertaLayer(cfg, name=f"layer_{i}")(x, pad_mask)
+            x = RobertaLayer(cfg, name=f"layer_{i}")(x, pad_mask, deterministic)
         return x
 
 

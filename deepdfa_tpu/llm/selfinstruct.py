@@ -44,8 +44,15 @@ __all__ = [
 
 @dataclasses.dataclass(frozen=True)
 class DialogueRound:
+    """``prompt`` is the task instruction — NEVER truncated; ``context`` is
+    droppable material (the function body) that shrinks first when the
+    dialogue exceeds ``block_size``. Keeping them separate means a long
+    function can never silently delete the instruction and change the
+    supervised task format (round-4 advisor finding)."""
+
     prompt: str
     response: str
+    context: str = ""
 
 
 def multitask_rounds(
@@ -58,8 +65,9 @@ def multitask_rounds(
         DialogueRound(
             prompt=(
                 "Is the following C/C++ function vulnerable? "
-                "Answer yes or no.\n" + code + "\n"
+                "Answer yes or no.\n"
             ),
+            context=code + "\n",
             response="yes" if vul else "no",
         )
     ]
@@ -101,12 +109,17 @@ def _raw_ids(tokenizer, text: str) -> list[int]:
 def encode_dialogue(
     tokenizer, rounds: Sequence[DialogueRound], block_size: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """One training row: ``bos, p1, r1, eos, p2, r2, eos, ...`` left-padded
-    to ``block_size``; loss on response+eos tokens only. Over-long dialogues
-    shrink PROMPT segments (front-first — the code body is the first and
-    longest) until everything fits; responses — the supervised part — only
-    get truncated in the degenerate case where they alone exceed the block,
-    and then from the back, keeping every earlier answer whole."""
+    """One training row: ``bos, p1, c1, r1, eos, p2, r2, eos, ...``
+    left-padded to ``block_size``; loss on response+eos tokens only.
+    Over-long dialogues shrink CONTEXT segments only (the function body),
+    from the tail — the instruction prompts and every response stay whole,
+    so truncation can never change the supervised task format (the round-4
+    advisor caught the previous front-first prompt cut deleting the
+    'Answer yes or no.' instruction for exactly the long examples).
+    Tail-cut matches the reference's ``truncation=True`` keep-the-head
+    behavior (``MSIVD/msivd/train.py:196-208``). If instructions+responses
+    alone exceed the block, the degenerate back-truncation applies, keeping
+    every earlier answer whole."""
     bos = getattr(tokenizer, "bos_token_id", None)
     eos = tokenizer.eos_token_id
     # (tokens, graded, shrinkable) segments
@@ -114,7 +127,9 @@ def encode_dialogue(
     if bos is not None:
         segs.append(([bos], False, False))
     for r in rounds:
-        segs.append((_raw_ids(tokenizer, r.prompt), False, True))
+        segs.append((_raw_ids(tokenizer, r.prompt), False, False))
+        if r.context:
+            segs.append((_raw_ids(tokenizer, r.context), False, True))
         segs.append((_raw_ids(tokenizer, r.response) + [eos], True, False))
     overflow = sum(len(s[0]) for s in segs) - block_size
     if overflow > 0:
@@ -123,11 +138,11 @@ def encode_dialogue(
                 break
             if shrink:
                 cut = min(len(toks), overflow)
-                segs[i] = (toks[cut:], graded, shrink)
+                segs[i] = (toks[: len(toks) - cut], graded, shrink)
                 overflow -= cut
     ids = [t for toks, _, _ in segs for t in toks]
     loss = [graded for toks, graded, _ in segs for _ in toks]
-    if len(ids) > block_size:  # responses alone exceed the block
+    if len(ids) > block_size:  # instructions+responses alone exceed the block
         ids, loss = ids[:block_size], loss[:block_size]
     n = len(ids)
     row = np.full(block_size, eos, np.int32)

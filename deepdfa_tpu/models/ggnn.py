@@ -30,7 +30,9 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deepdfa_tpu.config import ALL_SUBKEYS, GGNNConfig
 from deepdfa_tpu.data.graphs import BatchedGraphs
@@ -96,6 +98,18 @@ class GatedGraphConv(nn.Module):
         self, h: jnp.ndarray, senders: jnp.ndarray, receivers: jnp.ndarray
     ) -> jnp.ndarray:
         n_nodes = h.shape[0]
+        # A false edges_sorted promise makes TPU segment reductions silently
+        # wrong; when running eagerly (tests, hand-built batches — concrete
+        # arrays, not tracers) verify it. Jitted callers (Trainer) pass
+        # batch_np output, whose contract is host-side receiver sort.
+        if self.edges_sorted and not isinstance(receivers, jax.core.Tracer):
+            r = np.asarray(receivers)
+            if r.size and np.any(np.diff(r) < 0):
+                raise ValueError(
+                    "edges_sorted=True but receivers are not sorted by "
+                    "receiver — pass edges_sorted=False for hand-built edge "
+                    "lists, or sort them (batch_np does this on the host)"
+                )
         if h.shape[-1] > self.out_feats:
             raise ValueError("in_feats must be <= out_feats (DGL contract)")
         if h.shape[-1] < self.out_feats:

@@ -73,8 +73,15 @@ class GatedGraphConvDense(nn.Module):
                 m = nn.sigmoid(msg)
                 tiny = jnp.finfo(jnp.float32).tiny
                 logs = jnp.log(jnp.maximum(1.0 - m, tiny).astype(jnp.float32))
-                prod = jnp.exp(
-                    jnp.einsum("gji,gjd->gid", adj.astype(jnp.float32), logs)
+                logsum = jnp.einsum("gji,gjd->gid", adj.astype(jnp.float32), logs)
+                # Exact-zero parity with the segment fold: a saturated
+                # message (σm == 1) zeroes the product there, while the
+                # log-space matmul bottoms out at exp(log(tiny)·k) ≈ 1e-38 —
+                # flush any sum at/below log(tiny) to a true 0 (a genuine
+                # product that small underflows to 0 anyway, so the flush
+                # only ever makes the result MORE accurate).
+                prod = jnp.where(
+                    logsum <= jnp.log(tiny), 0.0, jnp.exp(logsum)
                 ).astype(h.dtype)
                 agg = 1.0 - (1.0 - nn.sigmoid(h)) * prod
             h = gru(agg, h)

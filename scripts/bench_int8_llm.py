@@ -51,32 +51,6 @@ from bench import (  # noqa: E402  (shared protocol)
 FULL_LAYERS = 32  # CodeLlama-7B
 
 
-def _randomize_params(params, seed: int):
-    """Value-randomise an int8-runtime param tree in place of the zero init,
-    leaf by leaf on device (never materialises an f32 copy of the weights)."""
-    import jax
-    import jax.numpy as jnp
-
-    leaves = jax.tree_util.tree_leaves_with_path(params)
-    keys = jax.random.split(jax.random.key(seed), len(leaves))
-
-    def fresh(path, leaf, key):
-        if leaf.dtype == jnp.int8:
-            return jax.random.randint(key, leaf.shape, -127, 128, jnp.int32).astype(jnp.int8)
-        name = jax.tree_util.keystr(path)
-        if "scale" in name:
-            return (1.0 + 0.1 * jax.random.normal(key, leaf.shape, jnp.float32)) * 1e-2
-        if "norm" in name.lower():
-            return leaf  # RMSNorm weights init to ones — randomising them
-            # ~N(0,.02) would suppress every residual branch ~50x
-        return (0.02 * jax.random.normal(key, leaf.shape, jnp.float32)).astype(leaf.dtype)
-
-    flat = [fresh(p, l, k) for (p, l), k in zip(leaves, keys)]
-    return jax.tree_util.tree_unflatten(
-        jax.tree_util.tree_structure(params), flat
-    )
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=FULL_LAYERS)
@@ -109,8 +83,10 @@ def main():
     ids = jnp.asarray(rng.integers(3, cfg.vocab_size, (args.batch, args.seq)),
                       jnp.int32)
     _progress(f"initialising int8-resident params ({args.layers} layers) on device")
+    from deepdfa_tpu.llm.quant import randomize_int8_runtime_params
+
     params = jax.jit(lambda: model.init(jax.random.key(0), ids)["params"])()
-    params = _randomize_params(params, seed=1)
+    params = randomize_int8_runtime_params(params, seed=1)
     # leaf.nbytes sums device metadata — tree_nbytes would pull ~6.8 GB of
     # weights back through the tunnel just to count them
     weight_bytes = sum(l.nbytes for l in jax.tree.leaves(params))

@@ -111,16 +111,22 @@ def main(argv=None) -> dict:
     if args.no_flowgnn:
         updates["use_gnn"] = False
     jcfg = dataclasses.replace(jcfg, **updates)
-    if encoder_family == "roberta" and not args.preset and not args.hf_checkpoint:
-        from deepdfa_tpu.llm.roberta import tiny_roberta
-
-        # hermetic default: tiny CodeBERT-architecture encoder, LineVul mode;
-        # built AFTER overrides so the position table covers --block_size
-        # (+2: RoBERTa positions start at pad_token_id + 1)
-        llm_cfg = tiny_roberta(
-            vocab_size=2048, max_position_embeddings=jcfg.block_size + 4
-        )
+    if encoder_family == "roberta":
+        # LineVul fine-tunes CodeBERT end-to-end in EVERY configuration —
+        # train_llm applies regardless of where the weights came from (the
+        # r04 advisor caught --hf-checkpoint without --preset silently
+        # running the encoder frozen, unlike the hermetic default and the
+        # linevul presets, which also set it)
         jcfg = dataclasses.replace(jcfg, train_llm=True)
+        if not args.preset and not args.hf_checkpoint:
+            from deepdfa_tpu.llm.roberta import tiny_roberta
+
+            # hermetic default: tiny CodeBERT-architecture encoder, LineVul
+            # mode; built AFTER overrides so the position table covers
+            # --block_size (+2: RoBERTa positions start at pad_token_id + 1)
+            llm_cfg = tiny_roberta(
+                vocab_size=2048, max_position_embeddings=jcfg.block_size + 4
+            )
     if args.freeze_graph:
         if not jcfg.use_gnn:
             raise SystemExit(

@@ -32,20 +32,26 @@ def test_tiny_emits_valid_json_line():
 
 
 def test_randomize_params_respects_dtypes():
-    import jax
+    # Shared randomizer (deepdfa_tpu.llm.quant): dtypes preserved, int8
+    # nonzero, scales ~1e-2, norm weights KEPT at init, None passthrough.
     import jax.numpy as jnp
 
-    sys.path.insert(0, str(REPO / "scripts"))
-    from bench_int8_llm import _randomize_params
+    from deepdfa_tpu.llm.quant import randomize_int8_runtime_params
 
     tree = {
         "q": jnp.zeros((4, 8), jnp.int8),
         "scale": jnp.ones((8,), jnp.float32),
         "embedding": jnp.zeros((16, 4), jnp.bfloat16),
+        "input_layernorm": {"weight": jnp.ones((4,), jnp.float32)},
+        "lora_a": None,
     }
-    out = _randomize_params(tree, seed=0)
+    out = randomize_int8_runtime_params(tree, seed=0)
     assert out["q"].dtype == jnp.int8 and int(jnp.abs(out["q"]).max()) > 0
     assert out["scale"].dtype == jnp.float32
     assert float(jnp.abs(out["scale"]).max()) < 1.0  # ~1e-2 magnitudes
     assert out["embedding"].dtype == jnp.bfloat16
     assert float(jnp.abs(out["embedding"]).max()) > 0
+    # RMSNorm weights keep their ones-init (randomising them suppresses
+    # every residual branch ~50x)
+    assert bool(jnp.all(out["input_layernorm"]["weight"] == 1.0))
+    assert out["lora_a"] is None

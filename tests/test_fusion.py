@@ -495,3 +495,32 @@ def test_graph_join_layout_whitelist():
     graphs = random_dataset(2, seed=5, input_dim=INPUT_DIM, mean_nodes=6)
     with pytest.raises(ValueError, match="unknown layout"):
         GraphJoin.from_list(graphs, layout="Dense")
+
+
+def test_fusion_layout_mismatch_raises_nameable_error():
+    """r03 advisor: GraphJoin(layout=dense) fed to FusionModel(layout=segment)
+    used to surface as an opaque jit shape error — now a TypeError naming
+    both layouts, raised before tracing."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from deepdfa_tpu.llm.dataset import GraphJoin, TextBatch
+    from deepdfa_tpu.llm.fusion import FusionModel
+
+    graphs = random_dataset(3, seed=6, input_dim=INPUT_DIM, mean_nodes=6)
+    join = GraphJoin.from_list(graphs, layout="dense")
+    tb = TextBatch(
+        input_ids=np.zeros((2, 8), np.int32),
+        labels=np.zeros(2, np.int32),
+        indices=np.array([0, 1]),
+        mask=np.ones(2, bool),
+        pad_mask=np.ones((2, 8), bool),
+    )
+    jb = join.join(tb)
+    cfg = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2,
+                     layout="segment", encoder_mode=True, label_style="graph")
+    fusion = FusionModel(gnn_cfg=cfg, input_dim=INPUT_DIM, llm_hidden_size=16)
+    hidden = jnp.zeros((2, 8, 16), jnp.float32)
+    with pytest.raises(TypeError, match="dense.*layout|layout.*dense"):
+        fusion.init(jax.random.key(0), hidden, jb.graphs)

@@ -147,3 +147,24 @@ def test_union_aggregation_trains_dfa_labels():
             state, _m, loss, _w = step(state, batch, ConfusionState.zeros())
             losses.append(float(loss))
         assert losses[-1] < losses[0], (agg, losses[0], losses[-1])
+
+
+def test_edges_sorted_false_promise_caught_eagerly():
+    """r03 advisor: edges_sorted=True with hand-built UNSORTED receivers
+    silently corrupted segment sums. Running eagerly (concrete arrays), the
+    layer now rejects the false promise instead of computing garbage."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from deepdfa_tpu.models.ggnn import GatedGraphConv
+
+    conv = GatedGraphConv(out_feats=8, n_steps=1)
+    h = jnp.ones((4, 8), jnp.float32)
+    senders = jnp.array([0, 1, 2, 3])
+    receivers = jnp.array([3, 1, 2, 0])  # NOT sorted
+    with pytest.raises(ValueError, match="edges_sorted"):
+        conv.init(jax.random.key(0), h, senders, receivers)
+    # the honest flag works
+    conv_ok = GatedGraphConv(out_feats=8, n_steps=1, edges_sorted=False)
+    conv_ok.init(jax.random.key(0), h, senders, receivers)

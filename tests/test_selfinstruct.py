@@ -60,6 +60,35 @@ def test_encode_dialogue_truncation_preserves_responses():
     assert n_graded == expect
 
 
+def test_encode_dialogue_truncation_preserves_instruction():
+    """Round-4 advisor fix: the detection instruction must survive however
+    long the function body is — only the code CONTEXT shrinks (from the
+    tail, the reference's keep-the-head truncation), so the supervised task
+    format is identical for short and long examples."""
+    long_code = "int f(){" + "".join(f" var{i}qq = {i};" for i in range(300)) + "}"
+    rounds = multitask_rounds(long_code, 1, "CWE-787", "overflow")
+    instr_ids = TOK.encode_raw(rounds[0].prompt)
+    code_ids = TOK.encode_raw(rounds[0].context)
+    ids, pad, lm = encode_dialogue(TOK, rounds, block_size=64)
+    real = ids[pad].tolist()
+    # the full instruction token run appears intact in the packed row
+    def contains(hay, needle):
+        return any(hay[i:i + len(needle)] == needle
+                   for i in range(len(hay) - len(needle) + 1))
+    assert contains(real, instr_ids), "instruction tokens were truncated"
+    # the code context was cut from the TAIL: its head tokens directly
+    # follow the instruction
+    keep = code_ids[: 8]
+    assert contains(real, instr_ids + keep), "code head did not survive"
+    # and ungraded: instruction+context carry no loss
+    n_graded = int(lm.sum())
+    expect = (
+        len(TOK.encode_raw("yes")) + len(TOK.encode_raw("CWE-787"))
+        + len(TOK.encode_raw("overflow")) + 3
+    )
+    assert n_graded == expect
+
+
 def test_encode_multitask_batch():
     ex = encode_multitask(
         ["int a(){}", "int b(){}"], [1, 0], TOK, 32,
