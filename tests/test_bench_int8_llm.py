@@ -7,10 +7,12 @@ import os
 import subprocess
 import sys
 from pathlib import Path
+import pytest
 
 REPO = Path(__file__).resolve().parent.parent
 
 
+@pytest.mark.slow
 def test_tiny_emits_valid_json_line():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)

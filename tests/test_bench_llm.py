@@ -7,8 +7,10 @@ interpret mode on CPU."""
 import dataclasses
 
 import numpy as np
+import pytest
 
 
+@pytest.mark.slow
 def test_build_step_int8_base_runs_and_counts_flops():
     from deepdfa_tpu.llm.llama import tiny_llama
 
@@ -30,6 +32,7 @@ def test_build_step_int8_base_runs_and_counts_flops():
     assert cf is None or cf > 0
 
 
+@pytest.mark.slow
 def test_build_step_skips_strict_compile_when_asked():
     from deepdfa_tpu.llm.llama import tiny_llama
 

@@ -101,6 +101,7 @@ def _ggnn_params(seed=0, encoder=False):
     return model, model.init(jax.random.key(seed), batch)["params"], batch
 
 
+@pytest.mark.slow
 def test_is_head_key_matches_param_tree():
     _model, params, _ = _ggnn_params()
     keys = set(params)
@@ -112,6 +113,7 @@ def test_is_head_key_matches_param_tree():
     assert any(not is_head_key(k) for k in keys)
 
 
+@pytest.mark.slow
 def test_encoder_partial_load_and_freeze():
     _m1, trained, _ = _ggnn_params(seed=1)
     _m2, fresh, _ = _ggnn_params(seed=2)

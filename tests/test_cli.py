@@ -29,6 +29,7 @@ def storage(tmp_path, monkeypatch):
     return tmp_path
 
 
+@pytest.mark.slow
 def test_fit_then_test_and_profile(storage, tmp_path):
     run_dir = tmp_path / "run"
     out = cli.main(["fit", "--run-dir", str(run_dir), *SMALL])
@@ -52,6 +53,7 @@ def test_fit_then_test_and_profile(storage, tmp_path):
     assert res["profile_ms_per_example"] > 0
 
 
+@pytest.mark.slow
 def test_dense_layout_fit_test_and_checkpoint_interchange(storage, tmp_path):
     """model.layout=dense drives fit/test end-to-end, and a dense-trained
     checkpoint restores into a segment-layout test run (shared param tree)."""
@@ -75,6 +77,7 @@ def test_dense_layout_fit_test_and_checkpoint_interchange(storage, tmp_path):
     assert abs(res_seg["test_F1Score"] - res["test_F1Score"]) < 0.05
 
 
+@pytest.mark.slow
 def test_dense_layout_scores_every_graph(storage, tmp_path):
     """Eval completeness (r03 verdict): with a node budget small enough that
     part of the corpus exceeds the dense per-graph cap, the oversize graphs
@@ -104,6 +107,7 @@ def test_dense_layout_scores_every_graph(storage, tmp_path):
     assert np.isfinite(res["test_F1Score"])
 
 
+@pytest.mark.slow
 def test_segment_layout_scores_every_graph(storage, tmp_path):
     """The oversize rescue route is layout-generic: a segment-layout run with
     a bucket smaller than the corpus tail must still score every test graph
@@ -126,6 +130,7 @@ def test_segment_layout_scores_every_graph(storage, tmp_path):
     assert res["n_dropped"] == 0
 
 
+@pytest.mark.slow
 def test_dense_layout_node_style_ranking(storage, tmp_path):
     run_dir = tmp_path / "run_dense_node"
     overrides = [*SMALL, "--set", "model.layout=dense",
@@ -181,6 +186,7 @@ def test_crash_renames_log(storage, tmp_path, monkeypatch):
     assert not (run_dir / "run.log").exists()
 
 
+@pytest.mark.slow
 def test_node_style_statement_ranking(storage, tmp_path):
     """label_style=node test runs emit IVDetect top-k statement hit rates."""
     run_dir = tmp_path / "noderun"
@@ -192,6 +198,7 @@ def test_node_style_statement_ranking(storage, tmp_path):
     assert 0.0 <= out["statement_hit@1"] <= out["statement_hit@10"] <= 1.0
 
 
+@pytest.mark.slow
 def test_trace_capture(storage, tmp_path):
     """--set trace=true writes a jax.profiler device trace during test."""
     run_dir = tmp_path / "tracerun"

@@ -113,6 +113,7 @@ def test_bigvul_full_msr_schema(tmp_path, monkeypatch):
     assert len(out[out.vul == 0]) == 7
 
 
+@pytest.mark.slow
 def test_hf_checkpoint_dir_roundtrip(tmp_path):
     """save_pretrained → load_hf_config/load_hf_checkpoint → logits parity →
     generate. Exercises the on-disk safetensors + config.json format, not an

@@ -19,6 +19,7 @@ from deepdfa_tpu.parallel.dp import (
 from deepdfa_tpu.parallel.mesh import local_mesh
 from deepdfa_tpu.train.loop import Trainer
 from deepdfa_tpu.train.metrics import ConfusionState, compute_metrics
+import pytest
 
 CFG = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2)
 INPUT_DIM = 40
@@ -35,6 +36,7 @@ def make_stacks(n_dp, n_batches=2, seed=0):
     return stacks, flat
 
 
+@pytest.mark.slow
 def test_dp_matches_single_device():
     mesh = local_mesh(8)
     model = GGNN(cfg=CFG, input_dim=INPUT_DIM)
@@ -85,6 +87,7 @@ def test_dp_matches_single_device():
         np.testing.assert_allclose(va, vb, atol=1e-5, err_msg=ka)
 
 
+@pytest.mark.slow
 def test_dp_eval_metrics_match_flat():
     mesh = local_mesh(8)
     model = GGNN(cfg=CFG, input_dim=INPUT_DIM)
@@ -119,6 +122,7 @@ def test_stack_batches_rejects_mixed_buckets():
         stack_batches([flat[0], other])
 
 
+@pytest.mark.slow
 def test_dp_dense_layout():
     """The dp machinery (shard_map + psum) drives the dense-adjacency forward
     unchanged — same stack/pspec plumbing, layout-polymorphic labels."""

@@ -43,6 +43,7 @@ def test_lm_loss_masks_padding():
     assert float(zero) == 0.0
 
 
+@pytest.mark.slow
 def test_only_lora_params_move(setup):
     model, params, tuner, examples, tuned, losses = setup
     assert losses[-1] < losses[0]  # memorisable corpus

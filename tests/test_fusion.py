@@ -181,6 +181,7 @@ def test_pool_tokens_last_real_token():
     )
 
 
+@pytest.mark.slow
 def test_llm_branch_not_constant_across_inputs():
     """Regression: the pooled LLM feature must differ between two different
     functions (the slot-0 read under padding was bit-identical)."""
@@ -287,6 +288,7 @@ def joint_setup(tmp_path_factory):
     return trainer, examples, state
 
 
+@pytest.mark.slow
 def test_joint_training_learns(joint_setup):
     trainer, examples, state = joint_setup
     assert state is not None
@@ -298,6 +300,7 @@ def test_joint_training_learns(joint_setup):
     assert evals and "eval_f1_macro" in evals[0]
 
 
+@pytest.mark.slow
 def test_joint_test_report(joint_setup):
     trainer, examples, state = joint_setup
     out = trainer.test(state.params, examples)
@@ -315,6 +318,7 @@ def test_joint_checkpoint_roundtrip(joint_setup):
     assert trainer.num_missing == 0
 
 
+@pytest.mark.slow
 def test_joint_resume_on_fresh_trainer(joint_setup):
     """Passing a resumed state to a trainer that never built its steps must
     work (ADVICE r1: _build was skipped when state was supplied)."""
@@ -336,6 +340,7 @@ def test_joint_resume_on_fresh_trainer(joint_setup):
     assert int(resumed.step) > int(state.step)
 
 
+@pytest.mark.slow
 def test_joint_no_flowgnn_mode():
     """--no_flowgnn presets: LLM-only head, no graphs anywhere."""
     import jax
@@ -386,6 +391,7 @@ def test_presets_cover_reference_launch_scripts():
         assert PRESETS[name].joint.use_gnn is False  # --no_flowgnn parity
 
 
+@pytest.mark.slow
 def test_fusion_dense_layout_parity():
     """FusionModel with a dense-layout encoder matches the segment-layout
     encoder on SHARED parameters (one tree, two forwards), and GraphJoin

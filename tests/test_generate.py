@@ -27,6 +27,7 @@ def _prompts(b=2, s=8, pad_rows=(3, 0), vocab=320, seed=0):
     return ids, mask
 
 
+@pytest.mark.slow
 def test_greedy_matches_stepwise_full_forward(lm):
     """Greedy generation must equal repeatedly running the full (non-cached)
     forward and taking argmax of the last real position."""
@@ -60,6 +61,7 @@ def test_eos_stops_and_pads(lm):
             assert (row[hits[0] :] == 2).all()
 
 
+@pytest.mark.slow
 def test_sampling_is_seed_deterministic(lm):
     model, params = lm
     ids, mask = _prompts()

@@ -109,6 +109,7 @@ def test_duplicate_edges_accumulate_like_segments():
     np.testing.assert_allclose(out_d[:1], out_s[:1], rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_trainer_drives_dense_layout():
     """The Trainer is layout-polymorphic: same config, same step functions,
     dense batches — loss parity with the segment layout on shared params at

@@ -12,6 +12,7 @@ from deepdfa_tpu.config import ALL_SUBKEYS, GGNNConfig
 from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
 from deepdfa_tpu.data.synthetic import random_dataset
 from deepdfa_tpu.models.ggnn import GGNN
+import pytest
 
 INPUT_DIM = 50
 
@@ -106,6 +107,7 @@ def test_padding_invariance():
     np.testing.assert_allclose(outs[0], outs[1], atol=1e-5)
 
 
+@pytest.mark.slow
 def test_union_aggregation_trains_dfa_labels():
     """GGNN with the differentiable-union aggregator (the DFA-lattice
     experiment, clipper.py:50-77): forward is finite and in-range, and the

@@ -13,6 +13,8 @@ from pathlib import Path
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 GOLDEN = json.loads(
     (Path(__file__).parent.parent / "configs" / "golden_quality.json").read_text()
 )

@@ -108,6 +108,7 @@ def test_activation_gradient_matches_dequantized_reference():
     )
 
 
+@pytest.mark.slow
 def test_lora_trains_through_int8_base():
     """End-to-end: tiny int8_runtime Llama with LoRA — grads w.r.t. the LoRA
     subtree are finite and nonzero through every int8 projection."""
@@ -188,6 +189,7 @@ def test_llama_int8_runtime_logits_parity():
     assert agree > 0.9, agree
 
 
+@pytest.mark.slow
 def test_llama_int8_runtime_param_shapes_match_conversion():
     """init-time shapes of the int8 model equal the converted checkpoint's,
     so orbax restore round-trips."""

@@ -82,6 +82,7 @@ def test_left_padded_parity_with_hf(hf_and_params):
     )
 
 
+@pytest.mark.slow
 def test_tp_sharded_forward_matches_single(hf_and_params):
     _, params = hf_and_params
     mesh = local_mesh(8, dp=2, tp=4)
@@ -97,6 +98,7 @@ def test_tp_sharded_forward_matches_single(hf_and_params):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_model_matches_full():
     cfg_full = tiny_llama()
     mesh = local_mesh(8, dp=2, sp=4)
@@ -144,6 +146,7 @@ def test_lora_init_is_noop_and_merge_matches():
     assert other and not any(other)
 
 
+@pytest.mark.slow
 def test_decode_cache_matches_full_forward():
     cfg = tiny_llama(max_position_embeddings=32)
     ids = np.random.default_rng(6).integers(0, cfg.vocab_size, (2, 7))
@@ -173,6 +176,7 @@ def test_decode_cache_matches_full_forward():
     )
 
 
+@pytest.mark.slow
 def test_decode_cache_respects_left_padding():
     """Padded prompt tokens must never contribute to the cache attention:
     decoding a left-padded batch must match the full forward with the same

@@ -11,6 +11,7 @@ from pathlib import Path
 import numpy as np
 
 from deepdfa_tpu.data.codegen import demo_corpus, generate_function
+import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
 
@@ -43,6 +44,7 @@ def test_demo_corpus_balance():
     assert df.before.equals(df2.before)
 
 
+@pytest.mark.slow
 def test_preprocess_to_training(tmp_path, monkeypatch):
     """preprocess.py --dataset demo → shards the CLI trains on; the defect is
     learnable through the REAL feature pipeline (vul strlen-def vs clamped
@@ -85,6 +87,7 @@ def test_preprocess_to_training(tmp_path, monkeypatch):
     assert json.loads(tuning[-1])["final"] is True
 
 
+@pytest.mark.slow
 def test_train_joint_cli(tmp_path, monkeypatch):
     """scripts/train_joint.py: preprocess shards -> joint train/test through
     the command surface (hermetic tiny model + hash tokenizer)."""
@@ -121,6 +124,7 @@ def test_train_joint_cli(tmp_path, monkeypatch):
     assert "test_f1_weighted" in out3 and np.isfinite(out3["test_loss"])
 
 
+@pytest.mark.slow
 def test_dataflow_label_training(tmp_path, monkeypatch):
     """The 'learn the DFA' loop: solver-solution labels materialise and the
     GGNN trains on label_style=dataflow_solution_out (the reference snapshot

@@ -32,6 +32,7 @@ def test_ring_matches_full(causal, sp):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_matches_full_gqa():
     mesh = local_mesh(8, dp=2, sp=4)
     q, k, v = _qkv(h=8, h_kv=2)
@@ -61,6 +62,7 @@ def test_ring_with_padding_mask():
     )
 
 
+@pytest.mark.slow
 def test_ring_bf16_inputs():
     mesh = local_mesh(4, dp=2, sp=2)
     q, k, v = _qkv()

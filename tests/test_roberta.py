@@ -128,6 +128,7 @@ def test_cls_pool_left_pad():
     np.testing.assert_allclose(np.asarray(got[1]), np.asarray(feats[1, 0]))
 
 
+@pytest.mark.slow
 def test_linevul_fusion_training_mode():
     """LineVul-combined (config #3b): encoder fine-tunes, GGNN stays frozen,
     loss is finite and the jitted step runs end-to-end."""

@@ -8,6 +8,8 @@ from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
 from deepdfa_tpu.data.sampler import epoch_indices, positive_weight
 from deepdfa_tpu.data.synthetic import random_dataset
 from deepdfa_tpu.models.ggnn import GGNN
+import pytest
+
 from deepdfa_tpu.train.loop import (
     Trainer,
     bce_with_logits,
@@ -67,6 +69,7 @@ def test_bce_weights_exclude_padding():
     assert abs(full - masked) < 1e-6
 
 
+@pytest.mark.slow
 def test_train_epoch_converges_and_finite():
     cfg = small_cfg()
     graphs = random_dataset(96, seed=2, input_dim=cfg.input_dim, vul_rate=0.25)
@@ -84,6 +87,7 @@ def test_train_epoch_converges_and_finite():
     assert 0.0 <= metrics["train_F1Score"] <= 1.0
 
 
+@pytest.mark.slow
 def test_node_label_style_runs():
     cfg = ExperimentConfig(
         model=GGNNConfig(label_style="node", **SMALL),
@@ -106,6 +110,7 @@ def test_extract_labels_node_masks_padding():
     assert float(weights[n_real:].sum()) == 0.0
 
 
+@pytest.mark.slow
 def test_weighted_epoch_loss_is_per_example():
     """A ragged final batch must not be over-weighted in the epoch mean."""
     cfg = small_cfg()
