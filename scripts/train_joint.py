@@ -275,7 +275,10 @@ def main(argv=None) -> dict:
         out["freeze_graph"] = str(args.freeze_graph)
     if args.do_train:
         state = trainer.train(train_ex, eval_ex, state=state)
-        out["history"] = trainer.history[-3:]
+        # full history: the recorded artifact must show the learning curve,
+        # not just the final epoch (VERDICT r04 weak #3 — a demo that only
+        # proves execution is empty evidence)
+        out["history"] = trainer.history
         out["num_missing"] = trainer.num_missing
     if args.do_test:
         if state is not None:
