@@ -31,7 +31,10 @@ import pandas as pd
 
 from deepdfa_tpu.cpg.schema import CPG
 
-__all__ = ["load_tables", "load_cpg", "load_dataflow", "JoernRunner"]
+__all__ = [
+    "load_tables", "load_cpg", "load_dataflow", "reexport_dataflow",
+    "JoernRunner",
+]
 
 NODE_COLUMNS = [
     "id", "_label", "name", "code", "lineNumber", "columnNumber",
@@ -101,6 +104,84 @@ def load_dataflow(path: str | Path) -> dict:
     return out
 
 
+def reexport_dataflow(stem: str | Path, cache: bool = True) -> Path:
+    """Summary-cached dataflow RE-export, native solver edition (capability
+    parity with ``DDFA/storage/external/get_dataflow_output.sc:26-75``):
+    re-run reaching definitions over the CACHED extraction artifacts
+    (``{stem}.nodes.json``/``.edges.json`` — no re-extraction, no JVM) and
+    (re)write ``{stem}.dataflow.json`` in the reference schema.
+
+    Cache contract mirrors the reference script: if
+    ``{stem}.dataflow.summary.json`` exists and ``cache=True`` the call is a
+    no-op. On a successful re-solve the summary marker is written too (the
+    reference checks the marker but never writes it — a permanently cold
+    cache; writing it is the evident intent). ``cache=False`` forces the
+    re-solve. The Joern-path twin is
+    ``deepdfa_tpu/cpg/queries/reexport_dataflow.sc``.
+    """
+    from deepdfa_tpu.cpg.dataflow import ReachingDefinitions
+
+    stem = str(stem)
+    out_path = Path(stem + ".dataflow.json")
+    summary_path = Path(stem + ".dataflow.summary.json")
+    if cache and summary_path.exists():
+        return out_path
+
+    cpg = load_cpg(stem)
+    rd = ReachingDefinitions(cpg)
+    in_sets, out_sets = rd.solve()
+    methods = [
+        n for n in cpg.nodes.values()
+        if n.label == "METHOD" and n.name not in ("<global>", "<empty>", "")
+    ]
+
+    def ast_descendants(root: int) -> set[int]:
+        seen, work = {root}, [root]
+        while work:
+            for c in cpg.successors(work.pop(), "AST"):
+                if c not in seen:
+                    seen.add(c)
+                    work.append(c)
+        return seen
+
+    # per-method sets, like the Joern twin's per-method ReachingDefProblem:
+    # restrict keys to the method's AST subtree (a multi-method artifact
+    # must not attribute one function's definitions to another)
+    member: dict[str, set[int]] | None = None
+    if len(methods) > 1:
+        member = {m.name: ast_descendants(m.id) for m in methods}
+
+    def node_sets(
+        sets_by_node: dict[int, set], keep: set[int] | None
+    ) -> dict[str, list[int]]:
+        return {
+            str(n): sorted(d.node for d in s)
+            for n, s in sorted(sets_by_node.items())
+            if keep is None or n in keep
+        }
+
+    gen = {n: s for n, s in rd.gen.items() if s}
+    kill = {n: rd.kill(n, rd.domain) for n in gen}
+    per_method = {}
+    for m in methods or [None]:
+        name = m.name if m is not None else Path(stem).stem
+        keep = member.get(name) if (member and m is not None) else None
+        per_method[name] = {
+            "problem.gen": node_sets(gen, keep),
+            "problem.kill": node_sets(kill, keep),
+            "solution.in": node_sets(in_sets, keep),
+            "solution.out": node_sets(out_sets, keep),
+        }
+    out_path.write_text(json.dumps(per_method))
+    summary_path.write_text(json.dumps({
+        "methods": len(per_method),
+        "solved_nodes": {k: len(v["solution.in"]) for k, v in per_method.items()},
+        "domain_size": len(rd.domain),
+        "solver": "native",
+    }))
+    return out_path
+
+
 class JoernRunner:
     """Batch runner for a local joern install (optional path).
 
@@ -137,3 +218,24 @@ class JoernRunner:
             capture_output=True,
         )
         return c_file
+
+    def reexport_dataflow(self, c_file: str | Path, cache: bool = True,
+                          timeout: int = 600) -> Path:
+        """JVM-path summary-cached re-solve over the cached ``.cpg.bin``
+        (``queries/reexport_dataflow.sc``; reference:
+        ``get_dataflow_output.sc:26-75``). Prefer the module-level
+        :func:`reexport_dataflow` (native solver, no JVM) unless Joern's own
+        solver output is specifically required."""
+        if not self.available:
+            raise RuntimeError(
+                f"joern binary {self.joern_bin!r} not on PATH; use the native "
+                "reexport_dataflow (deepdfa_tpu.cpg.joern) instead"
+            )
+        stem = str(Path(c_file))
+        script = Path(__file__).parent / "queries" / "reexport_dataflow.sc"
+        params = f"filename={stem},cache={'true' if cache else 'false'}"
+        subprocess.run(
+            [self.joern_bin, "--script", str(script), "--params", params],
+            check=True, timeout=timeout, capture_output=True,
+        )
+        return Path(stem + ".dataflow.json")

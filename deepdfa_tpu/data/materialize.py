@@ -169,6 +169,10 @@ class CorpusBuilder:
         """Full pipeline; returns (graphs, vocabs). Graphs with no CFG are
         dropped (counted by comparing lengths)."""
         hash_df = self.extract(cpgs, raise_all=raise_all)
+        # kept for the coverage analyzer (train/cli.py variant_coverage):
+        # scripts/preprocess.py persists it as hashes.parquet so `analyze`
+        # can rebuild the limit_all x subkey vocab grid without re-extraction
+        self.hash_df = hash_df
         vocabs = self.vocabs(hash_df, train_ids)
         by_graph: dict[int, dict[int, str]] = {}
         for row in hash_df.itertuples(index=False):

@@ -70,7 +70,12 @@ class Vocabulary:
         (``dbize_absdf.py:34-43``)."""
         if hash_json is None:
             return 0
-        combined = self.combined_hash(json.loads(hash_json))
+        return self.feature_id_from_dict(json.loads(hash_json))
+
+    def feature_id_from_dict(self, hash_dict: Mapping[str, list]) -> int:
+        """:meth:`feature_id` for an already-parsed hash (bulk callers —
+        the coverage grid — parse each hash once, not once per variant)."""
+        combined = self.combined_hash(hash_dict)
         return self.all_vocab.get(combined, 0) + 1
 
     @property
@@ -96,7 +101,8 @@ def build_vocab(
     """
     train_ids = set(int(i) for i in train_ids)
     df = hash_df.copy()
-    df["hash_dict"] = df["hash"].apply(json.loads)
+    if "hash_dict" not in df.columns:  # bulk callers may pre-parse once
+        df["hash_dict"] = df["hash"].apply(json.loads)
     train = df[df.graph_id.isin(train_ids)]
 
     subkey_vocabs: dict[str, dict[str, int]] = {}

@@ -518,36 +518,194 @@ def test(
     return results
 
 
-def coverage(graphs: list[Graph], feat: str = "_ABS_DATAFLOW") -> dict[str, float]:
-    """Feature coverage statistics for one split (``get_coverage``,
-    ``main_cli.py:192-313``): how many nodes are definitions, how many of
-    those fell off the train vocab (UNKNOWN), label balance."""
-    n_nodes = n_defs = n_unknown = n_vul_nodes = n_vul_graphs = 0
+# dbize_absdf.py:21-45's feature-variant grid: limit_all values x single
+# subkeys (the reference materialises 28 nodes_feat_* variants and its
+# analyzer reports coverage for whichever is configured; `analyze` here
+# reports the whole grid in one pass)
+COVERAGE_GRID_LIMITS = (1, 10, 100, 500, 1000, 5000, 10000)
+
+
+def coverage(graphs: list[Graph], feat: str = "_ABS_DATAFLOW") -> dict:
+    """Feature + dataflow-solution coverage statistics for one split — full
+    parity with the reference's per-dataset printout (``get_coverage``,
+    ``main_cli.py:192-313``): per-graph def/known/unknown/nodef counts
+    aggregated micro (token-weighted) and macro (graph-weighted), the
+    graphs-without-defs and has-unknown counts, and — when the shards carry
+    the RD solution bits (``--dataflow-labels`` preprocessing) — the
+    solution-proportion stats over all nodes and over definition nodes
+    (with the NaN accounting for def-free graphs, ``main_cli.py:298-313``)."""
+    defs, known, unknown, nodef, nodes = [], [], [], [], []
+    vul_nodes = vul_graphs = 0
+    skipped_feat = skipped_sol = 0
+    prop, prop_nz = [], []
     for g in graphs:
-        ids = g.node_feats[feat]
-        n_nodes += ids.size
-        n_defs += int((ids != 0).sum())
-        n_unknown += int((ids == 1).sum())
-        n_vul_nodes += int(g.node_feats["_VULN"].sum())
-        n_vul_graphs += int(g.node_feats["_VULN"].max() > 0)
-    return {
+        vul_nodes += int(g.node_feats["_VULN"].sum())
+        vul_graphs += int(g.node_feats["_VULN"].max() > 0)
+        ids = g.node_feats.get(feat)
+        if ids is None:
+            skipped_feat += 1
+            continue
+        nodes.append(ids.size)
+        defs.append(int((ids > 0).sum()))
+        nodef.append(int((ids == 0).sum()))
+        known.append(int((ids > 1).sum()))
+        unknown.append(int((ids == 1).sum()))
+        sol = g.node_feats.get("_DF_IN")
+        if sol is None:
+            skipped_sol += 1
+        else:
+            prop.append(float(np.mean(sol)))
+            nz = sol[ids > 0]
+            prop_nz.append(float(np.mean(nz)) if nz.size else float("nan"))
+
+    n = np.array(nodes, dtype=float)
+    d = np.array(defs, dtype=float)
+    k = np.array(known, dtype=float)
+    u = np.array(unknown, dtype=float)
+    nd = np.array(nodef, dtype=float)
+    has_defs = d > 0
+    safe = lambda num, den: float(num / den) if den else 0.0
+
+    out: dict = {
         "graphs": len(graphs),
-        "nodes": n_nodes,
-        "pct_def_nodes": n_defs / n_nodes if n_nodes else 0.0,
-        "pct_unknown_defs": n_unknown / n_defs if n_defs else 0.0,
-        "pct_known_defs": (n_defs - n_unknown) / n_defs if n_defs else 0.0,
-        "pct_vul_nodes": n_vul_nodes / n_nodes if n_nodes else 0.0,
-        "pct_vul_graphs": n_vul_graphs / len(graphs) if graphs else 0.0,
+        "graphs_with_features": int(len(d)),
+        "skipped_feat": skipped_feat,
+        "skipped_sol": skipped_sol,
+        "nodes": int(n.sum()),
+        "avg_num_nodes": float(n.mean()) if n.size else 0.0,
+        "graphs_without_defs": int((~has_defs).sum()),
+        "graphs_with_unknown": int((u > 0).sum()),
+        "avg_num_nodef": float(nd.mean()) if nd.size else 0.0,
+        "avg_num_def": float(d.mean()) if d.size else 0.0,
+        "avg_num_known": float(k.mean()) if k.size else 0.0,
+        "avg_num_unknown": float(u.mean()) if u.size else 0.0,
+        "pct_def_nodes_macro": float(np.mean(d / n)) if n.size else 0.0,
+        "pct_nodes_known_micro": safe(k.sum(), n.sum()),
+        "pct_nodes_unknown_micro": safe(u.sum(), n.sum()),
+        "pct_nodes_known_macro": float(np.mean(k / n)) if n.size else 0.0,
+        "pct_nodes_unknown_macro": float(np.mean(u / n)) if n.size else 0.0,
+        "pct_def_known_micro": safe(k.sum(), d.sum()),
+        "pct_def_unknown_micro": safe(u.sum(), d.sum()),
+        "pct_def_known_micro_graphs_with_defs": safe(
+            k[has_defs].sum(), d[has_defs].sum()
+        ),
+        "pct_def_unknown_micro_graphs_with_defs": safe(
+            u[has_defs].sum(), d[has_defs].sum()
+        ),
+        "pct_def_known_macro_graphs_with_defs": (
+            float(np.mean(k[has_defs] / d[has_defs])) if has_defs.any() else 0.0
+        ),
+        "pct_def_unknown_macro_graphs_with_defs": (
+            float(np.mean(u[has_defs] / d[has_defs])) if has_defs.any() else 0.0
+        ),
+        "pct_vul_nodes": safe(vul_nodes, n.sum()),
+        "pct_vul_graphs": safe(vul_graphs, len(graphs)),
+        # flat aliases kept from the round-2 analyzer (tests/tooling compat)
+        "pct_def_nodes": safe(d.sum(), n.sum()),
+        "pct_known_defs": safe(k.sum(), d.sum()),
+        "pct_unknown_defs": safe(u.sum(), d.sum()),
+    }
+    if prop:
+        pz = np.array(prop_nz, dtype=float)
+        valid = pz[~np.isnan(pz)]
+        out["solution"] = {
+            "avg_proportion_dataflow": float(np.mean(prop)),
+            "avg_proportion_definitions_dataflow": (
+                float(np.mean(valid)) if valid.size else 0.0
+            ),
+            "num_proportion_definitions_nan": int(np.isnan(pz).sum()),
+            "pct_proportion_definitions_nan": safe(
+                int(np.isnan(pz).sum()), len(pz)
+            ),
+        }
+    return out
+
+
+def variant_coverage(
+    hash_df, splits: dict[str, set[int]],
+    limits: Sequence[int] = COVERAGE_GRID_LIMITS,
+) -> dict[str, dict[str, float]]:
+    """Per-feature-variant def coverage over the limit_all x subkey grid
+    (the 28 ``nodes_feat_*`` variants of ``dbize_absdf.py:21-45``): for each
+    single-subkey vocabulary rebuilt from the TRAIN split at each limit,
+    the fraction of definitions per split whose combined hash is known
+    (feature id >= 2). Needs the stage-2 hash table persisted by
+    ``scripts/preprocess.py`` (``hashes.parquet``)."""
+    from deepdfa_tpu.config import ALL_SUBKEYS, FeatureConfig
+    from deepdfa_tpu.data.vocab import build_vocab
+
+    # hoist the loop-invariant work out of the 28-cell grid: parse each
+    # hash ONCE and slice each split ONCE (on Big-Vul-scale tables the
+    # naive loop re-parses and re-scans ~56 times)
+    hash_df = hash_df.copy()
+    hash_df["hash_dict"] = hash_df["hash"].apply(json.loads)
+    split_rows = {
+        part: hash_df[hash_df.graph_id.isin(ids)]["hash_dict"]
+        for part, ids in splits.items()
     }
 
+    out: dict[str, dict[str, float]] = {}
+    train_ids = splits.get("train", set())
+    for sk in ALL_SUBKEYS:
+        for limit in limits:
+            fcfg = FeatureConfig(
+                subkeys=(sk,), limit_all=limit, limit_subkeys=limit
+            )
+            voc = build_vocab(hash_df, train_ids, fcfg)
+            stats: dict[str, float] = {}
+            for part, dicts in split_rows.items():
+                if not len(dicts):
+                    stats[part] = 0.0
+                    continue
+                fids = dicts.apply(voc.feature_id_from_dict)
+                stats[part] = float((fids >= 2).mean())
+            out[f"{sk}_all_limitall_{limit}_limitsubkeys_{limit}"] = stats
+    return out
 
-def analyze(cfg: ExperimentConfig, run_dir: Path) -> dict[str, dict[str, float]]:
+
+def analyze(cfg: ExperimentConfig, run_dir: Path) -> dict:
+    """The ``--analyze_dataset`` equivalent (``run_analyze_dataset.sh`` /
+    ``get_coverage``): per-split feature+solution coverage at the
+    materialised config, the vul distribution, and — when the hash table
+    was persisted — the full per-feature-variant coverage grid. Writes
+    ``coverage.json`` (a superset of the reference's printout)."""
     corpus = load_corpus(cfg)
-    out = {}
+    out: dict = {"splits": {}}
+    n_vul = {p: sum(int(g.node_feats["_VULN"].max() > 0) for g in gs)
+             for p, gs in corpus.items()}
+    out["vul_distribution"] = {
+        p: {"vul": n_vul[p], "nonvul": len(gs) - n_vul[p], "total": len(gs)}
+        for p, gs in corpus.items()
+    }
     for part, graphs in corpus.items():
         stats = coverage(graphs)
-        logger.info("%s coverage: %s", part, {k: round(v, 4) if isinstance(v, float) else v for k, v in stats.items()})
-        out[part] = stats
+        logger.info(
+            "%s coverage: %s", part,
+            {k: round(v, 4) if isinstance(v, float) else v
+             for k, v in stats.items() if not isinstance(v, dict)},
+        )
+        out["splits"][part] = stats
+
+    sample_text = "_sample" if cfg.data.sample else ""
+    shard_dir = utils.processed_dir() / cfg.data.dsname / f"shards{sample_text}"
+    hash_path = shard_dir / "hashes.parquet"
+    csv_path = shard_dir / "hashes.csv.gz"
+    splits_file = shard_dir / "splits.json"
+    if (hash_path.exists() or csv_path.exists()) and splits_file.exists():
+        import pandas as pd
+
+        hash_df = (pd.read_parquet(hash_path) if hash_path.exists()
+                   else pd.read_csv(csv_path))
+        splits = {k: set(v) for k, v in json.loads(splits_file.read_text()).items()}
+        out["variants"] = variant_coverage(hash_df, splits)
+        for name, stats in out["variants"].items():
+            logger.info("variant %s: %s", name,
+                        {k: round(v, 4) for k, v in stats.items()})
+    else:
+        out["variants"] = None
+        logger.info("no hashes.parquet under %s — variant grid skipped "
+                    "(re-run scripts/preprocess.py to persist it)", shard_dir)
+
     (run_dir / "coverage.json").write_text(json.dumps(out, indent=2))
     return out
 

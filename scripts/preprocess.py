@@ -273,6 +273,12 @@ def main(argv=None) -> dict:
     (out_dir / "vocab.json").write_text(
         json.dumps({name: voc.all_vocab for name, voc in vocabs.items()})
     )
+    # stage-2 hash table: the coverage analyzer's input for the per-variant
+    # limit_all x subkey grid (train/cli.py variant_coverage)
+    try:
+        builder.hash_df.to_parquet(out_dir / "hashes.parquet")
+    except Exception:  # no parquet engine: fall back to csv
+        builder.hash_df.to_csv(out_dir / "hashes.csv.gz", index=False)
     summary = {
         "status": "ok",
         "out": str(out_dir),

@@ -143,11 +143,51 @@ def test_dense_layout_node_style_ranking(storage, tmp_path):
 def test_analyze_coverage(storage, tmp_path):
     run_dir = tmp_path / "run"
     out = cli.main(["analyze", "--run-dir", str(run_dir), *SMALL])
-    assert set(out) == {"train", "val", "test"}
-    for stats in out.values():
+    assert set(out["splits"]) == {"train", "val", "test"}
+    for stats in out["splits"].values():
         assert 0 <= stats["pct_def_nodes"] <= 1
         assert stats["graphs"] > 0
+        # full reference-printout parity (get_coverage, main_cli.py:192-313)
+        for key in ("avg_num_nodes", "graphs_without_defs",
+                    "graphs_with_unknown", "avg_num_def", "avg_num_known",
+                    "avg_num_unknown", "pct_def_known_micro",
+                    "pct_def_known_macro_graphs_with_defs",
+                    "pct_nodes_known_micro", "pct_nodes_known_macro"):
+            assert key in stats, key
+    assert out["vul_distribution"]["train"]["total"] == out["splits"]["train"]["graphs"]
+    # synthetic fallback corpus has no persisted hash table
+    assert out["variants"] is None
     assert (run_dir / "coverage.json").exists()
+
+
+def test_variant_coverage_grid():
+    """The limit_all x subkey grid (dbize_absdf.py:21-45): a hash present
+    only outside the top-limit vocab must read as UNKNOWN at small limits
+    and known at large ones."""
+    import json as _json
+
+    import pandas as pd
+
+    rows = []
+    # train graphs 0..9: common api hash "a" (9 times), rare "b" (once)
+    for g in range(9):
+        rows.append({"graph_id": g, "node_id": 0,
+                     "hash": _json.dumps({"api": ["a"]})})
+    rows.append({"graph_id": 9, "node_id": 0,
+                 "hash": _json.dumps({"api": ["b"]})})
+    # test graph 100 uses the rare hash
+    rows.append({"graph_id": 100, "node_id": 0,
+                 "hash": _json.dumps({"api": ["b"]})})
+    hash_df = pd.DataFrame(rows)
+    splits = {"train": set(range(10)), "test": {100}}
+    out = cli.variant_coverage(hash_df, splits, limits=(1, 10))
+    k1 = "api_all_limitall_1_limitsubkeys_1"
+    k10 = "api_all_limitall_10_limitsubkeys_10"
+    assert out[k1]["test"] == 0.0  # "b" is outside the top-1 vocab
+    assert out[k10]["test"] == 1.0  # wide vocab knows it
+    assert out[k1]["train"] == 0.9  # 9 of 10 train defs use the top hash
+    # every grid key carries every split
+    assert set(out[k1]) == {"train", "test"}
 
 
 def test_config_layering(tmp_path, storage):

@@ -64,3 +64,79 @@ def test_runner_unavailable_is_clear():
     assert not r.available
     with pytest.raises(RuntimeError, match="native frontend"):
         r.run("/tmp/nope.c")
+
+
+# ---------------------------------------------------------------------------
+# summary-cached dataflow re-export (get_dataflow_output.sc parity)
+
+
+def test_reexport_dataflow_roundtrip(tmp_path):
+    """Native re-solve from cached artifacts, no re-extraction: the
+    re-exported .dataflow.json round-trips through load_dataflow and its
+    solution sets agree with the Joern-exported golden fixture; the summary
+    marker makes the second call a cache no-op; cache=False forces."""
+    import shutil
+
+    from deepdfa_tpu.cpg.joern import load_dataflow, reexport_dataflow
+
+    for suffix in (".nodes.json", ".edges.json"):
+        shutil.copy(STEM.parent / f"sample.c{suffix}", tmp_path / f"sample.c{suffix}")
+    stem = tmp_path / "sample.c"
+
+    out = reexport_dataflow(stem)
+    assert out.exists() and (tmp_path / "sample.c.dataflow.summary.json").exists()
+    ours = load_dataflow(out)
+    golden = load_dataflow(STEM.parent / "sample.c.dataflow.json")
+    assert list(ours) == list(golden) == ["f"]
+    for key in ("solution.in", "solution.out"):
+        got = {n: set(v) for n, v in ours["f"][key].items() if v}
+        want = {n: set(v) for n, v in golden["f"][key].items() if v}
+        assert got == want, (key, got, want)
+    # gen agrees on the defining nodes
+    assert ours["f"]["problem.gen"] == golden["f"]["problem.gen"]
+
+    # second call: summary cache short-circuits (artifact untouched)
+    before = out.stat().st_mtime_ns
+    reexport_dataflow(stem)
+    assert out.stat().st_mtime_ns == before
+    # cache=False re-solves (artifact rewritten)
+    reexport_dataflow(stem, cache=False)
+    assert out.stat().st_mtime_ns >= before
+    assert load_dataflow(out) == ours
+
+
+def test_reexport_dataflow_matches_solver_on_generated_corpus(tmp_path):
+    """Round-trip on a REAL pipeline artifact: export a generated function's
+    CPG via the native frontend writers, re-solve via reexport_dataflow, and
+    cross-check the written solution against ReachingDefinitions run
+    directly on the same CPG."""
+    import json as _json
+
+    from deepdfa_tpu.cpg.dataflow import ReachingDefinitions
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.cpg.joern import load_dataflow, reexport_dataflow
+
+    code = "int f(int a){int x; x = a + 1; if (a) { x = 2; } return x;}"
+    cpg = parse_source(code)
+    # write reference-schema artifacts the reader understands
+    nodes = [
+        {"id": n.id, "_label": n.label, "name": n.name, "code": n.code,
+         "lineNumber": n.line, "order": n.order,
+         "typeFullName": n.type_full_name}
+        for n in cpg.nodes.values()
+    ]
+    edges = [[dst, src, etype, None] for src, dst, etype in cpg.edges]
+    stem = tmp_path / "gen.c"
+    (tmp_path / "gen.c.nodes.json").write_text(_json.dumps(nodes))
+    (tmp_path / "gen.c.edges.json").write_text(_json.dumps(edges))
+
+    out = reexport_dataflow(stem)
+    written = load_dataflow(out)
+    (name, sol), = written.items()
+    from deepdfa_tpu.cpg.joern import load_cpg
+
+    rd = ReachingDefinitions(load_cpg(stem))
+    in_sets, out_sets = rd.solve()
+    want_in = {n: sorted(d.node for d in s) for n, s in in_sets.items() if s}
+    got_in = {int(k): v for k, v in sol["solution.in"].items() if v}
+    assert got_in == want_in

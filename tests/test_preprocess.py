@@ -57,6 +57,8 @@ def test_preprocess_to_training(tmp_path, monkeypatch):
     assert summary["graphs"] == 60 and summary["failed"] == 0
     out = Path(summary["out"])
     assert (out / "splits.json").exists() and (out / "vocab.json").exists()
+    # stage-2 hash table persisted for the coverage analyzer's variant grid
+    assert (out / "hashes.parquet").exists() or (out / "hashes.csv.gz").exists()
 
     # idempotence: second run is a no-op without --overwrite
     again = preprocess.main(["--dataset", "demo", "--n", "60", "--workers", "1"])

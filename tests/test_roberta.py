@@ -248,6 +248,8 @@ def test_linevul_demo_recording_shows_learning():
     from pathlib import Path
 
     path = Path(__file__).resolve().parent.parent / "storage/linevul_demo/RESULT.json"
+    if not path.exists():  # committed artifact; guard stray partial checkouts
+        pytest.skip("recorded demo artifact not present")
     d = json.loads(path.read_text())
     assert d["num_missing"] == 0
     assert d["test_f1_1"] >= 0.8, d["test_f1_1"]
