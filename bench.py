@@ -247,16 +247,17 @@ def _time_chained_inference(apply_fn, params, batches, k: int, trials: int = 3):
 
 def build_dense_batches(corpus, n_batches: int, batch_graphs: int = 256):
     """Dense-adjacency batches over the same corpus prefix as
-    :func:`build_batches`, size-bucketed ({p50, p99} per-graph node budgets —
-    slot cost scales n², so routing median graphs to the small shape roughly
-    halves wasted matmul FLOPs at one extra compile). Returns
+    :func:`build_batches`, size-bucketed by the optimal k-bucket DP
+    (``derive_dense_sizes``, default k=6 — slot cost scales n², and the DP
+    split reached 0.83 node occupancy vs the old {p50,p99} pair's 0.49 on
+    this corpus, at up to 6 compiled shapes). Returns
     (groups, occupancy, n_dropped): ``groups`` maps nodes_per_graph → up to
     ``n_batches`` full batches of that compiled shape."""
     from deepdfa_tpu.data.dense import DenseBatcher, derive_dense_sizes
 
-    sizes = derive_dense_sizes(
-        corpus[: int(n_batches * batch_graphs * 1.5)], quantiles=(0.5, 0.99)
-    )
+    # optimal k-bucket split (round-5: replaces the {p50,p99} heuristic —
+    # VERDICT r04 #2 occupancy push)
+    sizes = derive_dense_sizes(corpus[: int(n_batches * batch_graphs * 1.5)])
     # the stream splits across len(sizes) buckets — scale the slice so each
     # bucket can still fill n_batches full batches
     graphs = corpus[: int(n_batches * batch_graphs * 1.5 * len(sizes))]

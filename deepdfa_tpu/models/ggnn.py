@@ -95,8 +95,14 @@ class GatedGraphConv(nn.Module):
 
     @nn.compact
     def __call__(
-        self, h: jnp.ndarray, senders: jnp.ndarray, receivers: jnp.ndarray
+        self, h: jnp.ndarray, senders: jnp.ndarray, receivers: jnp.ndarray,
+        taps: tuple | None = None,
     ) -> jnp.ndarray:
+        """``taps`` (diagnostics only): a tuple of ``n_steps`` zero arrays
+        shaped like ``h`` added to the state after each GRU update — the
+        standard trick for reading per-step gradients dL/dh_t through the
+        unrolled chain (grad w.r.t. taps[t]); None (the default) changes
+        nothing."""
         n_nodes = h.shape[0]
         # A false edges_sorted promise makes TPU segment reductions silently
         # wrong; when running eagerly (tests, hand-built batches — concrete
@@ -134,7 +140,7 @@ class GatedGraphConv(nn.Module):
         # happens once per batch as a numpy argsort on the host.
         # Python loop, unrolled by trace: n_steps is small (5) and static;
         # unrolling lets XLA pipeline the matmuls instead of a lax.scan barrier.
-        for _ in range(self.n_steps):
+        for _step in range(self.n_steps):
             msg_src = edge_linear(h)
             if self.aggregation == "sum":
                 agg = segment_sum(gather(msg_src, senders), receivers, n_nodes,
@@ -150,6 +156,8 @@ class GatedGraphConv(nn.Module):
                 agg = union(nn.sigmoid(h), msgs, senders, receivers,
                             indices_are_sorted=self.edges_sorted)
             h = gru(agg, h)
+            if taps is not None:
+                h = h + taps[_step]
         return h
 
 
@@ -241,10 +249,10 @@ class GGNN(nn.Module):
             return out.reshape(*ids.shape[:-1], -1)
         return self.embedding(batch.node_feats["_ABS_DATAFLOW"])
 
-    def __call__(self, batch: BatchedGraphs) -> jnp.ndarray:
+    def __call__(self, batch: BatchedGraphs, taps: tuple | None = None) -> jnp.ndarray:
         cfg = self.cfg
         feat_embed = self.embed_nodes(batch)
-        ggnn_out = self.ggnn(feat_embed, batch.senders, batch.receivers)
+        ggnn_out = self.ggnn(feat_embed, batch.senders, batch.receivers, taps=taps)
         out = jnp.concatenate([ggnn_out, feat_embed], axis=-1)
         if cfg.label_style == "graph":
             out = self.pooling(

@@ -127,7 +127,13 @@ def _batcher(cfg: ExperimentConfig, graphs: list[Graph] | None = None):
         # segment-fallback overflow below)
         cap = max(b.max_nodes // max(b.batch_graphs, 1), 8)
         if b.auto_buckets and graphs:
-            sizes = sorted({min(s, cap) for s in derive_dense_sizes(graphs)})
+            # corpus-size-aware shape count: the DP's occupancy win assumes
+            # batches actually FILL; the trainer's streaming mode flushes one
+            # partial batch per shape per pass, so cap k near the expected
+            # number of full batches (small demo corpora keep the old 2-shape
+            # behavior; big corpora get the full k=6 split)
+            k = int(np.clip(round(len(graphs) / max(b.batch_graphs, 1)), 1, 6))
+            sizes = sorted({min(s, cap) for s in derive_dense_sizes(graphs, k=k)})
         else:
             sizes = [cap]
         # drop_oversize=True means "don't error on oversize" — but a trainer

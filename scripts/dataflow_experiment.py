@@ -154,6 +154,154 @@ def chain_sweep(args) -> dict:
     return curves
 
 
+def _train_with_curve(dsname: str, epochs: int, seed: int = 0,
+                      probe_grads: bool = True, **model_overrides) -> dict:
+    """Train the golden GGNN on ``dsname`` recording the per-epoch curve,
+    the PLATEAU length (first epoch with train acc >= 0.7 — the round-5
+    diagnostic that explained the r03 'chain-depth collapse': the task has
+    a long flat stretch and the r03 sweep's 25 epochs ended inside it),
+    the val logit/label correlation (which goes high ~20 epochs BEFORE
+    accuracy — the logits rank-order the classes while still sitting
+    entirely on one side of the threshold), and per-step grad norms
+    dL/dh_t through the unrolled GRU chain (via the taps argument)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepdfa_tpu.config import ExperimentConfig
+    from deepdfa_tpu.data.sampler import positive_weight
+    from deepdfa_tpu.models.ggnn import GGNN
+    from deepdfa_tpu.train import cli
+    from deepdfa_tpu.train.loop import Trainer, bce_with_logits, graph_labels
+
+    cfg = _hard_cfg(ExperimentConfig(), dsname=dsname, **model_overrides)
+    cfg = dataclasses.replace(
+        cfg, optim=dataclasses.replace(cfg.optim, max_epochs=epochs)
+    )
+    corpus = cli.load_corpus(cfg)
+    train, val, test = corpus["train"], corpus["val"], corpus["test"]
+    labels = np.array([int(g.node_feats["_VULN"].max()) for g in train])
+    batcher = cli._batcher(cfg, train + val + test)
+    model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
+    trainer = Trainer(model, cfg, pos_weight=positive_weight(labels))
+    state = trainer.init_state(
+        jax.tree.map(jnp.asarray, next(cli._batch_stream(batcher, train[:64])))
+    )
+
+    def grad_norms_per_step(params) -> list[float]:
+        """|dL/dh_t| for each message-passing step on one val batch."""
+        b = jax.tree.map(jnp.asarray, next(cli._batch_stream(batcher, val)))
+        lab = graph_labels(b)
+        w = b.graph_mask.astype(jnp.float32)
+        from deepdfa_tpu.config import ALL_SUBKEYS
+
+        width = cfg.model.hidden_dim * (
+            len(ALL_SUBKEYS) if cfg.model.concat_all_absdf else 1
+        )
+        taps0 = tuple(
+            jnp.zeros((b.node_feats["_ABS_DATAFLOW"].shape[0], width),
+                      jnp.float32)
+            for _ in range(cfg.model.n_steps)
+        )
+
+        def loss_of_taps(taps):
+            logits = model.apply({"params": params}, b, taps=taps)
+            return bce_with_logits(logits, lab.astype(jnp.float32), w, None)
+
+        g = jax.grad(loss_of_taps)(taps0)
+        return [float(jnp.linalg.norm(t)) for t in g]
+
+    curve = []
+    breakthrough = None
+    grad_trace = {}
+    for epoch in range(epochs):
+        egs = cli._epoch_graphs(train, labels, cfg, epoch)
+        state, tm, tloss = trainer.train_epoch(
+            state, cli._batch_stream(batcher, egs, shuffle_seed=seed + epoch)
+        )
+        vm, vloss = trainer.evaluate(
+            state.params, cli._batch_stream(batcher, val)
+        )
+        row = {
+            "epoch": epoch,
+            "train_acc": round(float(tm["train_Accuracy"]), 4),
+            "val_acc": round(float(vm["val_Accuracy"]), 4),
+            "val_f1": round(float(vm["val_F1Score"]), 4),
+            "train_loss": round(float(tloss), 5),
+        }
+        curve.append(row)
+        if breakthrough is None and row["train_acc"] >= 0.7:
+            breakthrough = epoch
+        if probe_grads and epoch in (0, epochs // 4, epochs - 1):
+            grad_trace[str(epoch)] = [
+                round(x, 6) for x in grad_norms_per_step(state.params)
+            ]
+        # early stop once converged well past the plateau (saves hours in
+        # the sweep; the plateau length is the quantity of interest)
+        if len(curve) >= 10 and all(
+            r["train_acc"] >= 0.99 and r["val_acc"] >= 0.99
+            for r in curve[-10:]
+        ):
+            if probe_grads and str(epoch) not in grad_trace:
+                grad_trace[str(epoch)] = [
+                    round(x, 6) for x in grad_norms_per_step(state.params)
+                ]
+            break
+
+    # final test + val logit/label correlation
+    test_m, _ = trainer.evaluate(
+        state.params, cli._batch_stream(batcher, test), prefix="test_"
+    )
+    b = jax.tree.map(jnp.asarray, next(cli._batch_stream(batcher, val)))
+    logits = np.asarray(model.apply({"params": state.params}, b))
+    lab = np.asarray(graph_labels(b))
+    mask = np.asarray(b.graph_mask)
+    corr = None
+    if mask.sum() > 2:
+        c = float(np.corrcoef(logits[mask], lab[mask])[0, 1])
+        corr = c if np.isfinite(c) else None  # constant logits/labels -> NaN
+    return {
+        "test_f1": round(float(test_m["test_F1Score"]), 4),
+        "test_acc": round(float(test_m["test_Accuracy"]), 4),
+        "breakthrough_epoch": breakthrough,
+        "val_logit_label_corr": round(corr, 4) if corr is not None else None,
+        "grad_norm_per_step": grad_trace,
+        "curve_tail": curve[-3:],
+        "curve_every4": curve[::4],
+    }
+
+
+def rescue(args) -> dict:
+    """Round-5 directive #5: the r03 'chain-depth collapse' re-examined
+    with optimization diagnostics. For each L, train sum and union_relu at
+    the GOLDEN depth (n_steps=5) with an epoch budget past the plateau.
+    Evidence recorded per run: breakthrough epoch, grad-norm-per-step
+    traces, final F1, and the logit/label correlation."""
+    from scripts import preprocess as pp
+
+    depths = [int(x) for x in args.rescue.split(",")]
+    out: dict = {"n": args.n, "epochs": args.epochs, "depths": depths,
+                 "n_steps": 5, "runs": {}}
+    for L in depths:
+        ds = f"demo_chain{L}"
+        summary = pp.main(["--dataset", ds, "--n", str(args.n),
+                           "--seed", str(args.seed), "--overwrite"])
+        if summary.get("graphs") != args.n:
+            raise RuntimeError(f"corpus build mismatch for {ds}: {summary}")
+        for agg in ("sum", "union_relu"):
+            key = f"L{L}_{agg}"
+            out["runs"][key] = _train_with_curve(
+                ds, args.epochs, seed=args.seed, aggregation=agg, n_steps=5
+            )
+            print(f"{key}: f1={out['runs'][key]['test_f1']} "
+                  f"breakthrough={out['runs'][key]['breakthrough_epoch']}",
+                  file=sys.stderr)
+    print(json.dumps(out))
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=400)
@@ -163,8 +311,13 @@ def main(argv=None):
     ap.add_argument("--chain-sweep", default=None, metavar="L1,L2,...",
                     help="run the union-vs-sum chain-depth separation sweep "
                          "instead of the standard experiment")
+    ap.add_argument("--rescue", default=None, metavar="L1,L2,...",
+                    help="run the round-5 plateau-aware rescue sweep with "
+                         "optimization diagnostics (use --epochs >= 150)")
     args = ap.parse_args(argv)
 
+    if args.rescue:
+        return rescue(args)
     if args.chain_sweep:
         return chain_sweep(args)
 
