@@ -246,21 +246,24 @@ class _Embeddings(nn.Module):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
 
-        def emb(n, name):
+        def emb(n, name, row_axis="vocab"):
+            # only the word table is big enough to shard its rows over tp;
+            # position tables can be odd-sized and the token-type table has
+            # ONE row (RoBERTa never uses segment B) — those replicate
             return nn.Embed(
                 n, cfg.hidden_size, dtype=dtype,
                 embedding_init=nn.with_logical_partitioning(
-                    nn.initializers.normal(0.02), ("vocab", "embed")
+                    nn.initializers.normal(0.02), (row_axis, "embed")
                 ),
                 name=name,
             )
 
         x = emb(cfg.vocab_size, "word_embeddings")(input_ids)
-        x = x + emb(cfg.max_position_embeddings, "position_embeddings")(positions)
+        x = x + emb(cfg.max_position_embeddings, "position_embeddings",
+                    row_axis=None)(positions)
         # token type 0 everywhere (RoBERTa never uses segment B)
-        x = x + emb(cfg.type_vocab_size, "token_type_embeddings")(
-            jnp.zeros_like(input_ids)
-        )
+        x = x + emb(cfg.type_vocab_size, "token_type_embeddings",
+                    row_axis=None)(jnp.zeros_like(input_ids))
         x = _layer_norm(cfg.layer_norm_eps)(x).astype(dtype)
         return nn.Dropout(cfg.hidden_dropout_prob,
                           deterministic=self.deterministic)(x)

@@ -50,3 +50,4 @@ def test_golden_quality_floor(storage, tmp_path, dsname):
         f"golden-quality drift on {dsname}: test F1 {f1:.4f} < floor "
         f"{spec['min_test_f1']} (committed band: configs/golden_quality.json)"
     )
+
