@@ -230,8 +230,19 @@ def main(argv=None) -> dict:
     from deepdfa_tpu.config import FeatureConfig
 
     input_dim = FeatureConfig().input_dim  # must match the preprocess vocab
+    # With --freeze-graph, the encoder architecture must MATCH the trained
+    # checkpoint: read the fit run's config.json (sibling of checkpoints/)
+    # instead of assuming the golden config — a hidden-8 checkpoint loaded
+    # into a hidden-32 encoder fails with a shape error deep in flax.
+    gnn_cfg = GGNNConfig()
+    if args.freeze_graph:
+        cfg_file = Path(args.freeze_graph).parent / "config.json"
+        if cfg_file.exists():
+            saved = json.loads(cfg_file.read_text()).get("model", {})
+            names = {f.name for f in dataclasses.fields(GGNNConfig)}
+            gnn_cfg = GGNNConfig(**{k: v for k, v in saved.items() if k in names})
     fusion = FusionModel(
-        gnn_cfg=GGNNConfig(),
+        gnn_cfg=gnn_cfg,
         input_dim=input_dim,
         llm_hidden_size=llm_cfg.hidden_size,
         use_gnn=jcfg.use_gnn,

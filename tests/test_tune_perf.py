@@ -178,3 +178,29 @@ def test_isolated_trials_and_pruning(tmp_path, monkeypatch):
     # not grow parent peak RSS (in-process trials accumulate ~100MB+ of XLA
     # compile cache each; isolation keeps that in the children)
     assert rss_after - rss_before < 50_000, (rss_before, rss_after)
+
+
+def test_performance_evaluation_full_protocol(tmp_path, monkeypatch):
+    """The reference's REAL 3-stage protocol (performance_evaluation.sh:
+    DeepDFA, LineVul, DeepDFA+LineVul) runs hermetically end-to-end and
+    records per-stage wall times + metrics."""
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import importlib
+
+    from deepdfa_tpu import utils
+
+    importlib.reload(utils)
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+    import performance_evaluation
+
+    agg = performance_evaluation.main(
+        ["--protocol", "full", "--out", str(tmp_path / "perf_full"),
+         "--set", "optim.max_epochs=1", "--set", "model.hidden_dim=8",
+         "--set", "model.n_steps=1"]
+    )
+    assert set(agg["stages"]) == {"deepdfa", "linevul", "deepdfa_linevul"}
+    for stage in agg["stages"].values():
+        assert stage["seconds"] > 0
+    assert agg["total_seconds"] > 0
+    assert (tmp_path / "perf_full" / "performance_evaluation.json").exists()
