@@ -23,8 +23,15 @@ MACs, so the bf16 ceiling applies). Reference anchor: the 4-bit NF4
 inference assembly this replaces, ``MSIVD/msivd/train.py:873-885`` /
 ``hf_inference.py:86-107``.
 
+``--decode N`` switches to the autoregressive DECODE benchmark: the same
+int8-resident full stack behind a fixed-size KV cache, one ``lax.scan``
+over single-token steps (``llm/generate.py``) — the weights-bandwidth
+regime interactive generation lives in (each step re-reads every weight at
+small batch), vs the compute-shaped prefill forward the default measures.
+
 Usage: python scripts/bench_int8_llm.py [--layers 32] [--batch 4]
        [--seq 1024] [--chain 8] [--tiny]
+       python scripts/bench_int8_llm.py --decode 128 --batch 8
 """
 
 from __future__ import annotations
@@ -51,12 +58,73 @@ from bench import (  # noqa: E402  (shared protocol)
 FULL_LAYERS = 32  # CodeLlama-7B
 
 
+def bench_decode(model, cfg, params, args, roofline, backend, device_kind):
+    """Autoregressive DECODE throughput: the full int8-resident stack behind
+    a fixed-size KV cache, one ``lax.scan`` over single-token steps (the
+    ``llm/generate.py`` loop — the scan is its own chained protocol: the
+    returned tokens depend on every step). At batch<<128 each step re-reads
+    every weight, so this is the weights-bandwidth regime — the honest
+    inference number for interactive generation, vs the prefill-style
+    forward the default mode measures. Reference anchor: the batch
+    generation helper, ``MSIVD/msivd/hf_inference.py:129-162``."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.llm.generate import GenerateConfig, generate
+
+    rng = np.random.default_rng(2)
+    b, s = args.batch, args.decode_prompt
+    ids = np.asarray(rng.integers(3, cfg.vocab_size, (b, s)), np.int32)
+    pad = np.ones((b, s), bool)
+    gcfg = GenerateConfig(max_new_tokens=args.decode, temperature=0.0,
+                          eos_token_id=-1)  # greedy, never stops early
+
+    _progress(f"compiling + warming decode scan (b={b}, prompt {s}, "
+              f"new {args.decode})")
+    out = generate(model, params, ids, pad, gcfg)  # compile + warm
+    assert out.shape == (b, args.decode)
+    t = min(
+        _time_once(lambda: np.asarray(generate(model, params, ids, pad, gcfg)))
+        for _ in range(3)
+    )
+    # every scan step is one single-token forward (prompt teacher-forcing
+    # steps cost the same as sampled steps)
+    steps = s + args.decode - 1
+    tok_per_sec = b * steps / t
+    result = {
+        "metric": "int8_resident_decode_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "backend": backend,
+        "device_kind": device_kind,
+        "model": "tiny_llama" if args.tiny else "codellama_7b_dims",
+        "layers": cfg.num_hidden_layers,
+        "batch": b,
+        "prompt_len": s,
+        "new_tokens": args.decode,
+        "kv_cache_len": cfg.max_position_embeddings,
+        "step_ms": round(t / steps * 1e3, 3),
+        "timing": ("one jitted lax.scan over all single-token steps; "
+                   "returned tokens depend on every step; best of 3"),
+        "regime": ("weights-bandwidth-bound at small batch: each step "
+                   "re-reads the int8-resident weights"),
+        "roofline_tflops": round(roofline / 1e12, 1),
+        "git_rev": _git_rev(),
+    }
+    print(json.dumps(result))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=FULL_LAYERS)
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--chain", type=int, default=8)
+    ap.add_argument("--decode", type=int, default=0, metavar="NEW_TOKENS",
+                    help="measure autoregressive decode throughput instead "
+                    "of the prefill-style forward")
+    ap.add_argument("--decode-prompt", type=int, default=16)
     ap.add_argument("--tiny", action="store_true", help="tiny dims (CPU smoke)")
     args = ap.parse_args()
 
@@ -71,8 +139,14 @@ def main():
         args.batch, args.seq = min(args.batch, 2), min(args.seq, 128)
         args.layers = cfg.num_hidden_layers  # report the real tiny depth
     else:
+        # decode mode caps the KV cache at prompt+new (the default 16384
+        # max_position_embeddings would allocate an ~8.6 GB/batch-row cache)
+        max_pos = (
+            -(-(args.decode_prompt + args.decode) // 128) * 128
+            if args.decode else 16384
+        )
         cfg = codellama_7b(num_hidden_layers=args.layers, int8_runtime=True,
-                           dtype="bfloat16")
+                           dtype="bfloat16", max_position_embeddings=max_pos)
 
     backend, device_kind = _init_backend_with_retry()
     _progress(f"backend={backend}; measuring roofline")
@@ -90,6 +164,10 @@ def main():
     # leaf.nbytes sums device metadata — tree_nbytes would pull ~6.8 GB of
     # weights back through the tunnel just to count them
     weight_bytes = sum(l.nbytes for l in jax.tree.leaves(params))
+
+    if args.decode:
+        return bench_decode(model, cfg, params, args, roofline, backend,
+                            device_kind)
 
     fwd = lambda p, i: model.apply({"params": p}, i)
     ids_k = jnp.asarray(

@@ -57,3 +57,21 @@ def test_randomize_params_respects_dtypes():
     # every residual branch ~50x)
     assert bool(jnp.all(out["input_layernorm"]["weight"] == 1.0))
     assert out["lora_a"] is None
+
+
+@pytest.mark.slow
+def test_tiny_decode_emits_valid_json_line():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_BENCH_CHILD"] = "1"
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_int8_llm.py"),
+         "--tiny", "--decode", "8", "--decode-prompt", "4"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["metric"] == "int8_resident_decode_tokens_per_sec_per_chip"
+    assert d["value"] > 0 and d["new_tokens"] == 8
+    assert d["step_ms"] > 0

@@ -17,7 +17,7 @@ def test_chain_rescue_recording():
 
         pytest.skip("recorded rescue artifact not present")
     d = json.loads(path.read_text())
-    assert set(d["depths"]) == {5, 10, 20}
+    assert {5, 10, 20} <= set(d["depths"])
     for L in d["depths"]:
         s = d["runs"][f"L{L}_sum"]
         assert s["test_f1"] >= 0.95, (L, s["test_f1"])
