@@ -302,3 +302,33 @@ def test_derive_dense_sizes_dp_degenerate_cases():
     varied = random_dataset(300, seed=9, input_dim=40)
     for k in (1, 2, 3):
         assert len(derive_dense_sizes(varied, k=k)) <= k
+
+
+def test_derive_dense_sizes_dp_is_optimal_brute_force():
+    """k=2 DP vs exhaustive search over all candidate budget pairs: total
+    padded slots must match the exhaustive optimum on random corpora."""
+    import itertools
+
+    import numpy as np
+
+    from deepdfa_tpu.data.dense import derive_dense_size, derive_dense_sizes
+
+    rng = np.random.default_rng(13)
+    for trial in range(10):
+        sizes = rng.integers(3, 120, size=60)
+        graphs = [type("G", (), {"n_nodes": int(s)})() for s in sizes]
+        cap = derive_dense_size(graphs, 0.99, 8)
+        rounded = [min(-(-s // 8) * 8, 10**9) for s in sizes]
+        rounded = [r for r in rounded if r <= cap]
+        cands = sorted(set(rounded) | {cap})
+
+        def cost(buckets):
+            return sum(min(b for b in buckets if b >= r) for r in rounded)
+
+        best = min(
+            cost(pair)
+            for pair in itertools.combinations(cands, min(2, len(cands)))
+            if max(pair) == cap
+        ) if len(cands) >= 2 else cost((cap,))
+        got = derive_dense_sizes(graphs, k=2)
+        assert cost(got) == best, (trial, got, best)
