@@ -142,6 +142,9 @@ class DataConfig:
     seed: int = 0
     undersample: str | None = "v1.0"  # "vX" = X × #vul nonvul kept (``dclass.py:84-105``)
     oversample: float | None = None
+    # host→device prefetch depth for training/eval streams (the reference's
+    # ``train_workers`` DataLoader analogue, data/prefetch.py); 0 disables
+    prefetch: int = 2
     batch: BatchConfig = field(default_factory=BatchConfig)
     feature: FeatureConfig = field(default_factory=FeatureConfig)
 
