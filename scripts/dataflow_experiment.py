@@ -120,7 +120,9 @@ def run_ggnn(run_dir: Path, epochs: int, dsname: str = "demo_hard", **model_over
 
 
 def chain_sweep(args) -> dict:
-    """Union-vs-sum separation curves (round-3, VERDICT #4): for each def→def
+    """[Superseded by --rescue for conclusions — this 25-epoch budget stops
+    inside the optimization plateau the round-5 rescue documented; kept for
+    reproducing the r03 table.] Union-vs-sum separation curves: for each def→def
     CFG distance L, train the golden GGNN on ``demo_chain{L}`` with
     aggregation ∈ {sum, union_relu} at the golden depth (n_steps=5) and at a
     chain-covering depth (n_steps=L+3). The class is decided by WHICH

@@ -26,3 +26,10 @@ def test_chain_rescue_recording():
         u = d["runs"][f"L{L}_union_relu"]
         assert u["breakthrough_epoch"] is None  # the diagnosed failure
         assert u["grad_norm_per_step"]  # diagnostics recorded
+    # the node-level depth probe: BOTH aggregators solve RD prediction at
+    # depth (union's failure is specific to the pooled graph label)
+    node = d["node_level_rd"]
+    for key, r in node.items():
+        if key == "protocol":
+            continue
+        assert r["f1"] >= 0.95, (key, r)
