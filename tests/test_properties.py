@@ -1,0 +1,156 @@
+"""Property-based tests (hypothesis) over load-bearing invariants that
+example-based tests can only spot-check: batching contracts, the dialogue
+encoder's truncation guarantees, the dense-bucket DP, and the union
+algebra. Each property encodes a contract another module RELIES on (noted
+inline)."""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from deepdfa_tpu.data.graphs import BucketSpec, Graph, GraphBatcher
+from deepdfa_tpu.llm.dataset import HashTokenizer
+from deepdfa_tpu.llm.selfinstruct import encode_dialogue, multitask_rounds
+
+TOK = HashTokenizer(vocab_size=256)
+
+
+def _graph(rng: np.random.Generator, n_nodes: int, n_edges: int, gid: int) -> Graph:
+    senders = rng.integers(0, n_nodes, n_edges).astype(np.int32)
+    receivers = rng.integers(0, n_nodes, n_edges).astype(np.int32)
+    feats = {
+        "_ABS_DATAFLOW": rng.integers(0, 30, n_nodes).astype(np.int32),
+        "_VULN": rng.integers(0, 2, n_nodes).astype(np.int32),
+    }
+    return Graph(senders=senders, receivers=receivers, node_feats=feats, gid=gid)
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.data())
+def test_batch_np_contract(data):
+    """The batch_np contract every segment reduction RELIES on
+    (ggnn.py edges_sorted=True): receivers sorted ascending, masks mark
+    exactly the real prefix, node_gidx consistent with graph slots."""
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    n_graphs = data.draw(st.integers(1, 6))
+    graphs = [
+        _graph(rng, data.draw(st.integers(1, 20)), data.draw(st.integers(1, 40)), i)
+        for i in range(n_graphs)
+    ]
+    total_nodes = sum(g.n_nodes for g in graphs)
+    total_edges = sum(g.n_edges for g in graphs)
+    bucket = BucketSpec(n_graphs + 1, total_nodes + 8, total_edges + 8)
+    (batch,) = list(GraphBatcher([bucket]).batches(graphs))
+
+    recv = np.asarray(batch.receivers)[np.asarray(batch.edge_mask)]
+    assert np.all(np.diff(recv) >= 0), "receivers not sorted"
+    n_real_nodes = int(np.asarray(batch.node_mask).sum())
+    assert n_real_nodes == total_nodes
+    assert int(np.asarray(batch.edge_mask).sum()) == total_edges
+    # real nodes form a contiguous prefix
+    nm = np.asarray(batch.node_mask)
+    assert nm[:n_real_nodes].all() and not nm[n_real_nodes:].any()
+    # node_gidx of real nodes is nondecreasing and < n_graphs
+    gidx = np.asarray(batch.node_gidx)[:n_real_nodes]
+    assert np.all(np.diff(gidx) >= 0)
+    assert gidx.max() < n_graphs
+    # per-graph node counts preserved
+    counts = np.bincount(gidx, minlength=n_graphs)
+    np.testing.assert_array_equal(counts, [g.n_nodes for g in graphs])
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    n_stmts=st.integers(0, 120),
+    block=st.integers(24, 96),
+    vul=st.booleans(),
+    with_meta=st.booleans(),
+)
+def test_encode_dialogue_invariants(n_stmts, block, vul, with_meta):
+    """For ANY code length and block size: the instruction survives whole,
+    loss tokens are a subset of real tokens, real tokens are a contiguous
+    suffix (left pad), and when everything fits nothing is cut. The joint
+    trainer RELIES on loss⊆pad (response-only grading) and the left-pad
+    suffix (mask-aware pooling)."""
+    code = "int f(){" + " ".join(f"v{i}q={i};" for i in range(n_stmts)) + "}"
+    rounds = multitask_rounds(
+        code, int(vul),
+        cwe="CWE-787" if with_meta else "",
+        explanation="overflow" if with_meta else "",
+    )
+    ids, pad, lm = encode_dialogue(TOK, rounds, block)
+    assert ids.shape == (block,) and pad.shape == (block,) and lm.shape == (block,)
+    assert np.all(pad[lm]), "graded token outside the real-token set"
+    # left pad: real tokens contiguous at the end
+    if pad.any():
+        first = int(np.argmax(pad))
+        assert pad[first:].all()
+    # instruction tokens intact (unless instructions+responses alone
+    # overflow the block, which these sizes never do)
+    instr = TOK.encode_raw(rounds[0].prompt)
+    real = ids[pad].tolist()
+    assert any(
+        real[i:i + len(instr)] == instr
+        for i in range(len(real) - len(instr) + 1)
+    ), "instruction truncated"
+    # every response graded whole: graded token count == responses + eos
+    expect = sum(len(TOK.encode_raw(r.response)) + 1 for r in rounds)
+    assert int(lm.sum()) == expect
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.data())
+def test_derive_dense_sizes_dp_properties(data):
+    """DP output: <= k budgets, multiples of round_to, top == the oversize
+    cap, and never worse than the legacy {p50,p99} heuristic on total
+    padded slots (the quantity it optimises)."""
+    from deepdfa_tpu.data.dense import derive_dense_size, derive_dense_sizes
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    n = data.draw(st.integers(5, 120))
+    sizes = rng.integers(1, 150, n)
+    graphs = [type("G", (), {"n_nodes": int(s)})() for s in sizes]
+    k = data.draw(st.integers(1, 6))
+    got = derive_dense_sizes(graphs, k=k)
+    cap = derive_dense_size(graphs, 0.99, 8)
+    assert len(got) <= k
+    assert all(s % 8 == 0 for s in got)
+    assert max(got) == cap
+
+    def cost(buckets):
+        rounded = [-(-int(s) // 8) * 8 for s in sizes if -(-int(s) // 8) * 8 <= cap]
+        return sum(min(b for b in buckets if b >= r) for r in rounded)
+
+    legacy = derive_dense_sizes(graphs, quantiles=(0.5, 0.99))
+    if k >= len(legacy) and max(legacy) == cap:
+        assert cost(got) <= cost(legacy)
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.data())
+def test_segment_union_algebra(data):
+    """Union aggregators stay inside the [0,1] membership lattice and honor
+    the absorbing element: a saturated incoming message forces the result
+    to 1 at the receiver (the RD lattice's ⊤-absorption the learned-DFA
+    thesis builds on)."""
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.ops.union import segment_union_relu, segment_union_simple
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    n, e, d = data.draw(st.integers(2, 8)), data.draw(st.integers(1, 16)), 4
+    h = rng.random((n, d)).astype(np.float32)
+    m = rng.random((n, d)).astype(np.float32)
+    senders = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    receivers = np.sort(rng.integers(0, n, e)).astype(np.int32)
+    # saturate one sender's message and check its receiver hits 1
+    m[senders[0]] = 1.0
+    for union in (segment_union_simple, segment_union_relu):
+        out = np.asarray(union(
+            jnp.asarray(h), jnp.asarray(m), jnp.asarray(senders),
+            jnp.asarray(receivers), indices_are_sorted=True,
+        ))
+        assert out.shape == (n, d)
+        assert np.all(out >= -1e-6) and np.all(out <= 1 + 1e-6)
+        np.testing.assert_allclose(out[receivers[0]], 1.0, atol=1e-5)
