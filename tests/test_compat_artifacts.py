@@ -167,3 +167,70 @@ def test_hf_checkpoint_dir_roundtrip(tmp_path):
     )
     assert toks.shape == (2, 4)
     assert ((toks >= 0) & (toks < 320)).all()
+
+
+@pytest.mark.slow
+def test_bigvul_schema_preprocess_to_training(tmp_path, monkeypatch):
+    """Config #1 end-to-end on the FAITHFUL MSR CSV shape: the ~35-column
+    artifact (unnamed index, dates, float Score) → ingest (diff labels) →
+    preprocess (extraction → features → vocab → shards with line-level
+    vuln labels) → cli fit/test. The r04 verdict noted the schema fixtures
+    were the only evidence the real corpus would flow — this drives the
+    whole path, not just the reader."""
+    import importlib
+    import sys as _sys
+    from pathlib import Path
+
+    import numpy as np
+
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    from deepdfa_tpu import utils
+
+    importlib.reload(utils)
+    from deepdfa_tpu.data.codegen import demo_corpus
+
+    # MSR-schema rows with generated-C bodies: vul rows carry real
+    # before/after pairs (line-diff labels), non-vul rows identical pairs
+    demo = demo_corpus(36, seed=5, style="hard")
+    base = {k: v for k, v in _msr_full_schema_df().iloc[0].to_dict().items()
+            if k not in ("func_before", "func_after", "vul")}
+    rows = []
+    for r in demo.itertuples():
+        rows.append(dict(
+            base, commit_id=f"d{r.id:07x}", func_before=r.before,
+            func_after=r.after if r.vul else r.before, vul=int(r.vul),
+            del_lines=len(r.removed), add_lines=len(r.added),
+        ))
+    df = pd.DataFrame(rows)
+    ext = utils.external_dir()
+    ext.mkdir(parents=True, exist_ok=True)
+    df.to_csv(ext / "MSR_data_cleaned.csv", index=True)
+
+    _sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+    import preprocess
+
+    summary = preprocess.main(["--dataset", "bigvul", "--workers", "1"])
+    assert summary["status"] == "ok"
+    assert summary["graphs"] >= 30 and summary["failed"] == 0
+
+    from deepdfa_tpu.train import cli
+
+    run_dir = tmp_path / "run"
+    overrides = ["--set", "data.dsname=bigvul", "--set", "optim.max_epochs=2",
+                 "--set", "model.hidden_dim=8", "--set", "model.n_steps=2",
+                 "--set", "model.num_output_layers=2"]
+    fit_out = cli.main(["fit", "--run-dir", str(run_dir), *overrides])
+    assert np.isfinite(fit_out["val_F1Score"])
+    res = cli.main(["test", "--run-dir", str(run_dir),
+                    "--ckpt-dir", str(run_dir / "checkpoints"), *overrides])
+    assert "test_F1Score" in res
+    # line-level labels: vul graphs mark a strict subset of nodes (NOT the
+    # devign broadcast)
+    from deepdfa_tpu.config import load_config
+
+    cfg = load_config(overrides={"data.dsname": "bigvul"})
+    corpus = cli.load_corpus(cfg)
+    vul_graphs = [g for part in corpus.values() for g in part
+                  if g.node_feats["_VULN"].max() > 0]
+    assert vul_graphs
+    assert any(g.node_feats["_VULN"].min() == 0 for g in vul_graphs)
