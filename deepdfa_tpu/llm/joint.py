@@ -85,6 +85,9 @@ class JointConfig:
     # the ``flowgnn_encoder`` subtree (``main_cli.py:136-145``'s
     # freeze_graph_weights).
     train_llm: bool = False
+    # host→device prefetch depth for the join+transfer pipeline (the
+    # DataLoader-worker analogue, data/prefetch.py); 0 disables
+    prefetch: int = 2
     freeze_gnn: bool = False
 
     @property
@@ -350,8 +353,15 @@ class JointTrainer:
             )
             points = eval_points(n_batches, epoch, cfg)
             tr_loss, tr_num = 0.0, 0
-            for step, tb in enumerate(batches):
-                jb = self._joined(tb)
+            # overlap the host-side graph join + H2D transfer with the
+            # running step (prefetch_to_device; the index-join per batch is
+            # real host work — the reference hides it in DataLoader workers)
+            from deepdfa_tpu.data.prefetch import prefetch_to_device
+
+            joined = prefetch_to_device(
+                (self._joined(tb) for tb in batches), size=cfg.prefetch
+            )
+            for step, jb in enumerate(joined):
                 if self._steps is None or state is None:
                     built = self._build(
                         n_batches, jb,
