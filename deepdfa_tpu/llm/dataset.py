@@ -285,10 +285,22 @@ class GraphJoin:
             gid=-1,
         )
 
+    def _lock(self):
+        # counters are bumped from the prefetch producer thread while the
+        # consumer may run eval joins concurrently (llm/joint.py) — unsynced
+        # += would drop increments; lazy so dataclass replace/pickle work
+        import threading
+
+        lock = getattr(self, "_counter_lock", None)
+        if lock is None:
+            lock = self._counter_lock = threading.Lock()
+        return lock
+
     def join(self, batch: TextBatch) -> JoinedBatch:
         picked: list[Graph] = []
         found = np.zeros(batch.indices.shape[0], bool)
         placeholder = self._placeholder()
+        n_missing = 0
         for i, idx in enumerate(batch.indices):
             g = self.graphs.get(int(idx)) if batch.mask[i] else None
             if g is not None:
@@ -297,7 +309,7 @@ class GraphJoin:
             else:
                 picked.append(placeholder)
                 if batch.mask[i]:
-                    self.num_missing += 1
+                    n_missing += 1
         b = len(picked)
         if self.layout == "dense":
             from deepdfa_tpu.data.dense import batch_dense
@@ -308,14 +320,19 @@ class GraphJoin:
             # blowing every batch's n² adjacency up to the store's single
             # largest outlier. Budget: store p99, capped by max_nodes.
             npg = self._dense_npg()
+            n_oversize = 0
             for i, g in enumerate(picked):
                 if g.n_nodes > npg:
                     picked[i] = placeholder
                     found[i] = False
-                    self.num_oversize += 1
+                    n_oversize += 1
+            with self._lock():
+                self.num_oversize += n_oversize
             graphs = batch_dense(picked, b, npg)
         else:
             graphs = batch_np(picked, b + 1, self.max_nodes, self.max_edges)
+        with self._lock():
+            self.num_missing += n_missing
         return JoinedBatch(text=batch, graphs=graphs, mask=batch.mask & found)
 
     def _dense_npg(self) -> int:

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from deepdfa_tpu.data.prefetch import prefetch_to_device
 from deepdfa_tpu.llm.dataset import GraphJoin, JoinedBatch, TextExamples, text_batches
 from deepdfa_tpu.llm.fusion import FusionModel, fusion_loss
 from deepdfa_tpu.llm.llama import LlamaModel
@@ -86,8 +87,11 @@ class JointConfig:
     # freeze_graph_weights).
     train_llm: bool = False
     # host→device prefetch depth for the join+transfer pipeline (the
-    # DataLoader-worker analogue, data/prefetch.py); 0 disables
-    prefetch: int = 2
+    # DataLoader-worker analogue, data/prefetch.py); 0 disables. Default 1
+    # (one staged + one in flight): joint graph batches can be dense
+    # adjacencies — hundreds of MB each — so deeper queues trade real HBM
+    # for overlap that one staged batch already buys
+    prefetch: int = 1
     freeze_gnn: bool = False
 
     @property
@@ -354,10 +358,8 @@ class JointTrainer:
             points = eval_points(n_batches, epoch, cfg)
             tr_loss, tr_num = 0.0, 0
             # overlap the host-side graph join + H2D transfer with the
-            # running step (prefetch_to_device; the index-join per batch is
-            # real host work — the reference hides it in DataLoader workers)
-            from deepdfa_tpu.data.prefetch import prefetch_to_device
-
+            # running step (the index-join per batch is real host work —
+            # the reference hides it in DataLoader workers)
             joined = prefetch_to_device(
                 (self._joined(tb) for tb in batches), size=cfg.prefetch
             )
