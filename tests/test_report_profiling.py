@@ -1,0 +1,59 @@
+"""scripts/report_profiling.py — the reference's profiling aggregation
+(report_profiling.py:24-66 parity: gflops/gmacs/ms per example over the
+jsonl artifacts the test CLI writes)."""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+
+def _write(dirpath: Path, name: str, rows):
+    (dirpath / name).write_text("\n".join(json.dumps(r) for r in rows))
+
+
+def test_report_aggregates_steady_state(tmp_path):
+    from deepdfa_tpu.train.profiling import report
+
+    _write(tmp_path, "profiledata.jsonl", [
+        {"batch": 1, "flops": 1e9, "macs": 5e8, "batch_size": 10, "warmup": True},
+        {"batch": 2, "flops": 2e9, "macs": 1e9, "batch_size": 10},
+        {"batch": 3, "flops": 2e9, "macs": 1e9, "batch_size": 10},
+    ])
+    _write(tmp_path, "timedata.jsonl", [
+        {"batch": 1, "ms": 100.0, "batch_size": 10, "warmup": True},
+        {"batch": 2, "ms": 10.0, "batch_size": 10},
+        {"batch": 3, "ms": 30.0, "batch_size": 10},
+    ])
+    stats = report(tmp_path)
+    # warmup rows excluded: 4e9 flops over 20 examples
+    assert abs(stats["gflops_per_example"] - 0.2) < 1e-9
+    assert abs(stats["gmacs_per_example"] - 0.1) < 1e-9
+    assert abs(stats["ms_per_example"] - 2.0) < 1e-9
+    assert abs(stats["examples_per_sec"] - 500.0) < 1e-6
+
+
+def test_report_warmup_only_falls_back(tmp_path):
+    from deepdfa_tpu.train.profiling import report
+
+    _write(tmp_path, "timedata.jsonl", [
+        {"batch": 1, "ms": 50.0, "batch_size": 5, "warmup": True},
+    ])
+    stats = report(tmp_path)
+    assert abs(stats["ms_per_example"] - 10.0) < 1e-9
+    assert "gflops_per_example" not in stats  # no profiledata file
+
+
+def test_script_main_prints_one_json_line_per_run(tmp_path, capsys):
+    import report_profiling
+
+    _write(tmp_path, "profiledata.jsonl", [
+        {"batch": 1, "flops": 1e9, "macs": 5e8, "batch_size": 4},
+    ])
+    report_profiling.main([str(tmp_path)])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    d = json.loads(out[0])
+    assert d["run_dir"] == str(tmp_path)
+    assert abs(d["gflops_per_example"] - 0.25) < 1e-9
