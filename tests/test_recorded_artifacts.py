@@ -33,3 +33,19 @@ def test_chain_rescue_recording():
         if key == "protocol":
             continue
         assert r["f1"] >= 0.95, (key, r)
+
+
+def test_dense_quality_recording():
+    """Round-5 dense-layout quality parity artifact: both layouts trained
+    end-to-end at the golden protocol reach the demo_hard quality band."""
+    import json
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent.parent / "storage/dense_quality_r05.json"
+    if not path.exists():
+        import pytest
+
+        pytest.skip("recorded artifact not present")
+    d = json.loads(path.read_text())
+    assert d["segment"]["f1"] >= 0.9
+    assert d["dense"]["f1"] >= 0.9
