@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -509,6 +510,10 @@ def _validate(name: str, graphs_per_sec, flops_per_step, real_graphs, roofline, 
     return round(graphs_per_sec, 1)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
 def _git_rev() -> str | None:
     """Code provenance for the artifact: which commit produced this number."""
     import os
@@ -585,8 +590,49 @@ def run_with_device_watchdog(
     env["_BENCH_CHILD"] = "1"
     timeout_s = float(os.environ.get("BENCH_TPU_TIMEOUT_S", "1500"))
     cmd = [sys.executable, script_path, *argv]
-    reason = None
 
+    # The child banks the artifact-so-far after every stage; if a late stage
+    # wedges the tunnel past the budget, we emit the partial TPU artifact
+    # instead of throwing measured chip numbers away for a CPU fallback.
+    # A private mkdtemp dir (not a guessable mktemp name on shared /tmp —
+    # another process could pre-plant a fake artifact there) + finally-
+    # cleanup so nothing leaks even when the child is SIGKILLed mid-bank.
+    import shutil
+    import tempfile
+    partial_dir = tempfile.mkdtemp(prefix="bench-partial-")
+    partial_path = os.path.join(partial_dir, "partial.json")
+    env["_BENCH_PARTIAL_PATH"] = partial_path
+
+    def _salvage(why: str, want_backend: str = "tpu") -> bool:
+        try:
+            with open(partial_path) as f:
+                partial = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return False
+        if partial.get("backend") != want_backend:
+            return False  # a partial CPU artifact is worth less than a full one
+        if want_backend == "tpu":
+            partial["tpu_incomplete"] = why
+        else:
+            # degraded-to-CPU artifacts are keyed on tpu_unavailable by
+            # consumers; the salvaged partial must carry it like the rest
+            partial["tpu_unavailable"] = why
+            partial["incomplete"] = why
+        print(json.dumps(partial))
+        return True
+
+    try:
+        return _watchdog_body(script_path, argv, fallback_argv, env, cmd,
+                              timeout_s, _salvage)
+    finally:
+        shutil.rmtree(partial_dir, ignore_errors=True)
+
+
+def _watchdog_body(script_path, argv, fallback_argv, env, cmd, timeout_s,
+                   _salvage) -> int:
+    import subprocess
+
+    reason = None
     # Cheap bounded probe BEFORE committing the full device budget: a dead
     # tunnel hangs init indefinitely, and burning timeout_s on the doomed
     # attempt can push the attempt+fallback total past the caller's own
@@ -621,7 +667,7 @@ def run_with_device_watchdog(
                       "(dead tunnel relay / wedged grant)")
         if reason is not None:
             return _fallback_cpu(script_path, argv, fallback_argv, env,
-                                 timeout_s, reason)
+                                 timeout_s, reason, _salvage)
     try:
         proc = subprocess.run(cmd, env=env, timeout=timeout_s,
                               stdout=subprocess.PIPE, text=True)
@@ -644,10 +690,14 @@ def run_with_device_watchdog(
     except subprocess.TimeoutExpired:
         reason = (f"device bench exceeded {timeout_s:.0f}s "
                   "(wedged tunnel grant hangs device init)")
-    return _fallback_cpu(script_path, argv, fallback_argv, env, timeout_s, reason)
+    if _salvage(reason):
+        return 0
+    return _fallback_cpu(script_path, argv, fallback_argv, env, timeout_s,
+                         reason, _salvage)
 
 
-def _fallback_cpu(script_path, argv, fallback_argv, env, timeout_s, reason) -> int:
+def _fallback_cpu(script_path, argv, fallback_argv, env, timeout_s, reason,
+                  _salvage=None) -> int:
     """Re-run on CPU with the tunnel env dropped; emit the labelled artifact."""
     import subprocess
 
@@ -661,6 +711,11 @@ def _fallback_cpu(script_path, argv, fallback_argv, env, timeout_s, reason) -> i
               *(fallback_argv if fallback_argv is not None else argv)]
 
     def _failed(why: str, rc=None) -> int:
+        # the fallback child banks stages too — a partial CPU artifact on
+        # disk beats the null bench_failed marker when no full one is coming
+        if _salvage is not None and _salvage(f"{reason}; then {why}",
+                                             want_backend="cpu"):
+            return 0
         print(json.dumps({"metric": "bench_failed", "value": None,
                           "unit": None, "vs_baseline": None,
                           "tpu_unavailable": reason,
@@ -689,72 +744,19 @@ def _fallback_cpu(script_path, argv, fallback_argv, env, timeout_s, reason) -> i
     return 0
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--chain", type=int, default=128,
-                    help="k batches per chained-scan dispatch (headline)")
-    ap.add_argument("--baseline-steps", type=int, default=20)
-    ap.add_argument("--batches", type=int, default=4)
-    ap.add_argument("--skip-baseline", action="store_true")
-    args = ap.parse_args()
+def _assemble_result(backend, device_kind, roofline, occupancy, real_graphs,
+                     chained, dense=None, dense_real=None, dense_occ=None,
+                     dense_dropped=None, dense_error=None, chained_train=None,
+                     strict=None, peak_runs=None, peak_errors=None,
+                     base_gps=None):
+    """Build the ONE-line artifact from whatever stages have completed.
 
-    from deepdfa_tpu.config import FeatureConfig
-
-    _progress("building corpus batches (host)")
-    # one corpus sized for the largest consumer (superbatch-2048 peak, or a
-    # bigger-than-default --batches request)
-    corpus = build_corpus(
-        max(int(2 * 2048 * 1.5), int(args.batches * 256 * 1.5 * 2)),
-        FeatureConfig().input_dim,
-    )
-    batches, occupancy = build_batches(corpus, args.batches)
-    real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
-
-    backend, device_kind = _init_backend_with_retry()
-    _progress(f"backend={backend} device_kind={device_kind}; measuring roofline")
-    roofline = measure_roofline()
-    _progress(f"roofline {roofline / 1e12:.1f} TFLOP/s; chained inference (k={args.chain})")
-    chained = bench_chained(batches, args.chain, train=False)
-    _progress(f"chained: {chained['graphs_per_sec']:.0f} g/s; dense-adjacency chained")
-    dense = dense_occ = dense_real = None
-    dense_error = dense_dropped = None
-    try:
-        dense_groups, dense_occ, dense_dropped = build_dense_batches(
-            corpus, args.batches
-        )
-        dense = bench_chained_dense(dense_groups, args.chain)
-        dense_real = dense["graphs_per_step"]
-        _progress(f"dense: {dense['graphs_per_sec']:.0f} g/s "
-                  f"(shapes {dense['shapes']}); chained train")
-    except Exception as e:  # recorded verbatim, never swallowed
-        dense_error = f"{type(e).__name__}: {e}"
-        _progress(f"dense path failed: {dense_error}; chained train")
-    chained_train = bench_chained(batches, max(args.chain // 4, 8), train=True)
-    _progress("single-dispatch strict/pipelined")
-    strict = bench_jax(batches, args.steps, train=False)
-
-    # Peak throughput at superbatches: same model, larger static batches —
-    # bigger kernels per dispatch, higher arithmetic intensity. Failures are
-    # recorded per size, never swallowed.
-    peak_runs: dict[str, tuple] = {}
-    peak_errors: dict[str, str] = {}
-    for bg in (1024, 2048):
-        _progress(f"superbatch-{bg} peak")
-        try:
-            peak_batches, _ = build_batches(corpus, 2, batch_graphs=bg)
-            pr = float(np.mean([int(b.graph_mask.sum()) for b in peak_batches]))
-            peak_runs[str(bg)] = (
-                bench_chained(peak_batches, max(args.chain // 4, 8), train=False),
-                pr,
-            )
-        except Exception as e:  # recorded verbatim in the artifact
-            peak_errors[str(bg)] = f"{type(e).__name__}: {e}"
-
-    _progress("torch-cpu baseline (skipped)" if args.skip_baseline
-              else "torch-cpu baseline")
-    base_gps = None if args.skip_baseline else bench_torch_cpu(batches, args.baseline_steps)
-
+    Callable mid-run: ``main`` banks the artifact-so-far after every stage
+    (``_BENCH_PARTIAL_PATH``) so the process watchdog can salvage a partial
+    TPU artifact when a later stage wedges the tunnel, instead of discarding
+    measured TPU numbers for a CPU fallback."""
+    peak_runs = peak_runs or {}
+    peak_errors = peak_errors or {}
     refused: dict[str, str] = {}
     seg_value = _validate("segment_graphs_per_sec", chained["graphs_per_sec"],
                           chained["flops_per_step"], real_graphs, roofline, refused)
@@ -777,10 +779,13 @@ def main():
             chained["flops_per_step"] / real_graphs
             if chained["flops_per_step"] else None
         )
-    train_gps = _validate("train_graphs_per_sec", chained_train["graphs_per_sec"],
-                          chained_train["flops_per_step"], real_graphs, roofline, refused)
-    strict_gps = _validate("strict_graphs_per_sec", strict["graphs_per_sec"],
-                           strict["flops_per_step"], real_graphs, roofline, refused)
+    train_gps = strict_gps = None
+    if chained_train is not None:
+        train_gps = _validate("train_graphs_per_sec", chained_train["graphs_per_sec"],
+                              chained_train["flops_per_step"], real_graphs, roofline, refused)
+    if strict is not None:
+        strict_gps = _validate("strict_graphs_per_sec", strict["graphs_per_sec"],
+                               strict["flops_per_step"], real_graphs, roofline, refused)
     peak_by_size: dict[str, float | None] = {}
     for bg, (p, pr) in peak_runs.items():
         peak_by_size[bg] = _validate(f"peak_batch{bg}_graphs_per_sec",
@@ -852,10 +857,14 @@ def main():
         "padding_efficiency": {k: round(v, 3) for k, v in occupancy.items()},
         "graphs_per_batch": round(real_graphs, 1),
         "strict_graphs_per_sec": strict_gps,
-        "strict_step_ms": round(strict["step_ms"], 3),
-        "pipelined_graphs_per_sec": round(strict["pipelined_graphs_per_sec"], 1),
+        "strict_step_ms": round(strict["step_ms"], 3) if strict else None,
+        "pipelined_graphs_per_sec": (
+            round(strict["pipelined_graphs_per_sec"], 1) if strict else None
+        ),
         "train_graphs_per_sec": train_gps,
-        "train_step_ms": round(chained_train["step_ms"], 3),
+        "train_step_ms": (
+            round(chained_train["step_ms"], 3) if chained_train else None
+        ),
         "peak_superbatch_graphs_per_sec": peak_gps,
         "peak_by_batch": peak_by_size or None,
         "peak_errors": peak_errors or None,
@@ -881,6 +890,129 @@ def main():
         "config": "hidden32_steps5_concat4_batch256",
         "git_rev": _git_rev(),
     }
+    return result
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--chain", type=int, default=128,
+                    help="k batches per chained-scan dispatch (headline)")
+    ap.add_argument("--baseline-steps", type=int, default=20)
+    ap.add_argument("--batches", type=int, default=4)
+    ap.add_argument("--skip-baseline", action="store_true")
+    ap.add_argument("--layout", choices=("both", "segment", "dense"),
+                    default="both",
+                    help="segment: skip the dense-adjacency stage; dense: "
+                    "roofline + segment anchor + dense only (no train/"
+                    "strict/superbatch/baseline). Lets an operator bank the "
+                    "segment artifact before risking the dense compile on a "
+                    "flaky tunnel - a wedged dense stage once cost a whole "
+                    "healthy-window artifact (round 5).")
+    args = ap.parse_args()
+    dense_focus = args.layout == "dense"
+
+    from deepdfa_tpu.config import FeatureConfig
+
+    _progress("building corpus batches (host)")
+    # corpus sized for the largest consumer among the stages this --layout
+    # actually runs (dense focus skips the superbatch peaks, so the quick
+    # risky-window run doesn't pay their host-side corpus construction)
+    n_corpus = (int(args.batches * 256 * 1.5 * 2) if dense_focus
+                else max(int(2 * 2048 * 1.5), int(args.batches * 256 * 1.5 * 2)))
+    corpus = build_corpus(n_corpus, FeatureConfig().input_dim)
+    batches, occupancy = build_batches(corpus, args.batches)
+    real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
+
+    backend, device_kind = _init_backend_with_retry()
+    _progress(f"backend={backend} device_kind={device_kind}; measuring roofline")
+    roofline = measure_roofline()
+    _progress(f"roofline {roofline / 1e12:.1f} TFLOP/s; chained inference (k={args.chain})")
+    chained = bench_chained(batches, args.chain, train=False)
+    _progress(f"chained: {chained['graphs_per_sec']:.0f} g/s")
+    dense = dense_occ = dense_real = None
+    dense_error = dense_dropped = None
+    chained_train = strict = None
+    peak_runs: dict[str, tuple] = {}
+    peak_errors: dict[str, str] = {}
+    base_gps = None
+
+    partial_path = os.environ.get("_BENCH_PARTIAL_PATH")
+
+    def bank(stage: str) -> None:
+        """Atomically persist the artifact-so-far. The process watchdog
+        emits it if a later stage wedges the tunnel, instead of discarding
+        measured TPU numbers for a CPU fallback (the round-5 dense-stage
+        wedge cost exactly that: segment 76.6k g/s measured on the chip,
+        artifact lost to the 1500s budget)."""
+        if not partial_path:
+            return
+        r = _assemble_result(
+            backend, device_kind, roofline, occupancy, real_graphs, chained,
+            dense, dense_real, dense_occ, dense_dropped, dense_error,
+            chained_train, strict, peak_runs, peak_errors, base_gps)
+        r["partial_through_stage"] = stage
+        tmp = partial_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(r, f)
+        os.replace(tmp, partial_path)
+
+    bank("chained")
+    if not dense_focus:
+        _progress("chained train")
+        chained_train = bench_chained(batches, max(args.chain // 4, 8), train=True)
+        bank("train")
+        _progress("single-dispatch strict/pipelined")
+        strict = bench_jax(batches, args.steps, train=False)
+        bank("strict")
+
+    # Peak throughput at superbatches: same model, larger static batches -
+    # bigger kernels per dispatch, higher arithmetic intensity. Failures are
+    # recorded per size, never swallowed.
+    for bg in () if dense_focus else (1024, 2048):
+        _progress(f"superbatch-{bg} peak")
+        try:
+            peak_batches, _ = build_batches(corpus, 2, batch_graphs=bg)
+            pr = float(np.mean([int(b.graph_mask.sum()) for b in peak_batches]))
+            peak_runs[str(bg)] = (
+                bench_chained(peak_batches, max(args.chain // 4, 8), train=False),
+                pr,
+            )
+        except Exception as e:  # recorded verbatim in the artifact
+            peak_errors[str(bg)] = f"{type(e).__name__}: {e}"
+        bank(f"superbatch-{bg}")
+
+    skip_base = args.skip_baseline or dense_focus
+    _progress("torch-cpu baseline (skipped)" if skip_base
+              else "torch-cpu baseline")
+    base_gps = None if skip_base else bench_torch_cpu(batches, args.baseline_steps)
+    if not skip_base:
+        bank("baseline")
+
+    # Dense-adjacency LAST: it is the wedge-prone stage (per-shape compiles
+    # of the n^2 forward through the tunnel) - everything above is already
+    # banked if it takes the tunnel down.
+    if args.layout == "segment":
+        dense_error = "skipped (--layout segment)"
+    else:
+        _progress("dense-adjacency chained")
+        try:
+            dense_groups, dense_occ, dense_dropped = build_dense_batches(
+                corpus, args.batches
+            )
+            dense = bench_chained_dense(dense_groups, args.chain)
+            dense_real = dense["graphs_per_step"]
+            _progress(f"dense: {dense['graphs_per_sec']:.0f} g/s "
+                      f"(shapes {dense['shapes']})")
+        except Exception as e:  # recorded verbatim, never swallowed
+            dense_error = f"{type(e).__name__}: {e}"
+            _progress(f"dense path failed: {dense_error}")
+        bank("dense")
+
+    result = _assemble_result(
+        backend, device_kind, roofline, occupancy, real_graphs, chained,
+        dense, dense_real, dense_occ, dense_dropped, dense_error,
+        chained_train, strict, peak_runs, peak_errors, base_gps)
     print(json.dumps(result))
 
 

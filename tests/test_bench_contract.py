@@ -199,3 +199,86 @@ def test_watchdog_probe_short_circuits_dead_tunnel(tmp_path, monkeypatch):
     assert rc == 0
     assert out["backend"] == "cpu"
     assert "probe exceeded" in out["tpu_unavailable"]
+
+
+def test_watchdog_salvages_partial_tpu_artifact(tmp_path, monkeypatch):
+    """A child that banked TPU stages before wedging past the budget must
+    yield the partial TPU artifact, not a CPU fallback — the round-5 dense
+    wedge threw away a measured 76.6k g/s segment headline exactly this way."""
+    monkeypatch.setenv("BENCH_DEVICE_PROBE_TIMEOUT_S", "0")
+    monkeypatch.setenv("BENCH_TPU_TIMEOUT_S", "3")
+    import contextlib
+    import io
+    import json
+
+    fake = tmp_path / "fake_bench.py"
+    fake.write_text(
+        "import json, os, time\n"
+        "p = os.environ['_BENCH_PARTIAL_PATH']\n"
+        "with open(p + '.tmp', 'w') as f:\n"
+        "    json.dump({'metric': 'm', 'value': 76580.0, 'unit': 'u',\n"
+        "               'vs_baseline': None, 'backend': 'tpu',\n"
+        "               'partial_through_stage': 'chained'}, f)\n"
+        "os.replace(p + '.tmp', p)\n"
+        "time.sleep(60)\n"  # wedged dense stage
+    )
+    monkeypatch.setattr(bench, "_progress", lambda *_: None)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = bench.run_with_device_watchdog(str(fake), [])
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rc == 0
+    assert out["backend"] == "tpu" and out["value"] == 76580.0
+    assert out["partial_through_stage"] == "chained"
+    assert "exceeded" in out["tpu_incomplete"]
+
+
+def test_watchdog_prefers_full_cpu_artifact_over_partial_cpu(tmp_path, monkeypatch):
+    """A partial CPU artifact is worth less than the complete CPU fallback:
+    salvage applies only to backend=tpu partials."""
+    monkeypatch.setenv("BENCH_DEVICE_PROBE_TIMEOUT_S", "0")
+    monkeypatch.setenv("BENCH_TPU_TIMEOUT_S", "3")
+    import contextlib
+    import io
+    import json
+
+    fake = tmp_path / "fake_bench.py"
+    fake.write_text(
+        "import json, os, time\n"
+        "if os.environ.get('JAX_PLATFORMS') == 'cpu' \\\n"
+        "        and 'PALLAS_AXON_POOL_IPS' not in os.environ:\n"
+        "    print(json.dumps({'metric': 'm', 'value': 1.0, 'unit': 'u',\n"
+        "                      'vs_baseline': 0.7, 'backend': 'cpu'}))\n"
+        "else:\n"
+        "    p = os.environ['_BENCH_PARTIAL_PATH']\n"
+        "    with open(p, 'w') as f:\n"
+        "        json.dump({'metric': 'm', 'value': 2.0, 'backend': 'cpu',\n"
+        "                   'partial_through_stage': 'chained'}, f)\n"
+        "    time.sleep(60)\n"
+    )
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")  # simulated tunnel
+    monkeypatch.setattr(bench, "_progress", lambda *_: None)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = bench.run_with_device_watchdog(str(fake), [])
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rc == 0
+    assert out["backend"] == "cpu" and out["value"] == 1.0
+    assert "tpu_unavailable" in out
+
+
+def test_layout_segment_skips_dense_stage():
+    """--layout segment must record the skip verbatim so the artifact says
+    why the dense column is null."""
+    res = bench._assemble_result(
+        "tpu", "TPU v5 lite", 169.5e12, {"nodes": 0.8, "edges": 0.8},
+        243.0,
+        {"graphs_per_sec": 76580.0, "flops_per_step": 1e9, "k": 128,
+         "step_ms": 3.2, "wall_s": 0.4},
+        dense_error="skipped (--layout segment)",
+    )
+    assert res["layout"] == "segment"
+    assert res["dense_graphs_per_sec"] is None
+    assert res["dense_error"] == "skipped (--layout segment)"
+    assert res["segment_graphs_per_sec"] == 76580.0
+    assert res["strict_graphs_per_sec"] is None  # not measured, not faked
