@@ -33,10 +33,15 @@ snapshot() {
 }
 
 run_one() {  # run_one <name> <timeout_s> <cmd...>
+  # The outer budget must exceed the wrapper's own TPU budget + CPU
+  # fallback (BENCH_TPU_TIMEOUT_S each) or a timeout here kills the
+  # wrapper mid-fallback and its finally-cleanup destroys the banked
+  # partial before salvage can emit it.
   local name=$1 budget=$2; shift 2
   [ -s "$ART/$name.json" ] && grep -q '"backend": "tpu"' "$ART/$name.json" && return 0
   log "running $name: $*"
-  ( cd "$SNAP" && timeout "$budget" "$@" >"$ART/$name.json" 2>>"$ART/$name.log" )
+  ( cd "$SNAP" && BENCH_TPU_TIMEOUT_S=2000 timeout "$budget" "$@" \
+      >"$ART/$name.json" 2>>"$ART/$name.log" )
   local rc=$?
   log "$name exited rc=$rc"
   return $rc
@@ -50,11 +55,11 @@ while true; do
     # Order: bank the safe segment artifact first; the dense stage wedged
     # the relay once this round, so it runs LAST (and bench.py now banks
     # partials per stage regardless).
-    run_one bench_ggnn_segment  2400 python bench.py --layout segment
-    run_one bench_int8_prefill  2400 python scripts/bench_int8_llm.py
-    run_one bench_int8_decode   2400 python scripts/bench_int8_llm.py --decode 128 --batch 8
-    run_one bench_llm_qlora     3600 python bench_llm.py
-    run_one bench_ggnn_dense    2400 python bench.py --layout dense
+    run_one bench_ggnn_segment  4500 python bench.py --layout segment
+    run_one bench_int8_prefill  4500 python scripts/bench_int8_llm.py
+    run_one bench_int8_decode   4500 python scripts/bench_int8_llm.py --decode 128 --batch 8
+    run_one bench_llm_qlora     4500 python bench_llm.py
+    run_one bench_ggnn_dense    4500 python bench.py --layout dense
     # all captured on tpu? then drop to slow heartbeat
     ok=1
     for n in bench_ggnn_segment bench_int8_prefill bench_int8_decode bench_llm_qlora bench_ggnn_dense; do
