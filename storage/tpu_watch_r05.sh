@@ -38,7 +38,10 @@ run_one() {  # run_one <name> <timeout_s> <cmd...>
   # wrapper mid-fallback and its finally-cleanup destroys the banked
   # partial before salvage can emit it.
   local name=$1 budget=$2; shift 2
-  [ -s "$ART/$name.json" ] && grep -q '"backend": "tpu"' "$ART/$name.json" && return 0
+  # a REPLAYED banked artifact (bench.py's dead-tunnel fallback) must not
+  # mark a stage complete — only a fresh on-chip measurement does
+  [ -s "$ART/$name.json" ] && grep -q '"backend": "tpu"' "$ART/$name.json" \
+    && ! grep -q '"replayed_from_banked"' "$ART/$name.json" && return 0
   log "running $name: $*"
   ( cd "$SNAP" && BENCH_TPU_TIMEOUT_S=2000 timeout "$budget" "$@" \
       >"$ART/$name.json" 2>>"$ART/$name.log" )
@@ -63,7 +66,8 @@ while true; do
     # all captured on tpu? then drop to slow heartbeat
     ok=1
     for n in bench_ggnn_segment bench_int8_prefill bench_int8_decode bench_llm_qlora bench_ggnn_dense; do
-      { [ -s "$ART/$n.json" ] && grep -q '"backend": "tpu"' "$ART/$n.json"; } || ok=0
+      { [ -s "$ART/$n.json" ] && grep -q '"backend": "tpu"' "$ART/$n.json" \
+        && ! grep -q '"replayed_from_banked"' "$ART/$n.json"; } || ok=0
     done
     if [ "$ok" = 1 ]; then log "battery complete (all tpu); watcher idle"; sleep 3600; fi
   else
