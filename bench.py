@@ -775,8 +775,16 @@ def _banked_ggnn_artifacts() -> list[tuple[float, str, dict]]:
                                          "tpu_artifacts_r*")))
     if not dirs:
         return []
+    # Freshness window: "newest dir" only identifies the current round if
+    # the current round's dir exists — at a round boundary, before the new
+    # watcher arms, the newest dir on disk is the PREVIOUS round's. An age
+    # cutoff (default 24h, > a round, < two) makes stale-round replay
+    # impossible regardless of dir-creation ordering.
+    max_age_s = float(os.environ.get("BENCH_BANKED_MAX_AGE_H", "24")) * 3600
     out = []
     for p in glob.glob(os.path.join(dirs[-1], "bench_ggnn*.json")):
+        if time.time() - os.path.getmtime(p) > max_age_s:
+            continue
         try:
             with open(p) as f:
                 art = json.load(f)
@@ -857,6 +865,19 @@ def replay_banked(reason: str) -> bool:
                 if k in den[2]:
                     result[k] = den[2][k]
             sources.append(_src(den))
+    # The torch-CPU baseline is host-side and workload-anchored (config),
+    # not a device measurement — if the base artifact is a salvaged partial
+    # that wedged before the baseline stage, adopt it from any banked
+    # candidate of the same workload rather than shipping a null column.
+    if not result.get("baseline_graphs_per_sec"):
+        for c in reversed(cands):
+            if (c[2].get("baseline_graphs_per_sec")
+                    and c[2].get("config") == result.get("config")):
+                result["baseline_graphs_per_sec"] = c[2]["baseline_graphs_per_sec"]
+                if all(s["path"] != os.path.relpath(c[1], _banked_root())
+                       for s in sources):
+                    sources.append(_src(c))
+                break
     # Re-derive the headline over the merged pair. graphs/step is
     # recoverable exactly as rate × step time (both measured in the same
     # run), so per-graph FLOPs — and hence implied TFLOP/s and the MFU and
@@ -876,12 +897,16 @@ def replay_banked(reason: str) -> bool:
         den_fpg = (result["dense_flops_per_step"] / gps_step
                    if (result.get("dense_flops_per_step") and gps_step)
                    else None)
-        # the merged headline passes the same refusal gate fresh results do
+        # the merged headline passes the same refusal gate fresh results
+        # do — and per the refusal contract, a refused metric is reported
+        # as NULL (publishing a number the artifact itself calls a timing
+        # artifact would be self-contradicting)
         if (den_fpg and roof
                 and den_v * den_fpg > roof * 1e12):
             refused["replayed_dense_graphs_per_sec"] = (
                 f"implied {den_v * den_fpg / 1e12:.1f} TFLOP/s > banked "
                 f"roofline {roof:.1f} TFLOP/s")
+            result["dense_graphs_per_sec"] = None
         else:
             value, layout, fpg = den_v, "dense_adjacency", den_fpg
     result["value"], result["layout"] = value, layout
@@ -1102,6 +1127,16 @@ def main():
         os.replace(tmp, partial_path)
 
     bank("chained")
+    # Torch-CPU baseline EARLY: it never touches the device (pure host
+    # compute), so running it before the wedge-prone device stages means
+    # every salvaged partial from here on carries a non-null vs_baseline —
+    # a late-stage tunnel wedge must not cost the one-number comparison.
+    skip_base = args.skip_baseline or dense_focus
+    _progress("torch-cpu baseline (skipped)" if skip_base
+              else "torch-cpu baseline")
+    base_gps = None if skip_base else bench_torch_cpu(batches, args.baseline_steps)
+    if not skip_base:
+        bank("baseline")
     if not dense_focus:
         _progress("chained train")
         chained_train = bench_chained(batches, max(args.chain // 4, 8), train=True)
@@ -1125,13 +1160,6 @@ def main():
         except Exception as e:  # recorded verbatim in the artifact
             peak_errors[str(bg)] = f"{type(e).__name__}: {e}"
         bank(f"superbatch-{bg}")
-
-    skip_base = args.skip_baseline or dense_focus
-    _progress("torch-cpu baseline (skipped)" if skip_base
-              else "torch-cpu baseline")
-    base_gps = None if skip_base else bench_torch_cpu(batches, args.baseline_steps)
-    if not skip_base:
-        bank("baseline")
 
     # Dense-adjacency LAST: it is the wedge-prone stage (per-shape compiles
     # of the n^2 forward through the tunnel) - everything above is already

@@ -43,7 +43,11 @@ run_one() {  # run_one <name> <timeout_s> <cmd...>
   [ -s "$ART/$name.json" ] && grep -q '"backend": "tpu"' "$ART/$name.json" \
     && ! grep -q '"replayed_from_banked"' "$ART/$name.json" && return 0
   log "running $name: $*"
-  ( cd "$SNAP" && BENCH_TPU_TIMEOUT_S=2000 timeout "$budget" "$@" \
+  # BENCH_BANKED_ROOT=/nonexistent: battery children must MEASURE, never
+  # replay — a wedged stage replaying committed artifacts from the snapshot
+  # would masquerade as a fresh measurement in the stage file
+  ( cd "$SNAP" && BENCH_TPU_TIMEOUT_S=2000 BENCH_BANKED_ROOT=/nonexistent \
+      timeout "$budget" "$@" \
       >"$ART/$name.json" 2>>"$ART/$name.log" )
   local rc=$?
   log "$name exited rc=$rc"

@@ -419,3 +419,39 @@ def test_replay_banked_refuses_over_roofline_dense(tmp_path, monkeypatch,
     out = json.loads(capsys.readouterr().out.strip())
     assert out["layout"] == "segment" and out["value"] == 76580.0
     assert "replayed_dense_graphs_per_sec" in out["refused"]
+    assert out["dense_graphs_per_sec"] is None  # refused ⇒ reported null
+
+
+def test_replay_banked_backfills_baseline_from_sibling(tmp_path, monkeypatch,
+                                                       capsys):
+    """A salvaged partial that wedged before the baseline stage must not
+    ship a null vs_baseline when a sibling banked run of the same workload
+    measured the host-side torch baseline."""
+    monkeypatch.setenv("BENCH_BANKED_ROOT", str(tmp_path))
+    _banked(tmp_path, "bench_ggnn_segment",
+            {**_SEG_ART, "baseline_graphs_per_sec": None,
+             "vs_baseline": None, "partial_through_stage": "superbatch-1024"})
+    _banked(tmp_path, "bench_ggnn_dense",
+            {**_SEG_ART, "segment_graphs_per_sec": 75000.0})
+    assert bench.replay_banked("dead tunnel") is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 76580.0  # partial's fresher/faster headline wins
+    assert out["baseline_graphs_per_sec"] == 877.7  # adopted from sibling
+    assert out["vs_baseline"] == round(76580.0 / 877.7, 2)
+    assert "partial_through_stage" not in out
+
+
+def test_replay_banked_skips_stale_artifacts(tmp_path, monkeypatch, capsys):
+    """At a round boundary the newest dir on disk may be the PREVIOUS
+    round's; the age cutoff keeps those from replaying as this round's."""
+    import os
+    import time as _time
+
+    monkeypatch.setenv("BENCH_BANKED_ROOT", str(tmp_path))
+    _banked(tmp_path, "bench_ggnn_segment", _SEG_ART)
+    p = (tmp_path / "storage" / "tpu_artifacts_r99"
+         / "bench_ggnn_segment.json")
+    stale = _time.time() - 25 * 3600
+    os.utime(p, (stale, stale))
+    assert bench.replay_banked("dead tunnel") is False
+    assert capsys.readouterr().out == ""
