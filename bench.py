@@ -783,12 +783,16 @@ def _banked_ggnn_artifacts() -> list[tuple[float, str, dict]]:
     max_age_s = float(os.environ.get("BENCH_BANKED_MAX_AGE_H", "24")) * 3600
     out = []
     for p in glob.glob(os.path.join(dirs[-1], "bench_ggnn*.json")):
-        if time.time() - os.path.getmtime(p) > max_age_s:
-            continue
         try:
             with open(p) as f:
                 art = json.load(f)
         except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        # prefer the embedded emission stamp: a fresh checkout resets file
+        # mtimes to now, which would un-stale a committed prior-round
+        # artifact exactly at the round boundary this window guards
+        age_anchor = art.get("emitted_at_unix") or os.path.getmtime(p)
+        if time.time() - age_anchor > max_age_s:
             continue
         if (art.get("backend") == "tpu"
                 and art.get("metric") == "ggnn_inference_graphs_per_sec"
@@ -1058,11 +1062,24 @@ def _assemble_result(backend, device_kind, roofline, occupancy, real_graphs,
         ),
         "config": "hidden32_steps5_concat4_batch256",
         "git_rev": _git_rev(),
+        # wall-clock provenance: file mtimes reset on checkout/clone, so
+        # the replay freshness window reads this embedded stamp instead
+        "emitted_at_unix": int(time.time()),
     }
     return result
 
 
-def main():
+def _peak_list(spec: str) -> tuple:
+    """argparse type for ``--peak-batches``: a malformed value must exit 2
+    (usage error) — an rc=1 crash inside the child reads as device trouble
+    to the watchdog, which would mask the typo with a replay/CPU fallback."""
+    try:
+        return tuple(int(s) for s in spec.split(",") if s.strip())
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+
+
+def _build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--chain", type=int, default=128,
@@ -1070,6 +1087,12 @@ def main():
     ap.add_argument("--baseline-steps", type=int, default=20)
     ap.add_argument("--batches", type=int, default=4)
     ap.add_argument("--skip-baseline", action="store_true")
+    ap.add_argument("--peak-batches", type=_peak_list, default="1024,2048",
+                    help="comma-separated superbatch sizes for the peak "
+                    "stage ('' skips it). The 2048 superbatch is a ~113k-"
+                    "node unrolled compile — through a flaky tunnel it can "
+                    "outlive the whole stage budget (round 5 lost 28+ min "
+                    "to it), so batteries can run the safe sizes only.")
     ap.add_argument("--layout", choices=("both", "segment", "dense"),
                     default="both",
                     help="segment: skip the dense-adjacency stage; dense: "
@@ -1078,7 +1101,11 @@ def main():
                     "segment artifact before risking the dense compile on a "
                     "flaky tunnel - a wedged dense stage once cost a whole "
                     "healthy-window artifact (round 5).")
-    args = ap.parse_args()
+    return ap
+
+
+def main():
+    args = _build_parser().parse_args()
     dense_focus = args.layout == "dense"
 
     from deepdfa_tpu.config import FeatureConfig
@@ -1087,8 +1114,10 @@ def main():
     # corpus sized for the largest consumer among the stages this --layout
     # actually runs (dense focus skips the superbatch peaks, so the quick
     # risky-window run doesn't pay their host-side corpus construction)
+    peak_max = max(args.peak_batches, default=0)
     n_corpus = (int(args.batches * 256 * 1.5 * 2) if dense_focus
-                else max(int(2 * 2048 * 1.5), int(args.batches * 256 * 1.5 * 2)))
+                else max(int(2 * peak_max * 1.5),
+                         int(args.batches * 256 * 1.5 * 2)))
     corpus = build_corpus(n_corpus, FeatureConfig().input_dim)
     batches, occupancy = build_batches(corpus, args.batches)
     real_graphs = float(np.mean([int(b.graph_mask.sum()) for b in batches]))
@@ -1148,7 +1177,7 @@ def main():
     # Peak throughput at superbatches: same model, larger static batches -
     # bigger kernels per dispatch, higher arithmetic intensity. Failures are
     # recorded per size, never swallowed.
-    for bg in () if dense_focus else (1024, 2048):
+    for bg in () if dense_focus else args.peak_batches:
         _progress(f"superbatch-{bg} peak")
         try:
             peak_batches, _ = build_batches(corpus, 2, batch_graphs=bg)
@@ -1194,12 +1223,21 @@ if __name__ == "__main__":
     if os.environ.get("_BENCH_CHILD") == "1":
         main()
     else:
+        # Parse at the wrapper level FIRST: malformed args exit 2 here,
+        # before the watchdog could misread the child's crash as device
+        # trouble and mask it with a replay or CPU fallback.
+        _ns = _build_parser().parse_args(sys.argv[1:])
         # The CPU fallback KEEPS the torch-CPU baseline (few steps): an
         # artifact with a null vs_baseline column helps nobody, and on CPU
         # the same-semantics comparison is exactly where it's cheap (r04
         # shipped `vs_baseline: null` — judged as a regression vs r02).
+        # It also keeps the requested --layout (a segment-only battery run
+        # must not become a dense compile on one CPU core) and skips the
+        # superbatch peaks (device-sized compiles that would blow the same
+        # budget the TPU attempt just spent).
         raise SystemExit(run_with_device_watchdog(
             __file__, sys.argv[1:],
             fallback_argv=["--chain", "8", "--steps", "5", "--batches", "2",
-                           "--baseline-steps", "5"],
+                           "--baseline-steps", "5", "--peak-batches", "",
+                           "--layout", _ns.layout],
         ))

@@ -61,8 +61,10 @@ while true; do
     snapshot
     # Order: bank the safe segment artifact first; the dense stage wedged
     # the relay once this round, so it runs LAST (and bench.py now banks
-    # partials per stage regardless).
-    run_one bench_ggnn_segment  4500 python bench.py --layout segment
+    # partials per stage regardless). The 2048 superbatch compile hung a
+    # segment run for 28+ min this round — the battery runs the safe
+    # superbatch only; a full-peak run is an operator action.
+    run_one bench_ggnn_segment  4500 python bench.py --layout segment --peak-batches 1024
     run_one bench_int8_prefill  4500 python scripts/bench_int8_llm.py
     run_one bench_int8_decode   4500 python scripts/bench_int8_llm.py --decode 128 --batch 8
     run_one bench_llm_qlora     4500 python bench_llm.py

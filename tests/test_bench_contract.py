@@ -455,3 +455,32 @@ def test_replay_banked_skips_stale_artifacts(tmp_path, monkeypatch, capsys):
     os.utime(p, (stale, stale))
     assert bench.replay_banked("dead tunnel") is False
     assert capsys.readouterr().out == ""
+
+
+def test_replay_banked_staleness_uses_embedded_stamp(tmp_path, monkeypatch,
+                                                     capsys):
+    """A fresh checkout resets file mtimes — the embedded emission stamp
+    must govern, or a committed prior-round artifact un-stales itself at
+    exactly the round boundary the window guards."""
+    import time as _time
+
+    monkeypatch.setenv("BENCH_BANKED_ROOT", str(tmp_path))
+    _banked(tmp_path, "bench_ggnn_segment",
+            {**_SEG_ART, "emitted_at_unix": int(_time.time()) - 25 * 3600})
+    # file mtime is 'now' (just written), but the stamp says 25h ago
+    assert bench.replay_banked("dead tunnel") is False
+    assert capsys.readouterr().out == ""
+
+
+def test_peak_batches_usage_error_exits_2():
+    """A malformed --peak-batches must be a usage error (rc=2), which the
+    watchdog propagates — not an rc=1 crash it would mask as device
+    trouble with a replay or CPU fallback."""
+    with pytest.raises(SystemExit) as ei:
+        bench._build_parser().parse_args(["--peak-batches", "1024x2048"])
+    assert ei.value.code == 2
+    # and the default parses through the same type callable
+    ns = bench._build_parser().parse_args([])
+    assert ns.peak_batches == (1024, 2048)
+    assert bench._build_parser().parse_args(
+        ["--peak-batches", ""]).peak_batches == ()
