@@ -30,7 +30,15 @@ def full_protocol(args, out_dir: Path) -> dict:
     train DeepDFA+LineVul — here hermetically on the demo sample corpus
     (DeepDFA = GGNN fit/test; LineVul = roberta encoder only, no GNN;
     combined = roberta + frozen pretrained GGNN), with per-stage wall
-    times and test metrics."""
+    times and test metrics. Honors ``--runs`` (the reference repeats the
+    protocol 3×); ``stages``/``total_seconds`` quote the LAST run, every
+    run is in ``runs``. Banks the artifact-so-far after every stage
+    (``_BENCH_PARTIAL_PATH``) so a tunnel wedge mid-protocol salvages the
+    measured stages instead of discarding ~half an hour of chip time."""
+    import os
+
+    import jax
+
     import scripts.preprocess as pp
     import scripts.train_joint as tj
     from deepdfa_tpu.train import cli
@@ -38,51 +46,72 @@ def full_protocol(args, out_dir: Path) -> dict:
     # demo sample shards (idempotent)
     pp.main(["--dataset", "demo", "--n", "120", "--sample"])
 
-    stages = {}
-
-    def timed(name, fn):
-        t0 = time.monotonic()
-        out = fn()
-        stages[name] = {"seconds": round(time.monotonic() - t0, 2), **out}
-        print(json.dumps({name: stages[name]}), file=sys.stderr, flush=True)
-
-    ggnn_dir = out_dir / "deepdfa"
-    small = [x for o in (
-        "data.sample=true", "data.dsname=demo", "optim.max_epochs=3",
-    ) + tuple(args.overrides) for x in ("--set", o)]
-
-    def stage_deepdfa():
-        cli.main(["fit", "--run-dir", str(ggnn_dir), *small])
-        r = cli.main(["test", "--run-dir", str(ggnn_dir),
-                      "--ckpt-dir", str(ggnn_dir / "checkpoints"), *small])
-        return {"test_F1Score": r.get("test_F1Score")}
-
-    def stage_linevul():
-        r = tj.main(["--dataset", "demo", "--sample", "--encoder", "roberta",
-                     "--no_flowgnn", "--do_train", "--do_test", "--epochs", "2",
-                     "--output_dir", str(out_dir / "linevul")])
-        return {"test_f1_weighted": r.get("test_f1_weighted")}
-
-    def stage_combined():
-        r = tj.main(["--dataset", "demo", "--sample", "--encoder", "roberta",
-                     "--freeze-graph", str(ggnn_dir / "checkpoints"),
-                     "--do_train", "--do_test", "--epochs", "2",
-                     "--output_dir", str(out_dir / "combined")])
-        return {"test_f1_weighted": r.get("test_f1_weighted")}
-
-    timed("deepdfa", stage_deepdfa)
-    timed("linevul", stage_linevul)
-    timed("deepdfa_linevul", stage_combined)
-
-    import jax
-
+    runs: list[dict] = []
     agg = {
         "protocol": "full (train DeepDFA; train LineVul; train DeepDFA+LineVul "
                     "- performance_evaluation.sh parity, hermetic demo corpus)",
         "backend": jax.default_backend(),
-        "stages": stages,
-        "total_seconds": round(sum(s["seconds"] for s in stages.values()), 2),
+        "stages": None,
+        "total_seconds": None,
+        "runs": runs,
     }
+    partial_path = os.environ.get("_BENCH_PARTIAL_PATH")
+
+    def bank(stage_name: str) -> None:
+        if not partial_path:
+            return
+        snap = {**agg, "partial_through_stage": stage_name}
+        tmp = partial_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, partial_path)
+
+    for i in range(args.runs):
+        run_dir = out_dir / f"run_{i}" if args.runs > 1 else out_dir
+        stages: dict[str, dict] = {}
+        # wire the LIVE dict into the aggregate before the stages run, so a
+        # mid-run bank() snapshot carries the stages measured so far
+        agg["stages"] = stages
+        runs.append({"stages": stages, "total_seconds": None})
+
+        def timed(name, fn):
+            t0 = time.monotonic()
+            out = fn()
+            stages[name] = {"seconds": round(time.monotonic() - t0, 2), **out}
+            print(json.dumps({name: stages[name]}), file=sys.stderr, flush=True)
+            bank(f"run{i}:{name}")
+
+        ggnn_dir = run_dir / "deepdfa"
+        small = [x for o in (
+            "data.sample=true", "data.dsname=demo", "optim.max_epochs=3",
+        ) + tuple(args.overrides) for x in ("--set", o)]
+
+        def stage_deepdfa():
+            cli.main(["fit", "--run-dir", str(ggnn_dir), *small])
+            r = cli.main(["test", "--run-dir", str(ggnn_dir),
+                          "--ckpt-dir", str(ggnn_dir / "checkpoints"), *small])
+            return {"test_F1Score": r.get("test_F1Score")}
+
+        def stage_linevul():
+            r = tj.main(["--dataset", "demo", "--sample", "--encoder", "roberta",
+                         "--no_flowgnn", "--do_train", "--do_test",
+                         "--epochs", "2",
+                         "--output_dir", str(run_dir / "linevul")])
+            return {"test_f1_weighted": r.get("test_f1_weighted")}
+
+        def stage_combined():
+            r = tj.main(["--dataset", "demo", "--sample", "--encoder", "roberta",
+                         "--freeze-graph", str(ggnn_dir / "checkpoints"),
+                         "--do_train", "--do_test", "--epochs", "2",
+                         "--output_dir", str(run_dir / "combined")])
+            return {"test_f1_weighted": r.get("test_f1_weighted")}
+
+        timed("deepdfa", stage_deepdfa)
+        timed("linevul", stage_linevul)
+        timed("deepdfa_linevul", stage_combined)
+        total = round(sum(s["seconds"] for s in stages.values()), 2)
+        runs[-1]["total_seconds"] = agg["total_seconds"] = total
+
     (out_dir / "performance_evaluation.json").write_text(json.dumps(agg, indent=2))
     print(json.dumps(agg))
     return agg
@@ -226,9 +255,17 @@ if __name__ == "__main__":
         # fallback's checkpoints leak into this one's metrics
         fb_out = (utils.storage_dir() / "perf_eval_cpu_fallback"
                   / utils.get_run_id(["perf"]))
+        # the fallback keeps the requested PROTOCOL (a --protocol full run
+        # degrading to a ggnn-protocol artifact would record the wrong
+        # experiment under the full-protocol stage name) but pins the
+        # minimal sizes
+        _pp = argparse.ArgumentParser(add_help=False)
+        _pp.add_argument("--protocol", default="ggnn")
+        fb_protocol = _pp.parse_known_args(sys.argv[1:])[0].protocol
         raise SystemExit(run_with_device_watchdog(
             __file__, sys.argv[1:],
-            fallback_argv=["--runs", "1", "--out", str(fb_out),
+            fallback_argv=["--runs", "1", "--protocol", fb_protocol,
+                           "--out", str(fb_out),
                            "--set", "data.sample=true",
                            "--set", "optim.max_epochs=2"],
         ))

@@ -17,6 +17,11 @@ ART=$REPO/storage/tpu_artifacts_r05
 LOG=$REPO/storage/tpu_watch_r05.log
 SNAP=/tmp/tpu_watch_snapshot_r05
 mkdir -p "$ART"
+# ONE stage list: the run section and the completion check both iterate it
+# (a stage added to one but not the other once risked a false
+# "battery complete")
+STAGES=(bench_ggnn_segment bench_int8_prefill bench_int8_decode
+        bench_llm_qlora bench_ggnn_dense perf_eval_full)
 log() { echo "[$(date -u +%H:%M:%S)] $*" >>"$LOG"; }
 
 probe() {
@@ -32,16 +37,20 @@ snapshot() {
   # bench artifacts reference the corpus-derived buckets; no storage needed
 }
 
+captured() {  # captured <name>: stage has a FRESH on-chip artifact
+  # a REPLAYED banked artifact (bench.py's dead-tunnel fallback) must not
+  # mark a stage complete — only a fresh on-chip measurement does
+  [ -s "$ART/$1.json" ] && grep -q '"backend": "tpu"' "$ART/$1.json" \
+    && ! grep -q '"replayed_from_banked"' "$ART/$1.json"
+}
+
 run_one() {  # run_one <name> <timeout_s> <cmd...>
   # The outer budget must exceed the wrapper's own TPU budget + CPU
   # fallback (BENCH_TPU_TIMEOUT_S each) or a timeout here kills the
   # wrapper mid-fallback and its finally-cleanup destroys the banked
   # partial before salvage can emit it.
   local name=$1 budget=$2; shift 2
-  # a REPLAYED banked artifact (bench.py's dead-tunnel fallback) must not
-  # mark a stage complete — only a fresh on-chip measurement does
-  [ -s "$ART/$name.json" ] && grep -q '"backend": "tpu"' "$ART/$name.json" \
-    && ! grep -q '"replayed_from_banked"' "$ART/$name.json" && return 0
+  captured "$name" && return 0
   log "running $name: $*"
   # BENCH_BANKED_ROOT=/nonexistent: battery children must MEASURE, never
   # replay — a wedged stage replaying committed artifacts from the snapshot
@@ -69,12 +78,14 @@ while true; do
     run_one bench_int8_decode   4500 python scripts/bench_int8_llm.py --decode 128 --batch 8
     run_one bench_llm_qlora     4500 python bench_llm.py
     run_one bench_ggnn_dense    4500 python bench.py --layout dense
+    # quality-on-chip: the reference's 3-stage protocol (DeepDFA / LineVul /
+    # DeepDFA+LineVul) end-to-end on the TPU — wall times + test F1. Runs
+    # after every throughput stage: it compiles many distinct programs
+    # (GGNN fit, roberta, joint) and is therefore the most wedge-prone.
+    run_one perf_eval_full      4500 python scripts/performance_evaluation.py --protocol full --runs 1
     # all captured on tpu? then drop to slow heartbeat
     ok=1
-    for n in bench_ggnn_segment bench_int8_prefill bench_int8_decode bench_llm_qlora bench_ggnn_dense; do
-      { [ -s "$ART/$n.json" ] && grep -q '"backend": "tpu"' "$ART/$n.json" \
-        && ! grep -q '"replayed_from_banked"' "$ART/$n.json"; } || ok=0
-    done
+    for n in "${STAGES[@]}"; do captured "$n" || ok=0; done
     if [ "$ok" = 1 ]; then log "battery complete (all tpu); watcher idle"; sleep 3600; fi
   else
     log "probe failed (tunnel down)"

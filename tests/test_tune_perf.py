@@ -195,7 +195,8 @@ def test_performance_evaluation_full_protocol(tmp_path, monkeypatch):
     import performance_evaluation
 
     agg = performance_evaluation.main(
-        ["--protocol", "full", "--out", str(tmp_path / "perf_full"),
+        ["--protocol", "full", "--runs", "1",
+         "--out", str(tmp_path / "perf_full"),
          "--set", "optim.max_epochs=1", "--set", "model.hidden_dim=8",
          "--set", "model.n_steps=1"]
     )
@@ -203,4 +204,5 @@ def test_performance_evaluation_full_protocol(tmp_path, monkeypatch):
     for stage in agg["stages"].values():
         assert stage["seconds"] > 0
     assert agg["total_seconds"] > 0
+    assert agg["runs"][0]["stages"] is agg["stages"]  # --runs honored
     assert (tmp_path / "perf_full" / "performance_evaluation.json").exists()
