@@ -904,25 +904,36 @@ class _Builder:
         self._labels.clear()
 
 
-def parse_source(code: str) -> CPG:
-    """Parse C source (possibly several functions) into one CPG. Each
-    function gets a fresh builder (own scopes/labels); node ids are disjoint.
-    """
+def parse_functions(code: str) -> list[tuple[str, CPG]]:
+    """Parse C source into one ``(function name, CPG)`` pair PER function —
+    the `predict` scan surface scores and reports each function separately
+    (the reference corpus is one function per row, ``datasets.py:159-198``;
+    a raw file is not). Each function gets a fresh builder (own
+    scopes/labels); node ids are disjoint across functions."""
     ast, n_typedefs = _parse_with_recovery(_preprocess(code))
-    all_nodes: list[Node] = []
-    all_edges: list[tuple[int, int, str]] = []
+    out: list[tuple[str, CPG]] = []
     next_id = 1000100
-    found = False
     for ext in ast.ext:
         if isinstance(ext, c_ast.FuncDef):
             builder = _Builder(line_offset=n_typedefs, next_id=next_id)
             builder.build(ext)
-            all_nodes.extend(builder.nodes)
-            all_edges.extend(builder.edges)
+            name = getattr(ext.decl, "name", None) or f"func_{len(out)}"
+            out.append((name, CPG(builder.nodes, builder.edges)))
             next_id = builder._next + 100
-            found = True
-    if not found:
+    if not out:
         raise FrontendError("no function definition found")
+    return out
+
+
+def parse_source(code: str) -> CPG:
+    """Parse C source (possibly several functions) into one CPG — the merge
+    of :func:`parse_functions` (ONE parsing loop; file-mode and
+    per-function-mode must never diverge)."""
+    all_nodes: list[Node] = []
+    all_edges: list[tuple[int, int, str]] = []
+    for _name, cpg in parse_functions(code):
+        all_nodes.extend(cpg.nodes.values())
+        all_edges.extend(cpg.edges)
     return CPG(all_nodes, all_edges)
 
 

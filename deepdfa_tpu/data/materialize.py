@@ -61,15 +61,21 @@ def graph_from_cpg(
     graph_label: int | None = None,
     gtype: str = "cfg",
     dataflow_labels: bool = False,
+    selection: tuple[list, list] | None = None,
 ) -> Graph | None:
     """Build one training graph. ``feat_ids`` maps feature name →
     {node_id: int id}. Exactly one of ``vuln_lines`` (per-line labels,
     Big-Vul) / ``graph_label`` (broadcast, Devign) must be given.
 
+    ``selection``: a precomputed ``select_cfg_nodes(cpg, gtype)`` result.
+    Callers that need the node ORDER themselves (`predict` maps node index
+    → source line) pass it in, so the order used for features and the
+    order used for attribution are the same object by construction.
+
     Returns None when no graph structure survives selection (the reference
     drops such graphs at load time, ``linevd/dataset.py:40-45``).
     """
-    nodes, edges = select_cfg_nodes(cpg, gtype)
+    nodes, edges = selection if selection is not None else select_cfg_nodes(cpg, gtype)
     if not nodes:
         return None
     pos = {nid: i for i, nid in enumerate(nodes)}

@@ -82,6 +82,28 @@ class Vocabulary:
     def input_dim(self) -> int:
         return self.cfg.input_dim
 
+    def to_dict(self) -> dict:
+        """Full JSON-serialisable form. ``all_vocab`` alone (what the shard
+        dir's ``vocab.json`` used to carry) cannot encode NEW code: with
+        ``include_unknown=False`` (the reference default) the combined hash
+        substitutes UNKNOWN for out-of-vocab subkey values, which needs
+        ``subkey_vocabs`` — the serialisation predict-time encoding loads."""
+        return {
+            "cfg": dataclasses.asdict(self.cfg),
+            "subkey_vocabs": self.subkey_vocabs,
+            "all_vocab": self.all_vocab,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Vocabulary":
+        cfg_d = dict(d["cfg"])
+        cfg_d["subkeys"] = tuple(cfg_d["subkeys"])
+        return cls(
+            cfg=FeatureConfig(**cfg_d),
+            subkey_vocabs={k: dict(v) for k, v in d["subkey_vocabs"].items()},
+            all_vocab={k: int(v) for k, v in d["all_vocab"].items()},
+        )
+
 
 def _rank(values: pd.Series, limit: int | None) -> dict:
     counts = values.value_counts()

@@ -177,6 +177,10 @@ class GlobalAttentionPooling(nn.Module):
     ) -> jnp.ndarray:
         gate_logit = nn.Dense(1, dtype=self.dtype, name="gate")(h)[:, 0]
         gate = segment_softmax(gate_logit, node_gidx, num_graphs, mask=node_mask)
+        # statement saliency for `predict`: which nodes the readout weighted.
+        # sow is a no-op unless the caller applies with
+        # mutable=["intermediates"] — training/inference paths are unchanged.
+        self.sow("intermediates", "gate_weights", gate)
         return segment_sum(gate[:, None] * h, node_gidx, num_graphs)
 
 

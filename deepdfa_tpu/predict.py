@@ -1,0 +1,245 @@
+"""Score raw C/C++ source with a trained checkpoint — `deepdfa-tpu predict`.
+
+The reference has no single-command scan surface: scoring new code means
+re-running its preprocessing stack into shards and pointing the test
+harness at them (`DDFA/scripts/preprocess.sh` → `main_cli.py test`). Here
+the hermetic frontend (:mod:`deepdfa_tpu.cpg.frontend`), the
+abstract-dataflow features encoded with the TRAINING vocabulary
+(:mod:`deepdfa_tpu.data.vocab` — predict must never rebuild a vocabulary
+from the code being scored), and the trained GGNN compose into one call:
+C source in, per-function vulnerability probability plus ranked suspicious
+statements out.
+
+Statement ranking: for ``label_style="node"`` checkpoints the per-node
+sigmoid scores rank statements directly (the IVDetect top-k protocol,
+reference contract ``DDFA/sastvd/helpers/evaluate.py:262-322``); for the
+flagship graph-label model the readout's own attention gate — the weight
+the model put on each statement when classifying the function
+(``GlobalAttentionPooling``, reference ``code_gnn/models/flow_gnn/ggnn.py:66-68``)
+— is the saliency signal.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.config import ExperimentConfig
+from deepdfa_tpu.data.graphs import _round_up, batch_np
+from deepdfa_tpu.data.materialize import graph_from_cpg, select_cfg_nodes
+from deepdfa_tpu.data.vocab import Vocabulary
+
+__all__ = [
+    "load_vocabs", "make_scorer", "predict_source", "predict_paths",
+    "collect_sources",
+]
+
+
+def load_vocabs(shard_dir: Path | str) -> dict[str, Vocabulary]:
+    """The training vocabularies from a materialised shard dir.
+
+    Requires the full serialised form (``Vocabulary.to_dict``): the legacy
+    ``all_vocab``-only format cannot encode NEW code (UNKNOWN substitution
+    needs the subkey vocabs), so it is rejected with a re-preprocess hint
+    rather than silently mis-encoding every definition.
+    """
+    path = Path(shard_dir) / "vocab.json"
+    data = json.loads(path.read_text())
+    first = next(iter(data.values()), None)
+    if not isinstance(first, dict) or "subkey_vocabs" not in first:
+        raise ValueError(
+            f"{path} is the legacy all_vocab-only format and cannot encode "
+            "new source; re-run scripts/preprocess.py to write the full "
+            "vocabulary (cfg + subkey_vocabs + all_vocab)"
+        )
+    return {name: Vocabulary.from_dict(d) for name, d in data.items()}
+
+
+def _all_subkeys(vocabs: dict[str, Vocabulary]) -> tuple[str, ...]:
+    """Union of subkeys across vocabs, in first-seen order. Stage-2 hashes
+    must cover every subkey ANY vocabulary reads — picking one vocab's
+    subkeys would make encoding depend on JSON key order (a single-subkey
+    vocab first ⇒ every other vocab silently degrades to UNKNOWN)."""
+    seen: dict[str, None] = {}
+    for voc in vocabs.values():
+        for sk in voc.cfg.subkeys:
+            seen.setdefault(sk)
+    return tuple(seen)
+
+
+def _encode(cpg, gid: int, vocabs: dict[str, Vocabulary]):
+    """CPG → (Graph with training-vocab feature ids, CFG node-id order)."""
+    from deepdfa_tpu.cpg.features import extract_features, features_to_hashes
+
+    feats = extract_features(cpg, gid)
+    hashes: dict[int, str] = {}
+    if len(feats):
+        hash_df = features_to_hashes(feats, _all_subkeys(vocabs))
+        hashes = {
+            int(r.node_id): r.hash for r in hash_df.itertuples(index=False)
+        }
+    feat_ids = {
+        name: {n: voc.feature_id(h) for n, h in hashes.items()}
+        for name, voc in vocabs.items()
+    }
+    selection = select_cfg_nodes(cpg, "cfg")
+    g = graph_from_cpg(cpg, gid, feat_ids, graph_label=0, selection=selection)
+    return g, selection[0]
+
+
+def make_scorer(model, label_style: str) -> Callable:
+    """One jitted ``(params, batch) -> (fn_prob[max_graphs],
+    node_saliency[max_nodes])`` scorer. Built once per scan so every
+    function of the same padded batch shape reuses one XLA executable;
+    unsupported checkpoints fail HERE with a clear message, not as a
+    KeyError deep inside scoring."""
+    if label_style == "node":
+        @jax.jit
+        def score(params, batch):
+            node_p = jax.nn.sigmoid(model.apply({"params": params}, batch))
+            # function score = max node probability over the real nodes
+            neg = jnp.full_like(node_p, -jnp.inf)
+            masked = jnp.where(batch.node_mask, node_p, neg)
+            fn_p = jax.ops.segment_max(masked, batch.node_gidx,
+                                       num_segments=batch.max_graphs)
+            return fn_p, node_p
+        return score
+    if label_style != "graph":
+        raise ValueError(
+            f"predict supports label_style 'graph' or 'node', not "
+            f"{label_style!r} (dataflow-solution checkpoints score RD bits, "
+            "not vulnerability)"
+        )
+    if getattr(model, "cfg", None) is not None and model.cfg.encoder_mode:
+        raise ValueError(
+            "predict needs a classifier head; encoder_mode checkpoints "
+            "return pooled embeddings (use the joint-fusion test path)"
+        )
+
+    @jax.jit
+    def score(params, batch):
+        logits, mods = model.apply({"params": params}, batch,
+                                   mutable=["intermediates"])
+        gate = mods["intermediates"]["pooling"]["gate_weights"][0]
+        return jax.nn.sigmoid(logits), gate
+    return score
+
+
+def predict_source(
+    code: str,
+    *,
+    scorer: Callable,
+    params,
+    vocabs: dict[str, Vocabulary],
+    top_k: int = 5,
+    name: str = "<source>",
+) -> list[dict]:
+    """Score every function in ``code``; one result dict per function.
+
+    Functions are scored one per batch with budget shapes rounded up
+    (:func:`_round_up`), so the jitted ``scorer`` compiles once per size
+    bucket and similarly-sized functions reuse the executable.
+    """
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_functions
+
+    results = []
+    for fname, cpg in parse_functions(code):
+        cpg = add_dependence_edges(cpg)
+        g, node_ids = _encode(cpg, 0, vocabs)
+        if g is None:
+            results.append({"function": fname, "file": name,
+                            "error": "no CFG nodes survived selection"})
+            continue
+        batch = batch_np(
+            [g], 2, _round_up(g.n_nodes + 2),
+            max(_round_up(g.n_edges), 128),
+        )
+        dev = jax.tree.map(jnp.asarray, batch)
+        fn_p, saliency = scorer(params, dev)
+        prob = float(np.asarray(fn_p, np.float32)[0])
+        sal = np.asarray(saliency, np.float32)[: len(node_ids)]
+        order = np.argsort(-sal)[: max(top_k, 0)]
+        statements = [
+            {
+                "line": cpg.nodes[node_ids[i]].line,
+                "code": cpg.nodes[node_ids[i]].code,
+                "weight": round(float(sal[i]), 6),
+            }
+            for i in order
+        ]
+        results.append({
+            "function": fname,
+            "file": name,
+            "vulnerable_probability": round(prob, 6),
+            "top_statements": statements,
+        })
+    return results
+
+
+def collect_sources(paths: Sequence[str | Path]) -> list[tuple[str, str]]:
+    """(display name, source text) for each file; directories recurse over
+    ``*.c``/``*.h``/``*.cc``/``*.cpp``. Missing paths raise."""
+    out: list[tuple[str, str]] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files = sorted(
+                f for pat in ("*.c", "*.h", "*.cc", "*.cpp")
+                for f in p.rglob(pat)
+            )
+        elif p.exists():
+            files = [p]
+        else:
+            raise FileNotFoundError(p)
+        out.extend((str(f), f.read_text(errors="replace")) for f in files)
+    return out
+
+
+def predict_paths(
+    paths: Sequence[str | Path],
+    *,
+    cfg: ExperimentConfig,
+    model,
+    params,
+    vocabs: dict[str, Vocabulary],
+    top_k: int = 5,
+) -> dict:
+    """Scan files/dirs. Returns ``{results, n_scored, n_errors}`` —
+    ``n_scored`` counts successfully scored FUNCTIONS; error entries
+    (unparseable file, function with no CFG) are separate, since one
+    unparseable file says nothing about how many functions it held.
+
+    Frontend failures are per-file results with an ``error`` field — a
+    scan must report unparseable code, not die on it (mirrors the
+    preprocess pipeline's ``failed_frontend.txt`` policy).
+    """
+    from deepdfa_tpu.cpg.frontend import FrontendError
+
+    any_voc = next(iter(vocabs.values()))
+    if any_voc.input_dim != cfg.input_dim:
+        raise ValueError(
+            f"vocab input_dim {any_voc.input_dim} != config input_dim "
+            f"{cfg.input_dim} — the checkpoint and the shard dir disagree"
+        )
+    scorer = make_scorer(model, cfg.model.label_style)
+    results: list[dict] = []
+    for name, code in collect_sources(paths):
+        try:
+            results.extend(predict_source(
+                code, scorer=scorer, params=params, vocabs=vocabs,
+                top_k=top_k, name=name,
+            ))
+        except (FrontendError, SyntaxError, ValueError) as e:
+            results.append({"file": name, "error": f"{type(e).__name__}: {e}"})
+    n_err = sum(1 for r in results if "error" in r)
+    return {
+        "results": results,
+        "n_scored": len(results) - n_err,
+        "n_errors": n_err,
+    }

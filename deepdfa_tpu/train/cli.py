@@ -386,6 +386,17 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
     return last_val
 
 
+def _restore_params(ckpts: CheckpointManager, template_params):
+    """Best-else-latest parameter restore — ONE implementation so `test`
+    and `predict` can never load different weights for the same run."""
+    restored = (
+        ckpts.restore_best(template={"params": template_params})
+        if ckpts.best_step() is not None
+        else ckpts.restore_latest(template={"params": template_params})
+    )
+    return restored["params"]
+
+
 def test(
     cfg: ExperimentConfig, run_dir: Path, ckpt_dir: Path | None = None
 ) -> dict[str, float]:
@@ -399,12 +410,7 @@ def test(
 
     ckpts = CheckpointManager(ckpt_dir or run_dir / "checkpoints", cfg.checkpoint)
     if ckpts.latest_step() is not None:
-        restored = (
-            ckpts.restore_best(template={"params": state.params})
-            if ckpts.best_step() is not None
-            else ckpts.restore_latest(template={"params": state.params})
-        )
-        params = restored["params"]
+        params = _restore_params(ckpts, state.params)
         logger.info("restored checkpoint")
     else:
         params = state.params
@@ -669,6 +675,63 @@ def variant_coverage(
     return out
 
 
+def predict(
+    cfg: ExperimentConfig,
+    run_dir: Path,
+    sources: Sequence[str],
+    ckpt_dir: Path | None = None,
+    top_k: int = 5,
+) -> dict:
+    """Scan raw C/C++ files with a trained checkpoint: per-function
+    vulnerability probability + ranked statements. The end-to-end surface
+    the reference lacks (its test path reads preprocessed shards only);
+    full pipeline lives in :mod:`deepdfa_tpu.predict`."""
+    from deepdfa_tpu.data.graphs import batch_np
+    from deepdfa_tpu.predict import load_vocabs, predict_paths
+
+    import dataclasses
+
+    sample_text = "_sample" if cfg.data.sample else ""
+    shard_dir = utils.processed_dir() / cfg.data.dsname / f"shards{sample_text}"
+    vocabs = load_vocabs(shard_dir)
+    # scoring runs one small graph per batch: the segment forward is the
+    # right layout, and checkpoints are layout-portable (shared param tree),
+    # so a dense-trained checkpoint restores into it unchanged
+    if cfg.model.layout != "segment":
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, layout="segment"))
+    model = make_model(cfg.model, cfg.input_dim)
+
+    # template init on a minimal structurally-valid batch (predict builds
+    # its own per-function batches; the checkpoint restore just needs the
+    # parameter tree's shape)
+    n = 4
+    feats: dict[str, np.ndarray] = {"_VULN": np.zeros(n, np.int32)}
+    for key in vocabs:
+        feats[key] = np.zeros(n, np.int32)
+    dummy = Graph(
+        senders=np.arange(n - 1, dtype=np.int32),
+        receivers=np.arange(1, n, dtype=np.int32),
+        node_feats=feats,
+    ).with_self_loops()
+    example = jax.tree.map(jnp.asarray, batch_np([dummy], 2, 8, 128))
+    params = model.init(jax.random.key(0), example)["params"]
+
+    ckpts = CheckpointManager(ckpt_dir or run_dir / "checkpoints", cfg.checkpoint)
+    if ckpts.latest_step() is None:
+        raise FileNotFoundError(
+            f"no checkpoint under {ckpt_dir or run_dir / 'checkpoints'} — "
+            "predict scores with a TRAINED model; run fit first"
+        )
+    params = _restore_params(ckpts, params)
+
+    report = predict_paths(sources, cfg=cfg, model=model, params=params,
+                           vocabs=vocabs, top_k=top_k)
+    (run_dir / "predictions.json").write_text(json.dumps(report, indent=2))
+    print(json.dumps(report))
+    return report
+
+
 def analyze(cfg: ExperimentConfig, run_dir: Path) -> dict:
     """The ``--analyze_dataset`` equivalent (``run_analyze_dataset.sh`` /
     ``get_coverage``): per-split feature+solution coverage at the
@@ -733,14 +796,21 @@ def _parse_overrides(pairs: Sequence[str]) -> dict:
 
 def main(argv: Sequence[str] | None = None) -> dict:
     parser = argparse.ArgumentParser(prog="deepdfa-tpu")
-    parser.add_argument("command", choices=["fit", "test", "analyze"])
+    parser.add_argument("command", choices=["fit", "test", "analyze", "predict"])
     parser.add_argument("--config", action="append", default=[],
                         help="layered config files (later files win)")
     parser.add_argument("--set", action="append", default=[], dest="overrides",
                         help="dotted overrides, e.g. --set optim.max_epochs=3")
     parser.add_argument("--run-dir", default=None)
-    parser.add_argument("--ckpt-dir", default=None, help="checkpoint dir for test")
+    parser.add_argument("--ckpt-dir", default=None,
+                        help="checkpoint dir for test/predict")
+    parser.add_argument("--source", action="append", default=[],
+                        help="predict: C file or directory (repeatable)")
+    parser.add_argument("--top-k", type=int, default=5,
+                        help="predict: statements ranked per function")
     args = parser.parse_args(argv)
+    if args.command == "predict" and not args.source:
+        parser.error("predict requires at least one --source")
 
     cfg = load_config(*args.config, overrides=_parse_overrides(args.overrides))
     utils.seed_all(cfg.seed)
@@ -768,6 +838,10 @@ def main(argv: Sequence[str] | None = None) -> dict:
             return fit(cfg, run_dir)
         if args.command == "test":
             return test(cfg, run_dir, Path(args.ckpt_dir) if args.ckpt_dir else None)
+        if args.command == "predict":
+            return predict(cfg, run_dir, args.source,
+                           Path(args.ckpt_dir) if args.ckpt_dir else None,
+                           top_k=args.top_k)
         return analyze(cfg, run_dir)
     except Exception:
         # crash marker parity: rename log to .log.error (main_cli.py:324-336)

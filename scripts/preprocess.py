@@ -270,8 +270,11 @@ def main(argv=None) -> dict:
     )
     n_shards = save_shards(graphs, out_dir)
     (out_dir / "splits.json").write_text(json.dumps(splits))
+    # full form (cfg + subkey_vocabs + all_vocab): `predict` re-encodes NEW
+    # source against the training vocab, which needs the subkey vocabs for
+    # UNKNOWN substitution — all_vocab alone cannot do that
     (out_dir / "vocab.json").write_text(
-        json.dumps({name: voc.all_vocab for name, voc in vocabs.items()})
+        json.dumps({name: voc.to_dict() for name, voc in vocabs.items()})
     )
     # stage-2 hash table: the coverage analyzer's input for the per-variant
     # limit_all x subkey grid (train/cli.py variant_coverage)
