@@ -272,7 +272,8 @@ def build_dense_batches(corpus, n_batches: int, batch_graphs: int = 256):
     return groups, batcher.occupancy(all_batches), batcher.n_dropped
 
 
-def bench_chained_dense(groups, k: int, dtype: str = "bfloat16", trials: int = 3):
+def bench_chained_dense(groups, k: int, dtype: str = "bfloat16", trials: int = 3,
+                        on_shape=None):
     """Chained protocol over the dense-adjacency forward (shared timing
     helper — identical protocol to the segment layout by construction).
 
@@ -281,7 +282,14 @@ def bench_chained_dense(groups, k: int, dtype: str = "bfloat16", trials: int = 3
     corpus that shape carries; the quoted rate is the mixture
     ``Σ graphs / Σ wall`` — large-graph batches are NOT quietly skipped.
     ``flops_per_step`` is the k-weighted mean so the roofline gate checks
-    the same mixture it validates."""
+    the same mixture it validates.
+
+    ``on_shape(by_shape)`` fires after EVERY shape finishes with the
+    per-shape rates measured so far — the dense stage has wedged the
+    tunnel mid-compile twice (round 5), and without per-shape banking a
+    wedge at shape N discards shapes 1..N-1's measured numbers. Per-shape
+    rates are DIAGNOSTIC (never a headline: quoting a partial mixture
+    would silently drop the large-graph shapes and inflate the rate)."""
     import dataclasses as _dc
 
     import jax
@@ -302,6 +310,7 @@ def bench_chained_dense(groups, k: int, dtype: str = "bfloat16", trials: int = 3
     total_graphs = total_wall = total_flops = 0.0
     flops_unknown = False
     params = None
+    by_shape: dict[str, dict] = {}
     for s, batches in sorted(groups.items()):
         dev0 = jax.tree.map(jnp.asarray, batches[0])
         if params is None:
@@ -317,6 +326,14 @@ def bench_chained_dense(groups, k: int, dtype: str = "bfloat16", trials: int = 3
             flops_unknown = True
         else:
             total_flops += flops * ks[s]
+        by_shape[str(s)] = {
+            "graphs_per_sec": round(ks[s] * real / wall, 1),
+            "step_ms": round(wall / ks[s] * 1e3, 3),
+            "k": ks[s],
+            "flops_per_step": flops,
+        }
+        if on_shape is not None:
+            on_shape(dict(by_shape))
     k_total = sum(ks.values())
     return {
         "graphs_per_sec": total_graphs / total_wall,
@@ -326,6 +343,7 @@ def bench_chained_dense(groups, k: int, dtype: str = "bfloat16", trials: int = 3
         "k": k_total,
         "graphs_per_step": total_graphs / k_total,
         "shapes": {str(s): ks[s] for s in sorted(groups)},
+        "by_shape": by_shape,
     }
 
 
@@ -865,7 +883,8 @@ def replay_banked(reason: str) -> bool:
             for k in ("dense_graphs_per_sec", "dense_step_ms",
                       "dense_flops_per_step", "dense_shapes",
                       "dense_occupancy", "dense_dropped_oversize",
-                      "dense_error", "dense_graphs_per_step"):
+                      "dense_error", "dense_graphs_per_step",
+                      "dense_by_shape"):
                 if k in den[2]:
                     result[k] = den[2][k]
             sources.append(_src(den))
@@ -930,7 +949,7 @@ def _assemble_result(backend, device_kind, roofline, occupancy, real_graphs,
                      chained, dense=None, dense_real=None, dense_occ=None,
                      dense_dropped=None, dense_error=None, chained_train=None,
                      strict=None, peak_runs=None, peak_errors=None,
-                     base_gps=None):
+                     base_gps=None, dense_by_shape=None):
     """Build the ONE-line artifact from whatever stages have completed.
 
     Callable mid-run: ``main`` banks the artifact-so-far after every stage
@@ -1023,6 +1042,12 @@ def _assemble_result(backend, device_kind, roofline, occupancy, real_graphs,
         ),
         "dense_dropped_oversize": dense_dropped,
         "dense_error": dense_error,
+        # per-shape dense rates, banked after EVERY shape — diagnostic only
+        # (a partial mixture must never be quoted as the dense headline:
+        # it would drop the large-graph shapes and inflate the rate)
+        "dense_by_shape": (
+            dense.get("by_shape") if dense else dense_by_shape
+        ),
         "implied_tflops": derived["implied_tflops"],
         "roofline_tflops": round(roofline / 1e12, 1),
         "roofline_note": ("parallel independent bf16 matmul chains — the "
@@ -1129,7 +1154,7 @@ def main():
     chained = bench_chained(batches, args.chain, train=False)
     _progress(f"chained: {chained['graphs_per_sec']:.0f} g/s")
     dense = dense_occ = dense_real = None
-    dense_error = dense_dropped = None
+    dense_error = dense_dropped = dense_by_shape = None
     chained_train = strict = None
     peak_runs: dict[str, tuple] = {}
     peak_errors: dict[str, str] = {}
@@ -1148,7 +1173,8 @@ def main():
         r = _assemble_result(
             backend, device_kind, roofline, occupancy, real_graphs, chained,
             dense, dense_real, dense_occ, dense_dropped, dense_error,
-            chained_train, strict, peak_runs, peak_errors, base_gps)
+            chained_train, strict, peak_runs, peak_errors, base_gps,
+            dense_by_shape)
         r["partial_through_stage"] = stage
         tmp = partial_path + ".tmp"
         with open(tmp, "w") as f:
@@ -1201,7 +1227,15 @@ def main():
             dense_groups, dense_occ, dense_dropped = build_dense_batches(
                 corpus, args.batches
             )
-            dense = bench_chained_dense(dense_groups, args.chain)
+
+            def _on_shape(shapes_done):
+                nonlocal dense_by_shape
+                dense_by_shape = shapes_done
+                _progress(f"dense shape done: {sorted(shapes_done)}")
+                bank(f"dense-shape-{len(shapes_done)}")
+
+            dense = bench_chained_dense(dense_groups, args.chain,
+                                        on_shape=_on_shape)
             dense_real = dense["graphs_per_step"]
             _progress(f"dense: {dense['graphs_per_sec']:.0f} g/s "
                       f"(shapes {dense['shapes']})")
@@ -1213,7 +1247,8 @@ def main():
     result = _assemble_result(
         backend, device_kind, roofline, occupancy, real_graphs, chained,
         dense, dense_real, dense_occ, dense_dropped, dense_error,
-        chained_train, strict, peak_runs, peak_errors, base_gps)
+        chained_train, strict, peak_runs, peak_errors, base_gps,
+        dense_by_shape)
     print(json.dumps(result))
 
 

@@ -1,4 +1,4 @@
-"""Score raw C/C++ source with a trained checkpoint — `deepdfa-tpu predict`.
+"""Score raw C source with a trained checkpoint — `deepdfa-tpu predict`.
 
 The reference has no single-command scan surface: scoring new code means
 re-running its preprocessing stack into shards and pointing the test
@@ -98,6 +98,11 @@ def make_scorer(model, label_style: str) -> Callable:
     function of the same padded batch shape reuses one XLA executable;
     unsupported checkpoints fail HERE with a clear message, not as a
     KeyError deep inside scoring."""
+    if getattr(model, "cfg", None) is not None and model.cfg.encoder_mode:
+        raise ValueError(
+            "predict needs a classifier head; encoder_mode checkpoints "
+            "return pooled embeddings (use the joint-fusion test path)"
+        )
     if label_style == "node":
         @jax.jit
         def score(params, batch):
@@ -114,11 +119,6 @@ def make_scorer(model, label_style: str) -> Callable:
             f"predict supports label_style 'graph' or 'node', not "
             f"{label_style!r} (dataflow-solution checkpoints score RD bits, "
             "not vulnerability)"
-        )
-    if getattr(model, "cfg", None) is not None and model.cfg.encoder_mode:
-        raise ValueError(
-            "predict needs a classifier head; encoder_mode checkpoints "
-            "return pooled embeddings (use the joint-fusion test path)"
         )
 
     @jax.jit
@@ -184,15 +184,15 @@ def predict_source(
 
 def collect_sources(paths: Sequence[str | Path]) -> list[tuple[str, str]]:
     """(display name, source text) for each file; directories recurse over
-    ``*.c``/``*.h``/``*.cc``/``*.cpp``. Missing paths raise."""
+    ``*.c`` only — the frontend is a C11 parser (pycparser), so globbing
+    C++ or declaration-only headers would guarantee an error row per file.
+    An explicit FILE path of any extension is still honored (the caller
+    asked for that exact file). Missing paths raise."""
     out: list[tuple[str, str]] = []
     for p in paths:
         p = Path(p)
         if p.is_dir():
-            files = sorted(
-                f for pat in ("*.c", "*.h", "*.cc", "*.cpp")
-                for f in p.rglob(pat)
-            )
+            files = sorted(p.rglob("*.c"))
         elif p.exists():
             files = [p]
         else:

@@ -682,7 +682,7 @@ def predict(
     ckpt_dir: Path | None = None,
     top_k: int = 5,
 ) -> dict:
-    """Scan raw C/C++ files with a trained checkpoint: per-function
+    """Scan raw C files with a trained checkpoint: per-function
     vulnerability probability + ranked statements. The end-to-end surface
     the reference lacks (its test path reads preprocessed shards only);
     full pipeline lives in :mod:`deepdfa_tpu.predict`."""
@@ -813,6 +813,15 @@ def main(argv: Sequence[str] | None = None) -> dict:
         parser.error("predict requires at least one --source")
 
     cfg = load_config(*args.config, overrides=_parse_overrides(args.overrides))
+    if args.command == "predict" and args.run_dir:
+        # score with the RUN'S OWN recorded config as the base layer (CLI
+        # configs/overrides still win): `predict --run-dir <fit dir>` must
+        # restore a non-default-trained checkpoint without the caller
+        # re-passing every fit-time override
+        saved = Path(args.run_dir) / "config.json"
+        if saved.exists():
+            cfg = load_config(saved, *args.config,
+                              overrides=_parse_overrides(args.overrides))
     utils.seed_all(cfg.seed)
 
     run_id = cfg.run_name or utils.get_run_id([args.command])
@@ -830,7 +839,10 @@ def main(argv: Sequence[str] | None = None) -> dict:
     )
     from deepdfa_tpu.config import to_json
 
-    (run_dir / "config.json").write_text(to_json(cfg))
+    if args.command != "predict":
+        # predict is routinely pointed AT a fit run dir (README usage) —
+        # it must not clobber the trained run's recorded config
+        (run_dir / "config.json").write_text(to_json(cfg))
     logger.info("run %s: %s devices=%s", run_id, args.command, jax.device_count())
 
     try:

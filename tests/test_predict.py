@@ -93,9 +93,13 @@ def test_predict_end_to_end(tmp_path, monkeypatch):
     from deepdfa_tpu.train import cli
 
     run_dir = tmp_path / "run"
-    overrides = ["data.dsname=demo", "optim.max_epochs=10"]
+    # non-default width: predict must restore this from the run's saved
+    # config.json, NOT require the caller to re-pass fit-time overrides
+    overrides = ["data.dsname=demo", "optim.max_epochs=10",
+                 "model.hidden_dim=24"]
     sets = [x for o in overrides for x in ("--set", o)]
     cli.main(["fit", "--run-dir", str(run_dir), *sets])
+    saved_config = (run_dir / "config.json").read_text()
 
     # fresh functions the model never saw (ids beyond the n=120 corpus)
     rng = np.random.default_rng(123)
@@ -108,11 +112,15 @@ def test_predict_end_to_end(tmp_path, monkeypatch):
             generate_function(9100 + i, False, rng)["before"])
     (src_dir / "broken.c").write_text("this is not C at all {{{")
 
+    # README usage: no fit-time overrides re-passed — the run's own
+    # config.json is the base layer
     report = cli.main([
         "predict", "--run-dir", str(run_dir),
         "--ckpt-dir", str(run_dir / "checkpoints"),
-        "--source", str(src_dir), "--top-k", "3", *sets,
+        "--source", str(src_dir), "--top-k", "3",
     ])
+    # and predict must not clobber the fit run's recorded config
+    assert (run_dir / "config.json").read_text() == saved_config
 
     assert report["n_scored"] == 10
     assert report["n_errors"] == 1
