@@ -12,11 +12,15 @@ statements out.
 
 Statement ranking: for ``label_style="node"`` checkpoints the per-node
 sigmoid scores rank statements directly (the IVDetect top-k protocol,
-reference contract ``DDFA/sastvd/helpers/evaluate.py:262-322``); for the
-flagship graph-label model the readout's own attention gate — the weight
-the model put on each statement when classifying the function
-(``GlobalAttentionPooling``, reference ``code_gnn/models/flow_gnn/ggnn.py:66-68``)
-— is the saliency signal.
+reference contract ``DDFA/sastvd/helpers/evaluate.py:262-322``). For the
+flagship graph-label model the DEFAULT signal is **occlusion saliency**
+(:func:`occlusion_saliency` — Δ probability when each statement's
+dataflow features are masked; 12/12 top-1 on the round-5 localization
+study, BASELINE.md); the readout's attention gate
+(``GlobalAttentionPooling``, reference
+``code_gnn/models/flow_gnn/ggnn.py:66-68``) remains available as the
+1-forward cheap mode (``--saliency gate``) but localizes poorly (0/12
+top-1 in the same study).
 """
 
 from __future__ import annotations
@@ -130,6 +134,53 @@ def make_scorer(model, label_style: str) -> Callable:
     return score
 
 
+def occlusion_saliency(
+    scorer: Callable, params, g, n_real: int, chunk: int = 16,
+    full_p: float | None = None,
+) -> np.ndarray:
+    """Per-node evidence contribution: Δ function probability when that
+    node's abstract-dataflow features are masked to not-a-def (id 0).
+
+    Measured head-to-head on unseen vulnerable demo functions (round 5,
+    BASELINE.md): the attention gate ranks the defective definition top-1
+    in 0/12 (it concentrates on loop headers — attention-as-explanation's
+    known failure mode); occlusion ranks it top-1 in 12/12. Cost: one
+    scorer call per ``chunk`` masked copies instead of one per function —
+    the copies ride ONE padded batch, and the tail chunk is padded with
+    unmasked copies so every chunk of a given function size shares a
+    compiled shape.
+    """
+    import dataclasses
+
+    if full_p is None:  # predict_source already has it; standalone callers don't
+        full_b = batch_np([g], 2, _round_up(g.n_nodes + 2),
+                          max(_round_up(g.n_edges), 128))
+        fp, _ = scorer(params, jax.tree.map(jnp.asarray, full_b))
+        full_p = float(np.asarray(fp, np.float32)[0])
+
+    sal = np.zeros(n_real, np.float32)
+    abs_keys = [k for k in g.node_feats if k.startswith("_ABS_DATAFLOW")]
+    for start in range(0, n_real, chunk):
+        idxs = list(range(start, min(start + chunk, n_real)))
+        copies = []
+        for i in idxs:
+            nf = {k: (v.copy() if k in abs_keys else v)
+                  for k, v in g.node_feats.items()}
+            for k in abs_keys:
+                nf[k][i] = 0
+            copies.append(dataclasses.replace(g, node_feats=nf))
+        copies += [g] * (chunk - len(idxs))  # shape-stable tail padding
+        mb = batch_np(
+            copies, chunk + 1, _round_up(chunk * g.n_nodes + 2),
+            max(_round_up(chunk * g.n_edges), 128),
+        )
+        probs, _ = scorer(params, jax.tree.map(jnp.asarray, mb))
+        probs = np.asarray(probs, np.float32)
+        for j, i in enumerate(idxs):
+            sal[i] = full_p - probs[j]
+    return sal
+
+
 def predict_source(
     code: str,
     *,
@@ -138,8 +189,16 @@ def predict_source(
     vocabs: dict[str, Vocabulary],
     top_k: int = 5,
     name: str = "<source>",
+    saliency: str = "occlusion",
+    label_style: str = "graph",
 ) -> list[dict]:
     """Score every function in ``code``; one result dict per function.
+
+    ``saliency`` (graph-label checkpoints): ``"occlusion"`` (default —
+    per-statement evidence drop, see :func:`occlusion_saliency`) or
+    ``"gate"`` (the readout's attention weights; one forward, cheaper,
+    much weaker localization). Node-label checkpoints always rank by the
+    per-node probabilities.
 
     Functions are scored one per batch with budget shapes rounded up
     (:func:`_round_up`), so the jitted ``scorer`` compiles once per size
@@ -148,6 +207,9 @@ def predict_source(
     from deepdfa_tpu.cpg.features import add_dependence_edges
     from deepdfa_tpu.cpg.frontend import parse_functions
 
+    if saliency not in ("occlusion", "gate"):
+        raise ValueError(f"saliency must be 'occlusion' or 'gate', "
+                         f"not {saliency!r}")
     results = []
     for fname, cpg in parse_functions(code):
         cpg = add_dependence_edges(cpg)
@@ -161,9 +223,17 @@ def predict_source(
             max(_round_up(g.n_edges), 128),
         )
         dev = jax.tree.map(jnp.asarray, batch)
-        fn_p, saliency = scorer(params, dev)
+        fn_p, node_sal = scorer(params, dev)
         prob = float(np.asarray(fn_p, np.float32)[0])
-        sal = np.asarray(saliency, np.float32)[: len(node_ids)]
+        used = saliency
+        if label_style == "node":
+            used = "node_probability"
+            sal = np.asarray(node_sal, np.float32)[: len(node_ids)]
+        elif saliency == "occlusion":
+            sal = occlusion_saliency(scorer, params, g, len(node_ids),
+                                     full_p=prob)
+        else:
+            sal = np.asarray(node_sal, np.float32)[: len(node_ids)]
         order = np.argsort(-sal)[: max(top_k, 0)]
         statements = [
             {
@@ -177,6 +247,7 @@ def predict_source(
             "function": fname,
             "file": name,
             "vulnerable_probability": round(prob, 6),
+            "saliency": used,
             "top_statements": statements,
         })
     return results
@@ -209,6 +280,7 @@ def predict_paths(
     params,
     vocabs: dict[str, Vocabulary],
     top_k: int = 5,
+    saliency: str = "occlusion",
 ) -> dict:
     """Scan files/dirs. Returns ``{results, n_scored, n_errors}`` —
     ``n_scored`` counts successfully scored FUNCTIONS; error entries
@@ -229,14 +301,26 @@ def predict_paths(
         )
     scorer = make_scorer(model, cfg.model.label_style)
     results: list[dict] = []
-    for name, code in collect_sources(paths):
-        try:
-            results.extend(predict_source(
-                code, scorer=scorer, params=params, vocabs=vocabs,
-                top_k=top_k, name=name,
-            ))
-        except (FrontendError, SyntaxError, ValueError) as e:
-            results.append({"file": name, "error": f"{type(e).__name__}: {e}"})
+    for p in paths:
+        found = collect_sources([p])
+        if not found:
+            # a .c-less directory must not read as a clean scan of nothing
+            results.append({
+                "file": str(p),
+                "error": "directory contains no .c files "
+                         "(the frontend parses C11 only)",
+            })
+            continue
+        for name, code in found:
+            try:
+                results.extend(predict_source(
+                    code, scorer=scorer, params=params, vocabs=vocabs,
+                    top_k=top_k, name=name, saliency=saliency,
+                    label_style=cfg.model.label_style,
+                ))
+            except (FrontendError, SyntaxError, ValueError) as e:
+                results.append({"file": name,
+                                "error": f"{type(e).__name__}: {e}"})
     n_err = sum(1 for r in results if "error" in r)
     return {
         "results": results,

@@ -681,6 +681,7 @@ def predict(
     sources: Sequence[str],
     ckpt_dir: Path | None = None,
     top_k: int = 5,
+    saliency: str = "occlusion",
 ) -> dict:
     """Scan raw C files with a trained checkpoint: per-function
     vulnerability probability + ranked statements. The end-to-end surface
@@ -726,7 +727,7 @@ def predict(
     params = _restore_params(ckpts, params)
 
     report = predict_paths(sources, cfg=cfg, model=model, params=params,
-                           vocabs=vocabs, top_k=top_k)
+                           vocabs=vocabs, top_k=top_k, saliency=saliency)
     (run_dir / "predictions.json").write_text(json.dumps(report, indent=2))
     print(json.dumps(report))
     return report
@@ -808,11 +809,17 @@ def main(argv: Sequence[str] | None = None) -> dict:
                         help="predict: C file or directory (repeatable)")
     parser.add_argument("--top-k", type=int, default=5,
                         help="predict: statements ranked per function")
+    parser.add_argument("--saliency", choices=("occlusion", "gate"),
+                        default="occlusion",
+                        help="predict statement ranking: occlusion = per-"
+                        "statement evidence drop (default; 12/12 top-1 on "
+                        "the demo localization study vs the gate's 0/12 — "
+                        "BASELINE.md); gate = readout attention, 1 forward")
     args = parser.parse_args(argv)
     if args.command == "predict" and not args.source:
         parser.error("predict requires at least one --source")
 
-    cfg = load_config(*args.config, overrides=_parse_overrides(args.overrides))
+    layers = list(args.config)
     if args.command == "predict" and args.run_dir:
         # score with the RUN'S OWN recorded config as the base layer (CLI
         # configs/overrides still win): `predict --run-dir <fit dir>` must
@@ -820,8 +827,8 @@ def main(argv: Sequence[str] | None = None) -> dict:
         # re-passing every fit-time override
         saved = Path(args.run_dir) / "config.json"
         if saved.exists():
-            cfg = load_config(saved, *args.config,
-                              overrides=_parse_overrides(args.overrides))
+            layers.insert(0, saved)
+    cfg = load_config(*layers, overrides=_parse_overrides(args.overrides))
     utils.seed_all(cfg.seed)
 
     run_id = cfg.run_name or utils.get_run_id([args.command])
@@ -839,9 +846,10 @@ def main(argv: Sequence[str] | None = None) -> dict:
     )
     from deepdfa_tpu.config import to_json
 
-    if args.command != "predict":
-        # predict is routinely pointed AT a fit run dir (README usage) —
-        # it must not clobber the trained run's recorded config
+    if args.command != "predict" or not (run_dir / "config.json").exists():
+        # no-clobber for predict: it is routinely pointed AT a fit run dir
+        # (README usage) and must not overwrite the trained run's recorded
+        # config — but a FRESH predict run dir still gets provenance
         (run_dir / "config.json").write_text(to_json(cfg))
     logger.info("run %s: %s devices=%s", run_id, args.command, jax.device_count())
 
@@ -853,13 +861,16 @@ def main(argv: Sequence[str] | None = None) -> dict:
         if args.command == "predict":
             return predict(cfg, run_dir, args.source,
                            Path(args.ckpt_dir) if args.ckpt_dir else None,
-                           top_k=args.top_k)
+                           top_k=args.top_k, saliency=args.saliency)
         return analyze(cfg, run_dir)
     except Exception:
-        # crash marker parity: rename log to .log.error (main_cli.py:324-336)
+        # crash marker parity: rename log to .log.error (main_cli.py:324-336).
+        # NOT for predict: it is routinely pointed at a fit run dir, and a
+        # failed scan must not mark the completed TRAINING run as crashed.
         for h in handlers:
             h.close()
-        log_file.rename(log_file.with_suffix(".log.error"))
+        if args.command != "predict":
+            log_file.rename(log_file.with_suffix(".log.error"))
         raise
 
 

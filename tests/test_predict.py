@@ -105,9 +105,11 @@ def test_predict_end_to_end(tmp_path, monkeypatch):
     rng = np.random.default_rng(123)
     src_dir = tmp_path / "scan"
     src_dir.mkdir()
+    vuln_lines: dict[str, set] = {}
     for i in range(5):
-        (src_dir / f"vul{i}.c").write_text(
-            generate_function(9000 + i, True, rng)["before"])
+        row = generate_function(9000 + i, True, rng)
+        (src_dir / f"vul{i}.c").write_text(row["before"])
+        vuln_lines[f"vul{i}.c"] = set(row["removed"])
         (src_dir / f"fixed{i}.c").write_text(
             generate_function(9100 + i, False, rng)["before"])
     (src_dir / "broken.c").write_text("this is not C at all {{{")
@@ -130,10 +132,20 @@ def test_predict_end_to_end(tmp_path, monkeypatch):
     assert len(scored) == 10
     for r in scored.values():
         assert 0.0 <= r["vulnerable_probability"] <= 1.0
+        assert r["saliency"] == "occlusion"
         assert 1 <= len(r["top_statements"]) <= 3
         for s in r["top_statements"]:
             assert s["line"] is None or s["line"] >= 1
-            assert s["weight"] >= 0
+            assert np.isfinite(s["weight"])
+    # localization floor: occlusion saliency must place the KNOWN
+    # vulnerable line in the top-3 for most vulnerable functions (the
+    # round-5 study measured 12/12 top-1 at this training budget; the
+    # floor is deliberately looser for seed robustness — BASELINE.md)
+    loc_hits = sum(
+        bool({s["line"] for s in by_file[n]["top_statements"]} & lines)
+        for n, lines in vuln_lines.items()
+    )
+    assert loc_hits >= 4, (loc_hits, vuln_lines)
     # the learned signal: vulnerable functions score above patched ones on
     # average (single pairs are noisy at this training budget)
     vul_mean = np.mean([r["vulnerable_probability"]
@@ -162,3 +174,44 @@ def test_make_scorer_rejects_unsupported_checkpoints():
                      cfg.input_dim)
     with pytest.raises(ValueError, match="encoder_mode"):
         make_scorer(enc, "graph")
+
+
+def test_occlusion_saliency_masking_math():
+    """Deterministic check of the occlusion machinery — chunking, tail
+    padding, index bookkeeping — against a hand-computable scorer whose
+    'probability' is the sum of a graph's _ABS_DATAFLOW ids: masking node
+    i must produce a drop of exactly feat[i]."""
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.data.graphs import Graph
+    from deepdfa_tpu.ops.segment import segment_sum
+    from deepdfa_tpu.predict import occlusion_saliency
+
+    n = 21  # > chunk (16): exercises the padded tail chunk
+    feats = np.arange(1, n + 1, dtype=np.int32)  # distinct, nonzero
+    g = Graph(
+        senders=np.arange(n - 1, dtype=np.int32),
+        receivers=np.arange(1, n, dtype=np.int32),
+        node_feats={"_VULN": np.zeros(n, np.int32),
+                    "_ABS_DATAFLOW": feats.copy()},
+    ).with_self_loops()
+
+    def scorer(params, batch):
+        vals = batch.node_feats["_ABS_DATAFLOW"].astype(jnp.float32)
+        vals = jnp.where(batch.node_mask, vals, 0.0)
+        per_graph = segment_sum(vals, batch.node_gidx, batch.max_graphs)
+        return per_graph, vals
+
+    sal = occlusion_saliency(scorer, None, g, n, chunk=16)
+    np.testing.assert_allclose(sal, feats.astype(np.float32))
+
+
+def test_predict_paths_reports_empty_directory(tmp_path):
+    """A .c-less directory must yield a visible error row, not a clean
+    scan of nothing."""
+    from deepdfa_tpu.predict import collect_sources
+
+    d = tmp_path / "cpponly"
+    d.mkdir()
+    (d / "x.cpp").write_text("class X {};")
+    assert collect_sources([d]) == []
