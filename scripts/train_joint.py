@@ -30,6 +30,25 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 
+def _restore_newest_epoch(trainer, examples, jcfg, search_dir, what: str):
+    """Newest ``epoch_*`` checkpoint restore (``--load_checkpoint`` parity,
+    ``train.py:221-224``), shared by test-only runs and source scans: glob +
+    numeric sort, trace one batch for the param template, load."""
+    from deepdfa_tpu.llm.dataset import text_batches
+
+    epochs_saved = sorted(
+        Path(search_dir).glob("epoch_*"),
+        key=lambda p: int(p.name.split("_")[1]),
+    )
+    if not epochs_saved:
+        raise SystemExit(
+            f"{what} needs an epoch_* checkpoint under {search_dir}"
+        )
+    first = trainer._joined(next(text_batches(examples, jcfg.eval_batch_size)))
+    template = trainer._build(1, first).params
+    return trainer.load(template, epochs_saved[-1].name), epochs_saved[-1].name
+
+
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser()
     parser.add_argument("--dataset", default="demo")
@@ -57,7 +76,23 @@ def main(argv=None) -> dict:
         "weights into the fusion model and freeze them "
         "(main_cli.py:136-145 freeze-transfer)",
     )
+    parser.add_argument(
+        "--predict-source", action="append", default=[], metavar="PATH",
+        help="scan raw C files/dirs with a trained joint/fusion checkpoint "
+        "(the `deepdfa-tpu predict` analogue for the LLM⊕GNN family): "
+        "per-function vulnerability probability from the fused classifier. "
+        "Needs an epoch_* save under --output_dir (or --do_train in the "
+        "same run); model flags must match training, like --do_test.",
+    )
     args = parser.parse_args(argv)
+    if args.predict_source:
+        if args.do_train or args.do_test:
+            parser.error("--predict-source is a standalone scan over the "
+                         "given files (their labels are unknown) — run "
+                         "training/testing separately")
+        if not args.output_dir:
+            parser.error("--predict-source needs --output_dir pointing at "
+                         "the trained joint run (its epoch_* checkpoint)")
 
     import dataclasses
 
@@ -135,16 +170,85 @@ def main(argv=None) -> dict:
             )
         jcfg = dataclasses.replace(jcfg, freeze_gnn=True)
 
-    # --- corpus: functions + labels from the demo generator or ingest table
-    if args.dataset == "demo":
+    # --- corpus: functions + labels from the demo generator / ingest table,
+    # or (scan mode) raw source files split per function
+    scan_meta = scan_graphs = None
+    scan_errors: list[dict] = []
+    if args.predict_source:
+        from deepdfa_tpu.config import FeatureConfig as _FC
+        from deepdfa_tpu.cpg.features import add_dependence_edges
+        from deepdfa_tpu.cpg.frontend import FrontendError, parse_functions
+        from deepdfa_tpu.predict import _encode, collect_sources, load_vocabs
+
+        vocabs = None
+        if jcfg.use_gnn:  # a --no_flowgnn checkpoint never needed shards
+            suffix = "_sample" if args.sample else ""
+            vocabs = load_vocabs(
+                utils.processed_dir() / args.dataset / f"shards{suffix}")
+            voc_dim = next(iter(vocabs.values())).input_dim
+            if voc_dim != _FC().input_dim:
+                raise SystemExit(
+                    f"vocab input_dim {voc_dim} != config input_dim "
+                    f"{_FC().input_dim} — the checkpoint and the shard dir "
+                    "disagree")
+        funcs, labels, ids, scan_meta, scan_graphs = [], [], [], [], []
+        for src_path in args.predict_source:
+            found = collect_sources([src_path])
+            if not found:
+                # a .c-less directory must not read as a clean scan of nothing
+                scan_errors.append({
+                    "file": str(src_path),
+                    "error": "directory contains no .c files "
+                             "(the frontend parses C11 only)"})
+                continue
+            for file_name, text in found:
+                # wrap the WHOLE per-file pipeline: one pathological file
+                # (parse OR feature extraction) must not abort the scan
+                try:
+                    parsed = parse_functions(text)
+                    src_lines = text.splitlines()
+                    for fname, cpg in parsed:
+                        cpg = add_dependence_edges(cpg)
+                        gid = len(funcs)
+                        g = None
+                        if jcfg.use_gnn:
+                            g, _node_ids = _encode(cpg, gid, vocabs)
+                            if g is None:
+                                scan_errors.append(
+                                    {"file": file_name, "function": fname,
+                                     "error": "no CFG nodes survived "
+                                              "selection"})
+                                continue
+                        # the LLM branch tokenizes the function's own source
+                        # span (node line numbers are original-source lines)
+                        lines = [n.line for n in cpg.nodes.values() if n.line]
+                        lo, hi = ((min(lines), max(lines)) if lines
+                                  else (1, len(src_lines)))
+                        funcs.append("\n".join(src_lines[max(lo - 1, 0):hi]))
+                        labels.append(0)  # unknown — what we are predicting
+                        ids.append(gid)
+                        if jcfg.use_gnn:
+                            scan_graphs.append(g)
+                        scan_meta.append({"file": file_name,
+                                          "function": fname})
+                except (FrontendError, SyntaxError, ValueError) as e:
+                    scan_errors.append({"file": file_name,
+                                        "error": f"{type(e).__name__}: {e}"})
+        if not funcs:
+            out = {"results": scan_errors, "n_scored": 0,
+                   "n_errors": len(scan_errors)}
+            print(json.dumps(out))
+            return out
+    elif args.dataset == "demo":
         from deepdfa_tpu.data.codegen import demo_corpus
 
         df = demo_corpus(60 if args.sample else 200, seed=0)
+        funcs, labels, ids = df.before.tolist(), df.vul.tolist(), df.id.tolist()
     else:
         from deepdfa_tpu.data import ingest
 
         df = ingest.ds(args.dataset, sample=args.sample)
-    funcs, labels, ids = df.before.tolist(), df.vul.tolist(), df.id.tolist()
+        funcs, labels, ids = df.before.tolist(), df.vul.tolist(), df.id.tolist()
 
     # --- model + tokenizer
     if encoder_family == "roberta":
@@ -204,20 +308,38 @@ def main(argv=None) -> dict:
         )["params"]
 
     examples = encode_functions(funcs, labels, tokenizer, jcfg.block_size, indices=ids)
-    n = len(examples)
-    rng = np.random.default_rng(jcfg.seed)
-    perm = rng.permutation(n)
-    cut_val, cut_test = int(n * 0.8), int(n * 0.9)
-    pick = lambda sl: type(examples)(*(np.asarray(a)[perm[sl]] for a in examples))
-    train_ex, eval_ex, test_ex = (
-        pick(slice(0, cut_val)),
-        pick(slice(cut_val, cut_test)),
-        pick(slice(cut_test, None)),
-    )
+    if scan_meta is not None:
+        # scan mode: no splits — every parsed function is scored
+        train_ex = eval_ex = test_ex = examples
+    else:
+        n = len(examples)
+        rng = np.random.default_rng(jcfg.seed)
+        perm = rng.permutation(n)
+        cut_val, cut_test = int(n * 0.8), int(n * 0.9)
+        pick = lambda sl: type(examples)(*(np.asarray(a)[perm[sl]] for a in examples))
+        train_ex, eval_ex, test_ex = (
+            pick(slice(0, cut_val)),
+            pick(slice(cut_val, cut_test)),
+            pick(slice(cut_test, None)),
+        )
 
-    # --- graphs from the preprocess shards (index-join by function id)
+    # --- graphs: the scanned functions' own encodings (scan mode) or the
+    # preprocess shards (index-join by function id)
     join = None
-    if jcfg.use_gnn:
+    if jcfg.use_gnn and scan_graphs is not None:
+        # budget for the WORST batch (eval_batch_size copies of the largest
+        # scanned function) — the default 4096/8192 budget aborts the whole
+        # scan with a raw ValueError on one big real-world function
+        from deepdfa_tpu.data.graphs import _round_up
+
+        mn = max(g.n_nodes for g in scan_graphs)
+        me = max(g.n_edges for g in scan_graphs)
+        join = GraphJoin.from_list(
+            scan_graphs,
+            max_nodes=max(4096, _round_up(mn * jcfg.eval_batch_size + 2)),
+            max_edges=max(8192, _round_up(me * jcfg.eval_batch_size)),
+        )
+    elif jcfg.use_gnn:
         suffix = "_sample" if args.sample else ""
         shard_dir = utils.processed_dir() / args.dataset / f"shards{suffix}"
         if not shard_dir.exists():
@@ -295,22 +417,33 @@ def main(argv=None) -> dict:
         if state is not None:
             params = state.params
         else:
-            # test-only run: restore the newest epoch checkpoint
-            # (``--load_checkpoint`` parity, train.py:221-224)
-            epochs_saved = sorted(
-                Path(args.output_dir or run_dir).glob("epoch_*"),
-                key=lambda p: int(p.name.split("_")[1]),
-            )
-            if not epochs_saved:
-                raise SystemExit(
-                    f"--do_test without --do_train needs an epoch_* checkpoint "
-                    f"under {run_dir}"
-                )
-            # build the param template by tracing one batch, then load
-            first = trainer._joined(next(text_batches(test_ex, jcfg.eval_batch_size)))
-            template = trainer._build(1, first).params
-            params = trainer.load(template, epochs_saved[-1].name)
+            params, _ = _restore_newest_epoch(
+                trainer, test_ex, jcfg, args.output_dir or run_dir,
+                "--do_test without --do_train")
         out |= trainer.test(params, test_ex)
+    if args.predict_source:
+        params, ckpt_name = _restore_newest_epoch(
+            trainer, examples, jcfg, args.output_dir, "--predict-source")
+        _loss, probs, _labels = trainer._run_eval(params, examples)
+        # _run_eval keeps masked-in rows in batch order; every scan example
+        # owns its graph by construction, so probs align with scan_meta
+        if len(probs) != len(scan_meta):
+            raise RuntimeError(
+                f"scan alignment broke: {len(probs)} probabilities for "
+                f"{len(scan_meta)} functions (missing graphs?)"
+            )
+        results = [
+            {**meta, "vulnerable_probability": round(float(p), 6)}
+            for meta, p in zip(scan_meta, probs[:, 1])
+        ] + scan_errors
+        out = {
+            "results": results,
+            "n_scored": len(scan_meta),
+            "n_errors": len(scan_errors),
+            "checkpoint": ckpt_name,
+            "run_dir": str(run_dir),
+        }
+        (run_dir / "predictions.json").write_text(json.dumps(out, indent=2))
     print(json.dumps(out, default=float))
     return out
 

@@ -215,3 +215,47 @@ def test_predict_paths_reports_empty_directory(tmp_path):
     d.mkdir()
     (d / "x.cpp").write_text("class X {};")
     assert collect_sources([d]) == []
+
+
+@pytest.mark.slow
+def test_joint_fusion_scan(tmp_path, monkeypatch):
+    """--predict-source: the scan surface for the LLM⊕GNN / LineVul fusion
+    family — raw C files through the trained fused classifier. Mechanics
+    under test: per-function rows aligned with probabilities, error rows
+    for unparseable files, standalone-mode guard. (Quality of the fusion
+    model itself is pinned by the recorded linevul demo floor in
+    tests/test_roberta.py.)"""
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import preprocess
+    import train_joint
+
+    preprocess.main(["--dataset", "demo", "--n", "60", "--sample",
+                     "--workers", "1"])
+    run = tmp_path / "joint"
+    train_joint.main(["--dataset", "demo", "--sample", "--encoder", "roberta",
+                      "--do_train", "--epochs", "2",
+                      "--output_dir", str(run)])
+
+    rng = np.random.default_rng(4)
+    scan = tmp_path / "scan"
+    scan.mkdir()
+    (scan / "v.c").write_text(generate_function(8800, True, rng)["before"])
+    (scan / "ok.c").write_text(generate_function(8801, False, rng)["before"])
+    (scan / "broken.c").write_text("not C {{{")
+
+    out = train_joint.main(["--dataset", "demo", "--sample",
+                            "--encoder", "roberta",
+                            "--predict-source", str(scan),
+                            "--output_dir", str(run)])
+    assert out["n_scored"] == 2 and out["n_errors"] == 1
+    rows = {Path(r["file"]).name: r for r in out["results"]}
+    assert "error" in rows["broken.c"]
+    for name in ("v.c", "ok.c"):
+        assert 0.0 <= rows[name]["vulnerable_probability"] <= 1.0
+        assert rows[name]["function"].startswith("f88")
+    assert (run / "predictions.json").exists()
+
+    # standalone-mode guard: scanning is not a training run
+    with pytest.raises(SystemExit):
+        train_joint.main(["--predict-source", str(scan), "--do_train",
+                          "--output_dir", str(run)])
