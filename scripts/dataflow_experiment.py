@@ -157,7 +157,9 @@ def chain_sweep(args) -> dict:
 
 
 def _train_with_curve(dsname: str, epochs: int, seed: int = 0,
-                      probe_grads: bool = True, **model_overrides) -> dict:
+                      probe_grads: bool = True, warm_start: dict | None = None,
+                      return_params: bool = False, freeze_encoder: bool = False,
+                      **model_overrides):
     """Train the golden GGNN on ``dsname`` recording the per-epoch curve,
     the PLATEAU length (first epoch with train acc >= 0.7 — the round-5
     diagnostic that explained the r03 'chain-depth collapse': the task has
@@ -191,6 +193,29 @@ def _train_with_curve(dsname: str, epochs: int, seed: int = 0,
     state = trainer.init_state(
         jax.tree.map(jnp.asarray, next(cli._batch_stream(batcher, train[:64])))
     )
+    if warm_start is not None:
+        # encoder transfer (embeddings + message passing); the head/pooling
+        # keys keep fresh init — the SAME predicate as --freeze_graph
+        # training (train/checkpoint.py is_head_key), not a private copy
+        from deepdfa_tpu.train.checkpoint import encoder_partial_load
+
+        state = state._replace(
+            params=encoder_partial_load(state.params, warm_start))
+    if freeze_encoder:
+        # head-only training: zero encoder updates via the shared
+        # freeze-transfer optimizer (main_cli.py:142-145 parity)
+        from deepdfa_tpu.train.checkpoint import frozen_encoder_optimizer
+        from deepdfa_tpu.train.loop import make_train_step
+
+        trainer.optimizer = frozen_encoder_optimizer(
+            trainer.optimizer, state.params)
+        o = cfg.optim
+        trainer.train_step = make_train_step(
+            model, trainer.optimizer, label_style=cfg.model.label_style,
+            pos_weight=trainer.pos_weight if o.use_weighted_loss else None,
+            undersample_node_on_loss_factor=o.undersample_node_on_loss_factor,
+        )
+        state = state._replace(opt_state=trainer.optimizer.init(state.params))
 
     def grad_norms_per_step(params) -> list[float]:
         """|dL/dh_t| for each message-passing step on one val batch."""
@@ -256,15 +281,18 @@ def _train_with_curve(dsname: str, epochs: int, seed: int = 0,
     test_m, _ = trainer.evaluate(
         state.params, cli._batch_stream(batcher, test), prefix="test_"
     )
-    b = jax.tree.map(jnp.asarray, next(cli._batch_stream(batcher, val)))
-    logits = np.asarray(model.apply({"params": state.params}, b))
-    lab = np.asarray(graph_labels(b))
-    mask = np.asarray(b.graph_mask)
     corr = None
-    if mask.sum() > 2:
-        c = float(np.corrcoef(logits[mask], lab[mask])[0, 1])
-        corr = c if np.isfinite(c) else None  # constant logits/labels -> NaN
-    return {
+    # the logit/label correlation is a GRAPH-label diagnostic (per-node
+    # styles emit [max_nodes] logits — graph_mask doesn't apply)
+    if cfg.model.label_style == "graph":
+        b = jax.tree.map(jnp.asarray, next(cli._batch_stream(batcher, val)))
+        logits = np.asarray(model.apply({"params": state.params}, b))
+        lab = np.asarray(graph_labels(b))
+        mask = np.asarray(b.graph_mask)
+        if mask.sum() > 2:
+            c = float(np.corrcoef(logits[mask], lab[mask])[0, 1])
+            corr = c if np.isfinite(c) else None  # constant → NaN
+    result = {
         "test_f1": round(float(test_m["test_F1Score"]), 4),
         "test_acc": round(float(test_m["test_Accuracy"]), 4),
         "breakthrough_epoch": breakthrough,
@@ -273,6 +301,9 @@ def _train_with_curve(dsname: str, epochs: int, seed: int = 0,
         "curve_tail": curve[-3:],
         "curve_every4": curve[::4],
     }
+    if return_params:
+        return result, state.params
+    return result
 
 
 def rescue(args) -> dict:
@@ -304,6 +335,58 @@ def rescue(args) -> dict:
     return out
 
 
+def union_pretrain(args) -> dict:
+    """The VERDICT-suggested rescue for union_relu's GRAPH-level failure:
+    node-level RD supervision — where the lattice aggregator demonstrably
+    learns the dataflow fixpoint (0.99 F1 at every depth, ``node_level_rd``
+    in ``storage/chain_rescue_r05.json``) — as PRETRAINING, then transfer
+    the encoder (embeddings + message passing) under a fresh graph head.
+    The diagnosis this tests: union's squashed [0,1] membership algebra
+    starves the backward signal from the pooled head; if the encoder
+    already computes reachability when graph training starts, the head
+    only has to read it — no deep credit assignment through the starved
+    chain. Reference thesis op: ``clipper.py:50-77``."""
+    from scripts import preprocess as pp
+
+    depths = [int(x) for x in args.union_pretrain.split(",")]
+    out: dict = {"n": args.n, "epochs": args.epochs, "depths": depths,
+                 "n_steps": 5, "aggregation": "union_relu", "runs": {}}
+    for L in depths:
+        ds = f"demo_chain{L}"
+        summary = pp.main(["--dataset", ds, "--n", str(args.n),
+                           "--seed", str(args.seed), "--dataflow-labels",
+                           "--overwrite"])
+        if summary.get("graphs") != args.n:
+            raise RuntimeError(f"corpus build mismatch for {ds}: {summary}")
+        stage1, donor = _train_with_curve(
+            ds, 15, seed=args.seed, aggregation="union_relu", n_steps=5,
+            label_style="dataflow_solution_out", probe_grads=False,
+            return_params=True,
+        )
+        warm = _train_with_curve(
+            ds, args.epochs, seed=args.seed, aggregation="union_relu",
+            n_steps=5, warm_start=donor,
+        )
+        frozen = _train_with_curve(
+            ds, args.epochs, seed=args.seed, aggregation="union_relu",
+            n_steps=5, warm_start=donor, freeze_encoder=True,
+        )
+        out["runs"][f"L{L}"] = {
+            # cold-start control = the recorded chance-level rescue runs
+            # (storage/chain_rescue_r05.json) — not re-burned here
+            "node_pretrain": stage1,
+            "graph_warmstart": warm,
+            "graph_warmstart_frozen": frozen,
+        }
+        print(f"L{L}: pretrain_node_f1={stage1['test_f1']} "
+              f"warmstart_graph_f1={warm['test_f1']} "
+              f"frozen_graph_f1={frozen['test_f1']} "
+              f"breakthrough={warm['breakthrough_epoch']}/"
+              f"{frozen['breakthrough_epoch']}", file=sys.stderr)
+    print(json.dumps(out))
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=400)
@@ -316,8 +399,14 @@ def main(argv=None):
     ap.add_argument("--rescue", default=None, metavar="L1,L2,...",
                     help="run the round-5 plateau-aware rescue sweep with "
                          "optimization diagnostics (use --epochs >= 150)")
+    ap.add_argument("--union-pretrain", default=None, metavar="L1,L2,...",
+                    help="node-level RD pretraining -> graph-head transfer "
+                         "for the union_relu aggregator (the lattice rescue; "
+                         "use --epochs >= 150 for the graph stage)")
     args = ap.parse_args(argv)
 
+    if args.union_pretrain:
+        return union_pretrain(args)
     if args.rescue:
         return rescue(args)
     if args.chain_sweep:

@@ -49,3 +49,35 @@ def test_dense_quality_recording():
     d = json.loads(path.read_text())
     assert d["segment"]["f1"] >= 0.9
     assert d["dense"]["f1"] >= 0.9
+
+
+def test_union_pretrain_recording():
+    """Round-5 union-pretrain artifact (storage/union_pretrain_r05.json):
+    the pretraining rescue for union_relu's graph-level failure. Pins the
+    shape of the negative result — the encoder learns the RD bit at node
+    level, and BOTH transfer variants (fine-tuned and frozen-encoder,
+    which removes deep credit assignment entirely) stay at chance — so
+    the recorded readout-side diagnosis cannot silently drift. (Fast:
+    reads the recorded artifact, no training.)"""
+    import json
+    from pathlib import Path
+
+    path = (Path(__file__).resolve().parent.parent
+            / "storage/union_pretrain_r05.json")
+    if not path.exists():
+        import pytest
+
+        pytest.skip("recorded union-pretrain artifact not present")
+    d = json.loads(path.read_text())
+    assert d["aggregation"] == "union_relu"
+    for L in d["depths"]:
+        r = d["runs"][f"L{L}"]
+        # the donor genuinely learned the node-level task
+        assert r["node_pretrain"]["test_f1"] >= 0.95, r["node_pretrain"]
+        for variant in ("graph_warmstart", "graph_warmstart_frozen"):
+            w = r[variant]
+            # chance-level accuracy, no breakthrough, no logit signal
+            assert w["test_acc"] < 0.65, (variant, w["test_acc"])
+            assert w["breakthrough_epoch"] is None, variant
+            corr = w["val_logit_label_corr"]
+            assert corr is None or abs(corr) < 0.3, (variant, corr)
