@@ -490,6 +490,22 @@ def named_splits(name: str, path: str | Path | None = None) -> pd.Series:
     return df["split"].replace({"valid": "val", "holdout": "test"})
 
 
+def partition_ids(ids, smap: dict) -> tuple[dict[str, list], int]:
+    """Bucket ``ids`` by a split map into train/val/test; ids the map does
+    not assign are EXCLUDED from every split (the reference drops unmapped
+    rows at load) and counted. ONE implementation for preprocess-time and
+    load-time partitioning — the protocol must not be defined twice."""
+    splits: dict[str, list] = {"train": [], "val": [], "test": []}
+    unassigned = 0
+    for fid in ids:
+        part = smap.get(fid)
+        if part in splits:
+            splits[part].append(fid)
+        else:
+            unassigned += 1
+    return splits, unassigned
+
+
 def splits_map(dsname: str) -> dict:
     """Default fixed-split map per dataset (``datasets.py:431-438``)."""
     if dsname == "bigvul" or dsname.startswith("mutated"):

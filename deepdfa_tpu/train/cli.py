@@ -83,6 +83,28 @@ def load_corpus(cfg: ExperimentConfig) -> dict[str, list[Graph]]:
     splits_file = shard_dir / "splits.json"
     if shard_dir.exists() and splits_file.exists():
         graphs = load_shards(shard_dir)
+        if cfg.data.split not in ("fixed", "random"):
+            # load-time re-partition by a NAMED split (the reference's
+            # `--data.split cross_project_fold_N_{dataset,holdout}`,
+            # run_cross_project.sh): the shards and their vocabulary stay
+            # as preprocessed — only the partition changes, exactly like
+            # test.sh re-splitting at load
+            from deepdfa_tpu.data import ingest
+
+            smap = ingest.named_splits(cfg.data.split).to_dict()
+            by_gid = {g.gid: g for g in graphs}
+            id_splits, missing = ingest.partition_ids(sorted(by_gid), smap)
+            if sum(len(v) for v in id_splits.values()) == 0:
+                raise ValueError(
+                    f"named split {cfg.data.split!r} matched NONE of the "
+                    f"{len(by_gid)} shard graph ids — wrong split file for "
+                    "this corpus?")
+            if missing:
+                logger.warning(
+                    "%d graphs not in named split %r dropped",
+                    missing, cfg.data.split)
+            return {part: [by_gid[i] for i in ids_]
+                    for part, ids_ in id_splits.items()}
         splits = {k: set(v) for k, v in json.loads(splits_file.read_text()).items()}
         # split-leakage guard (reference linevd/datamodule.py:75-78: train/val/
         # test id sets must be pairwise disjoint at construction)

@@ -144,6 +144,15 @@ def main(argv=None) -> dict:
     parser.add_argument("--overwrite", action="store_true")
     parser.add_argument("--limit-all", type=int, default=1000)
     parser.add_argument("--limit-subkeys", type=int, default=1000)
+    parser.add_argument("--split", default="random",
+                        help="random: seeded 70/10/20 (default); fixed: the "
+                        "dataset's protocol split (LineVul for Big-Vul, "
+                        "CodeXGLUE for Devign — ingest.splits_map); any "
+                        "other value: a named split csv under "
+                        "external/splits/<name>.csv (cross-project folds, "
+                        "run_cross_project.sh parity). The split decides "
+                        "the TRAIN-ONLY vocabulary, so protocol parity "
+                        "needs it at preprocess time, not just at fit.")
     parser.add_argument("--dataflow-labels", action="store_true",
                         help="attach _DF_IN/_DF_OUT solver-solution node labels")
     parser.add_argument("--no-cache", action="store_true",
@@ -161,6 +170,17 @@ def main(argv=None) -> dict:
     suffix = "_sample" if args.sample else ""
     out_dir = utils.processed_dir() / args.dataset / f"shards{suffix}"
     if (out_dir / "splits.json").exists() and not args.overwrite:
+        # the split DEFINES the train-only vocabulary: silently serving
+        # shards built under a different split would hand a fold experiment
+        # the wrong partition AND the wrong vocab. Marker absent = legacy
+        # dir (always built random).
+        marker = out_dir / "split.txt"
+        recorded = marker.read_text().strip() if marker.exists() else "random"
+        if recorded != args.split:
+            raise SystemExit(
+                f"{out_dir} was built with split {recorded!r}, not "
+                f"{args.split!r} — pass --overwrite to rebuild (the vocab "
+                "must be rebuilt for the new split)")
         print(json.dumps({"status": "exists", "out": str(out_dir)}))
         return {"status": "exists", "out": str(out_dir)}
 
@@ -249,16 +269,33 @@ def main(argv=None) -> dict:
         if joern_session is not None:
             joern_session.close()
 
-    # 4. split (random 70/10/20 unless the ingest table carries one)
-    rng = np.random.default_rng(args.seed)
+    # 4. split: seeded random 70/10/20, the dataset's fixed protocol split,
+    # or a named (cross-project fold) split file — the choice defines the
+    # train-only vocabulary below, so it must happen HERE
     ids = sorted(cpgs)
-    perm = rng.permutation(len(ids))
-    n_val, n_test = int(len(ids) * 0.1), int(len(ids) * 0.2)
-    splits = {
-        "val": [ids[i] for i in perm[:n_val]],
-        "test": [ids[i] for i in perm[n_val : n_val + n_test]],
-        "train": [ids[i] for i in perm[n_val + n_test :]],
-    }
+    if args.split == "random":
+        rng = np.random.default_rng(args.seed)
+        perm = rng.permutation(len(ids))
+        n_val, n_test = int(len(ids) * 0.1), int(len(ids) * 0.2)
+        splits = {
+            "val": [ids[i] for i in perm[:n_val]],
+            "test": [ids[i] for i in perm[n_val : n_val + n_test]],
+            "train": [ids[i] for i in perm[n_val + n_test :]],
+        }
+    else:
+        from deepdfa_tpu.data import ingest
+
+        smap = (ingest.splits_map(args.dataset) if args.split == "fixed"
+                else ingest.named_splits(args.split).to_dict())
+        splits, unassigned = ingest.partition_ids(ids, smap)
+        if unassigned:
+            print(f"[preprocess] {unassigned}/{len(ids)} functions not in "
+                  f"split {args.split!r} — excluded from all splits",
+                  file=sys.stderr)
+        if not splits["train"]:
+            raise SystemExit(
+                f"split {args.split!r} assigns no TRAIN functions from this "
+                "corpus — the train-only vocabulary would be empty")
 
     # 5. materialize
     builder = CorpusBuilder(
@@ -270,6 +307,7 @@ def main(argv=None) -> dict:
     )
     n_shards = save_shards(graphs, out_dir)
     (out_dir / "splits.json").write_text(json.dumps(splits))
+    (out_dir / "split.txt").write_text(args.split)
     # full form (cfg + subkey_vocabs + all_vocab): `predict` re-encodes NEW
     # source against the training vocab, which needs the subkey vocabs for
     # UNKNOWN substitution — all_vocab alone cannot do that

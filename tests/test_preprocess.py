@@ -286,3 +286,70 @@ def test_devign_preprocess_to_training(tmp_path, monkeypatch):
                 if g.node_feats["_VULN"].max() > 0]
     assert some_vul
     assert all(g.node_feats["_VULN"].min() == 1 for g in some_vul)
+
+
+@pytest.mark.slow
+def test_cross_project_protocol(tmp_path, monkeypatch):
+    """run_cross_project.sh parity, hermetic: fabricated fold split csvs
+    over the demo corpus drive per-fold preprocess (fold-specific
+    train-only vocab), fit, mixed test, and the load-time holdout
+    re-partition — without touching the shard vocab."""
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import importlib
+
+    from deepdfa_tpu import utils
+
+    importlib.reload(utils)
+    import run_cross_project
+
+    # fabricate fold-0 splits over demo ids 0..79: "project A" = ids 0..59
+    # (mixed train/valid/test), "project B" = ids 60..79 (holdout)
+    splits_dir = utils.external_dir() / "splits"
+    splits_dir.mkdir(parents=True, exist_ok=True)
+    # reference csv shape: pandas to_csv with a leading row-index column
+    rows_ds = [",example_index,split"]
+    rows_ho = [",example_index,split"]
+    for i in range(60):
+        part = "valid" if i % 10 == 8 else "test" if i % 10 == 9 else "train"
+        rows_ds.append(f"{i},{i},{part}")
+        rows_ho.append(f"{i},{i},train")
+    for j, i in enumerate(range(60, 80)):
+        rows_ho.append(f"{60 + j},{i},holdout")
+    (splits_dir / "cross_project_fold_0_dataset.csv").write_text(
+        "\n".join(rows_ds))
+    (splits_dir / "cross_project_fold_0_holdout.csv").write_text(
+        "\n".join(rows_ho))
+
+    agg = run_cross_project.main([
+        "--dataset", "demo", "--folds", "1", "--n", "80",
+        "--out", str(tmp_path / "xp"),
+        "--set", "optim.max_epochs=4",
+    ])
+    f0 = agg["folds"]["fold_0"]
+    assert f0["mixed_test_f1"] is not None
+    assert f0["holdout_test_f1"] is not None
+    assert agg["holdout_f1_mean"] == round(f0["holdout_test_f1"], 4)
+    # the fold's shards carry the NAMED split (ids 60..79 in no partition)
+    shard_dir = utils.processed_dir() / "demo" / "shards"
+    splits = json.loads((shard_dir / "splits.json").read_text())
+    all_assigned = set(splits["train"]) | set(splits["val"]) | set(splits["test"])
+    assert all_assigned == set(range(60))
+    assert (tmp_path / "xp" / "cross_project.json").exists()
+
+
+def test_preprocess_split_marker_guards_idempotence(tmp_path, monkeypatch):
+    """Re-running preprocess with a DIFFERENT --split must refuse to serve
+    the stale shards (their vocab was built under the other split), not
+    silently return status=exists."""
+    monkeypatch.setenv("DEEPDFA_STORAGE", str(tmp_path / "storage"))
+    import preprocess
+
+    assert preprocess.main(["--dataset", "demo", "--n", "30",
+                            "--workers", "1"])["status"] == "ok"
+    # same split: idempotent
+    assert preprocess.main(["--dataset", "demo", "--n", "30",
+                            "--workers", "1"])["status"] == "exists"
+    # different split: refuse
+    with pytest.raises(SystemExit, match="built with split 'random'"):
+        preprocess.main(["--dataset", "demo", "--n", "30", "--workers", "1",
+                         "--split", "some_fold"])
