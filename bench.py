@@ -779,7 +779,10 @@ def _banked_root() -> str:
             or os.path.dirname(os.path.abspath(__file__)))
 
 
-def _banked_ggnn_artifacts() -> list[tuple[float, str, dict]]:
+GOLDEN_CONFIG = "hidden32_steps5_concat4_batch256"
+
+
+def _banked_ggnn_artifacts(backends=("tpu",)) -> list[tuple[float, str, dict]]:
     """On-chip ggnn artifacts banked by the watcher battery, newest last —
     from the CURRENT round's dir only (the highest-numbered
     ``storage/tpu_artifacts_r*``): each round's battery measures that
@@ -812,7 +815,7 @@ def _banked_ggnn_artifacts() -> list[tuple[float, str, dict]]:
         age_anchor = art.get("emitted_at_unix") or os.path.getmtime(p)
         if time.time() - age_anchor > max_age_s:
             continue
-        if (art.get("backend") == "tpu"
+        if (art.get("backend") in backends
                 and art.get("metric") == "ggnn_inference_graphs_per_sec"
                 and not art.get("replayed_from_banked")):
             out.append((os.path.getmtime(p), p, art))
@@ -893,7 +896,10 @@ def replay_banked(reason: str) -> bool:
     # that wedged before the baseline stage, adopt it from any banked
     # candidate of the same workload rather than shipping a null column.
     if not result.get("baseline_graphs_per_sec"):
-        for c in reversed(cands):
+        # CPU-FALLBACK artifacts qualify here too: the torch baseline is
+        # host-side, so a fallback's full-fidelity 20-step measurement
+        # beats re-measuring a quick one at replay time
+        for c in reversed(_banked_ggnn_artifacts(backends=("tpu", "cpu"))):
             if (c[2].get("baseline_graphs_per_sec")
                     and c[2].get("config") == result.get("config")):
                 result["baseline_graphs_per_sec"] = c[2]["baseline_graphs_per_sec"]
@@ -901,6 +907,30 @@ def replay_banked(reason: str) -> bool:
                        for s in sources):
                     sources.append(_src(c))
                 break
+    if (not result.get("baseline_graphs_per_sec")
+            and result.get("config") == GOLDEN_CONFIG):
+        # no banked run ever reached the baseline stage: measure it NOW —
+        # the torch-CPU comparison never touches the (dead) device, and a
+        # replayed artifact must not ship a null vs_baseline column (the
+        # r04 verdict called that a regression). Gated on the banked config
+        # matching THIS code's workload — ratioing a banked number against
+        # a different workload's baseline would be a fabrication.
+        try:
+            from deepdfa_tpu.config import FeatureConfig
+
+            corpus = build_corpus(int(2 * 256 * 1.5),
+                                  FeatureConfig().input_dim)
+            batches, _occ = build_batches(corpus, 2)
+            result["baseline_graphs_per_sec"] = round(
+                bench_torch_cpu(batches, steps=5), 1)
+            result["baseline_note"] = (
+                "torch-cpu baseline measured at replay time (5 steps, same "
+                "corpus construction) — the banked run wedged before its "
+                "baseline stage")
+        except Exception as e:  # never let the baseline sink the replay
+            result["baseline_note"] = (
+                f"baseline measurement at replay failed: "
+                f"{type(e).__name__}: {e}")
     # Re-derive the headline over the merged pair. graphs/step is
     # recoverable exactly as rate × step time (both measured in the same
     # run), so per-graph FLOPs — and hence implied TFLOP/s and the MFU and
@@ -1085,7 +1115,7 @@ def _assemble_result(backend, device_kind, roofline, occupancy, real_graphs,
             "inference at hidden-32 is gather/scatter-bound on GPUs too, "
             "with typical MFU well under 5% — the ratio is a lower bound"
         ),
-        "config": "hidden32_steps5_concat4_batch256",
+        "config": GOLDEN_CONFIG,
         "git_rev": _git_rev(),
         # wall-clock provenance: file mtimes reset on checkout/clone, so
         # the replay freshness window reads this embedded stamp instead

@@ -484,3 +484,45 @@ def test_peak_batches_usage_error_exits_2():
     assert ns.peak_batches == (1024, 2048)
     assert bench._build_parser().parse_args(
         ["--peak-batches", ""]).peak_batches == ()
+
+
+def test_replay_banked_measures_missing_baseline(tmp_path, monkeypatch,
+                                                 capsys):
+    """If NO banked run reached the torch-baseline stage, replay measures
+    it at emit time (host-only, device not needed) — a replayed artifact
+    must never ship vs_baseline: null."""
+    monkeypatch.setenv("BENCH_BANKED_ROOT", str(tmp_path))
+    _banked(tmp_path, "bench_ggnn_segment",
+            {**_SEG_ART, "baseline_graphs_per_sec": None,
+             "vs_baseline": None,
+             "partial_through_stage": "superbatch-1024"})
+    monkeypatch.setattr(bench, "bench_torch_cpu", lambda b, steps: 900.0)
+    assert bench.replay_banked("relay dead") is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["baseline_graphs_per_sec"] == 900.0
+    assert out["vs_baseline"] == round(76580.0 / 900.0, 2)
+    assert "measured at replay time" in out["baseline_note"]
+
+
+def test_replay_banked_adopts_cpu_fallback_baseline(tmp_path, monkeypatch,
+                                                    capsys):
+    """A CPU-fallback artifact's full-fidelity host-side baseline beats
+    re-measuring a quick one at replay time."""
+    monkeypatch.setenv("BENCH_BANKED_ROOT", str(tmp_path))
+    _banked(tmp_path, "bench_ggnn_segment",
+            {**_SEG_ART, "baseline_graphs_per_sec": None,
+             "vs_baseline": None,
+             "partial_through_stage": "superbatch-1024"})
+    _banked(tmp_path, "bench_ggnn_cpu",
+            {**_SEG_ART, "backend": "cpu", "segment_graphs_per_sec": 500.0,
+             "value": 500.0, "baseline_graphs_per_sec": 877.7})
+
+    def boom(*a, **k):
+        raise AssertionError("must not re-measure when a banked baseline exists")
+
+    monkeypatch.setattr(bench, "bench_torch_cpu", boom)
+    assert bench.replay_banked("relay dead") is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 76580.0  # the TPU number, never the CPU one
+    assert out["baseline_graphs_per_sec"] == 877.7
+    assert out["vs_baseline"] == round(76580.0 / 877.7, 2)
