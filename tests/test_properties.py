@@ -169,3 +169,48 @@ def test_segment_union_algebra(data):
         assert out.shape == (n, d)
         assert np.all(out >= -1e-6) and np.all(out <= 1 + 1e-6)
         np.testing.assert_allclose(out[receivers[0]], 1.0, atol=1e-5)
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.data())
+def test_vocab_serialisation_roundtrip_property(data):
+    """`predict` encodes NEW code with a JSON-deserialised vocabulary —
+    for ANY corpus of definition hashes and any limits, every feature id
+    (including out-of-vocab UNKNOWN substitutions) must survive
+    to_dict → json → from_dict exactly."""
+    import json as _json
+
+    import pandas as pd
+
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.data.vocab import Vocabulary, build_vocab
+
+    val = st.text(alphabet="abcxyz_0123456789", min_size=1, max_size=8)
+    n_rows = data.draw(st.integers(2, 30))
+    rows = []
+    for i in range(n_rows):
+        h = {
+            "api": data.draw(st.lists(val, max_size=3)),
+            "datatype": data.draw(st.lists(val, max_size=1)),
+            "literal": data.draw(st.lists(val, max_size=2)),
+            "operator": data.draw(st.lists(val, max_size=2)),
+        }
+        rows.append({"graph_id": i % 5, "node_id": i,
+                     "hash": _json.dumps(h)})
+    df = pd.DataFrame(rows)
+    cfg = FeatureConfig(
+        limit_all=data.draw(st.integers(1, 50)),
+        limit_subkeys=data.draw(st.integers(1, 50)),
+        include_unknown=data.draw(st.booleans()),
+    )
+    voc = build_vocab(df, train_ids=range(3), cfg=cfg)
+    back = Vocabulary.from_dict(_json.loads(_json.dumps(voc.to_dict())))
+    assert back.cfg == voc.cfg
+    # every training hash, plus unseen ones (UNKNOWN path), encode equal
+    probes = [r["hash"] for r in rows] + [
+        _json.dumps({"api": ["never_in_train"], "datatype": [],
+                     "literal": [], "operator": []}),
+        None,  # not-a-definition
+    ]
+    for h in probes:
+        assert back.feature_id(h) == voc.feature_id(h), h
