@@ -1,0 +1,162 @@
+"""Model export for serving — `deepdfa-tpu export`.
+
+Serializes the trained GGNN scoring forward (parameters baked in as
+constants) to a portable StableHLO artifact via ``jax.export``. The
+artifact is self-contained: a server deserializes and calls it WITHOUT
+the model code, the config system, or the checkpoint machinery — only
+jax and the batch arrays. The reference has no deployment story at all
+(its test harness is the only inference path); this is the TPU-native
+one: one compiled program, fixed shapes, runnable on the backends baked
+into the artifact's lowering ``platforms`` (default cpu+tpu; jax.export
+platform-checks at call time — it does not re-lower).
+
+Artifact layout (one directory):
+- ``model.stablehlo``  — the serialized exported function;
+- ``manifest.json``    — input schema (shapes/dtypes of the batch pytree,
+  in flattened tree order), the producing config, and provenance.
+
+The exported function maps a :class:`BatchedGraphs`-shaped pytree of the
+manifest's fixed shapes to per-graph vulnerability probabilities
+``[max_graphs]`` (graph label style) or per-node probabilities
+``[max_nodes]`` (node style) — padding slots carry garbage; callers mask
+with ``graph_mask``/``node_mask`` exactly as in training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.config import ExperimentConfig, to_json
+from deepdfa_tpu.data.graphs import BatchedGraphs, Graph, batch_np
+
+__all__ = ["export_ggnn", "load_exported", "example_batch"]
+
+
+def _register_pytrees() -> None:
+    """jax.export serializes the input PyTreeDef; custom containers must be
+    registered once under a stable name (the name is part of the artifact
+    contract — both the exporter and every loader call this)."""
+    from jax import export as jexport
+
+    try:
+        jexport.register_namedtuple_serialization(
+            BatchedGraphs,
+            serialized_name="deepdfa_tpu.data.graphs.BatchedGraphs")
+    except ValueError:
+        pass  # already registered in this process
+
+
+def example_batch(cfg: ExperimentConfig, vocab_keys=None) -> BatchedGraphs:
+    """A structurally-valid batch at the config's ceiling shapes — the
+    shape contract the exported program is specialized to."""
+    b = cfg.data.batch
+    n = 4
+    # feature columns ONLY — the exported program never reads labels, and a
+    # server must not have to fabricate a _VULN column to call it
+    feats: dict[str, np.ndarray] = {}
+    if vocab_keys is None:
+        from deepdfa_tpu.config import ALL_SUBKEYS
+
+        vocab_keys = ([f"_ABS_DATAFLOW_{sk}" for sk in ALL_SUBKEYS]
+                      if cfg.model.concat_all_absdf else ["_ABS_DATAFLOW"])
+    for key in vocab_keys:
+        feats[key] = np.zeros(n, np.int32)
+    g = Graph(
+        senders=np.arange(n - 1, dtype=np.int32),
+        receivers=np.arange(1, n, dtype=np.int32),
+        node_feats=feats,
+    ).with_self_loops()
+    return batch_np([g], b.batch_graphs + 1, b.max_nodes, b.max_edges)
+
+
+def export_ggnn(cfg: ExperimentConfig, params, out_dir: str | Path,
+                vocab_keys=None, model=None, example=None,
+                platforms=("cpu", "tpu"), provenance: dict | None = None) -> Path:
+    """Serialize ``sigmoid(model(batch))`` with ``params`` baked in.
+
+    ``platforms``: lowering targets baked into the artifact — export on a
+    TPU host must stay loadable on a CPU serving box and vice versa
+    (jax.export platform-checks at call time, it does NOT re-lower).
+    ``model``/``example``: pass the already-built pair when the caller
+    constructed them for checkpoint restore (cli.export_model) so the two
+    can never diverge."""
+    from jax import export as jexport
+
+    from deepdfa_tpu.models import make_model
+
+    _register_pytrees()
+    if model is None:
+        model = make_model(cfg.model, cfg.input_dim)
+
+    def score(batch: BatchedGraphs):
+        return jax.nn.sigmoid(model.apply({"params": params}, batch))
+
+    ex = example_batch(cfg, vocab_keys) if example is None else example
+    args_spec = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype), ex)
+    exported = jexport.export(jax.jit(score),
+                              platforms=list(platforms))(args_spec)
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    (out_dir / "model.stablehlo").write_bytes(exported.serialize())
+    leaves, treedef = jax.tree.flatten(ex)
+    manifest = {
+        "format": "jax.export stablehlo",
+        "callable": "sigmoid(GGNN(batch)) — probabilities; mask padding "
+                    "with graph_mask/node_mask",
+        "label_style": cfg.model.label_style,
+        "layout": cfg.model.layout,
+        "input_treedef": str(treedef),
+        "node_feat_keys": sorted(ex.node_feats),
+        "input_leaves": [
+            {"shape": list(np.shape(x)), "dtype": str(np.asarray(x).dtype)}
+            for x in leaves
+        ],
+        "platforms": list(platforms),
+        "config": json.loads(to_json(cfg)),
+        "provenance": provenance or {},
+    }
+    (out_dir / "manifest.json").write_text(json.dumps(manifest, indent=2))
+    return out_dir
+
+
+@dataclasses.dataclass
+class _Servable:
+    """Deserialized model: call with a BatchedGraphs of the manifest shapes."""
+
+    exported: object
+    manifest: dict
+
+    def __call__(self, batch: BatchedGraphs) -> np.ndarray:
+        # conform to the exported schema: batches may carry extra feature
+        # columns (e.g. labels, solver bits) the program never read —
+        # select exactly the manifest's keys; missing ones are a clear
+        # error here, not a pytree-structure stack trace
+        want = self.manifest["node_feat_keys"]
+        missing = [k for k in want if k not in batch.node_feats]
+        if missing:
+            raise ValueError(
+                f"batch is missing node_feats {missing} required by the "
+                f"exported model (manifest node_feat_keys={want})")
+        batch = batch._replace(
+            node_feats={k: batch.node_feats[k] for k in want})
+        dev = jax.tree.map(jnp.asarray, batch)
+        return np.asarray(self.exported.call(dev))
+
+
+def load_exported(out_dir: str | Path) -> _Servable:
+    from jax import export as jexport
+
+    _register_pytrees()
+    out_dir = Path(out_dir)
+    exported = jexport.deserialize(
+        (out_dir / "model.stablehlo").read_bytes())
+    manifest = json.loads((out_dir / "manifest.json").read_text())
+    return _Servable(exported=exported, manifest=manifest)

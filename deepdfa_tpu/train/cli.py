@@ -755,6 +755,47 @@ def predict(
     return report
 
 
+def export_model(
+    cfg: ExperimentConfig, run_dir: Path, ckpt_dir: Path | None = None
+) -> dict:
+    """Serialize the trained scoring forward to a portable StableHLO
+    artifact (``deepdfa_tpu/serving.py``) — params baked in, loadable
+    without the model code. Restores best-else-latest exactly like
+    ``test``/``predict``."""
+    import dataclasses
+
+    from deepdfa_tpu.serving import example_batch, export_ggnn
+
+    # serve the segment forward: checkpoints are layout-portable (shared
+    # param tree), and the exported schema is a BatchedGraphs — same
+    # coercion predict applies
+    if cfg.model.layout != "segment":
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, layout="segment"))
+    model = make_model(cfg.model, cfg.input_dim)
+    example = jax.tree.map(jnp.asarray, example_batch(cfg))
+    params = model.init(jax.random.key(0), example)["params"]
+    ckpts = CheckpointManager(ckpt_dir or run_dir / "checkpoints", cfg.checkpoint)
+    if ckpts.latest_step() is None:
+        raise FileNotFoundError(
+            f"no checkpoint under {ckpt_dir or run_dir / 'checkpoints'} — "
+            "export serializes a TRAINED model; run fit first"
+        )
+    params = _restore_params(ckpts, params)
+    best = ckpts.best_step()
+    provenance = {
+        "checkpoint_dir": str(ckpt_dir or run_dir / "checkpoints"),
+        "restored": ("best" if best is not None else "latest"),
+        "step": int(best if best is not None else ckpts.latest_step()),
+    }
+    out = export_ggnn(cfg, params, run_dir / "export",
+                      model=model, example=example, provenance=provenance)
+    size = (out / "model.stablehlo").stat().st_size
+    result = {"export_dir": str(out), "stablehlo_bytes": size, **provenance}
+    print(json.dumps(result))
+    return result
+
+
 def analyze(cfg: ExperimentConfig, run_dir: Path) -> dict:
     """The ``--analyze_dataset`` equivalent (``run_analyze_dataset.sh`` /
     ``get_coverage``): per-split feature+solution coverage at the
@@ -819,14 +860,16 @@ def _parse_overrides(pairs: Sequence[str]) -> dict:
 
 def main(argv: Sequence[str] | None = None) -> dict:
     parser = argparse.ArgumentParser(prog="deepdfa-tpu")
-    parser.add_argument("command", choices=["fit", "test", "analyze", "predict"])
+    parser.add_argument("command",
+                        choices=["fit", "test", "analyze", "predict",
+                                 "export"])
     parser.add_argument("--config", action="append", default=[],
                         help="layered config files (later files win)")
     parser.add_argument("--set", action="append", default=[], dest="overrides",
                         help="dotted overrides, e.g. --set optim.max_epochs=3")
     parser.add_argument("--run-dir", default=None)
     parser.add_argument("--ckpt-dir", default=None,
-                        help="checkpoint dir for test/predict")
+                        help="checkpoint dir for test/predict/export")
     parser.add_argument("--source", action="append", default=[],
                         help="predict: C file or directory (repeatable)")
     parser.add_argument("--top-k", type=int, default=5,
@@ -842,7 +885,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         parser.error("predict requires at least one --source")
 
     layers = list(args.config)
-    if args.command == "predict" and args.run_dir:
+    if args.command in ("predict", "export") and args.run_dir:
         # score with the RUN'S OWN recorded config as the base layer (CLI
         # configs/overrides still win): `predict --run-dir <fit dir>` must
         # restore a non-default-trained checkpoint without the caller
@@ -868,7 +911,8 @@ def main(argv: Sequence[str] | None = None) -> dict:
     )
     from deepdfa_tpu.config import to_json
 
-    if args.command != "predict" or not (run_dir / "config.json").exists():
+    if (args.command not in ("predict", "export")
+            or not (run_dir / "config.json").exists()):
         # no-clobber for predict: it is routinely pointed AT a fit run dir
         # (README usage) and must not overwrite the trained run's recorded
         # config — but a FRESH predict run dir still gets provenance
@@ -884,6 +928,10 @@ def main(argv: Sequence[str] | None = None) -> dict:
             return predict(cfg, run_dir, args.source,
                            Path(args.ckpt_dir) if args.ckpt_dir else None,
                            top_k=args.top_k, saliency=args.saliency)
+        if args.command == "export":
+            return export_model(
+                cfg, run_dir,
+                Path(args.ckpt_dir) if args.ckpt_dir else None)
         return analyze(cfg, run_dir)
     except Exception:
         # crash marker parity: rename log to .log.error (main_cli.py:324-336).
@@ -891,7 +939,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         # failed scan must not mark the completed TRAINING run as crashed.
         for h in handlers:
             h.close()
-        if args.command != "predict":
+        if args.command not in ("predict", "export"):
             log_file.rename(log_file.with_suffix(".log.error"))
         raise
 
