@@ -88,3 +88,37 @@ def test_export_cli_end_to_end(tmp_path, monkeypatch):
     assert result_d["stablehlo_bytes"] > 0
     assert (load_exported(result_d["export_dir"])
             .manifest["layout"] == "segment")
+
+
+def test_servable_rejects_missing_feature_keys(tmp_path):
+    """The servable conforms batches to its manifest — a batch missing a
+    required feature column fails with the manifest's key list, not a
+    pytree-structure stack trace."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import ExperimentConfig
+    from deepdfa_tpu.models import make_model
+    from deepdfa_tpu.serving import example_batch, export_ggnn, load_exported
+
+    cfg = ExperimentConfig()
+    model = make_model(cfg.model, cfg.input_dim)
+    ex = jax.tree.map(jnp.asarray, example_batch(cfg))
+    params = model.init(jax.random.key(0), ex)["params"]
+    servable = load_exported(export_ggnn(cfg, params, tmp_path / "e"))
+
+    crippled = ex._replace(node_feats={
+        k: v for k, v in ex.node_feats.items() if not k.endswith("_api")})
+    with pytest.raises(ValueError, match="_ABS_DATAFLOW_api"):
+        servable(crippled)
+
+
+def test_export_cli_requires_checkpoint(tmp_path):
+    """export serializes a TRAINED model — no checkpoint is a clear error,
+    not a silently-exported fresh init."""
+    from deepdfa_tpu.train import cli
+
+    with pytest.raises(FileNotFoundError, match="run fit first"):
+        cli.main(["export", "--run-dir", str(tmp_path / "empty")])
