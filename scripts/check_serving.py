@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Serving-artifact round-trip check on the local accelerator.
+
+Exports the GGNN scoring forward (fresh params — this validates the
+SERIALIZATION contract, which is training-independent), deserializes it,
+and calls it on a real random batch on whatever backend jax finds,
+comparing against the live ``model.apply``. On the TPU this is the proof
+that the cpu+tpu-lowered StableHLO artifact (`deepdfa_tpu/serving.py`)
+actually executes on the chip — the CPU suite can only check the cpu leg.
+
+Prints ONE JSON line: ``{metric, value (max abs diff), unit, vs_baseline,
+backend, ok}``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+TOL = 2e-4  # bf16-model probabilities re-lowered per backend
+
+
+def main(argv=None) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepdfa_tpu.config import ExperimentConfig
+    from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+    from deepdfa_tpu.data.synthetic import random_dataset
+    from deepdfa_tpu.models import make_model
+    from deepdfa_tpu.serving import example_batch, export_ggnn, load_exported
+
+    backend = jax.default_backend()
+    cfg = ExperimentConfig()
+    model = make_model(cfg.model, cfg.input_dim)
+    ex = jax.tree.map(jnp.asarray, example_batch(cfg))
+    params = model.init(jax.random.key(0), ex)["params"]
+
+    with tempfile.TemporaryDirectory(prefix="serving-check-") as tmp:
+        servable = load_exported(export_ggnn(cfg, params, tmp))
+        b = cfg.data.batch
+        batcher = GraphBatcher(
+            [BucketSpec(b.batch_graphs + 1, b.max_nodes, b.max_edges)])
+        batch = next(iter(batcher.batches(
+            random_dataset(128, seed=11, input_dim=cfg.input_dim))))
+        got = servable(batch)
+        want = np.asarray(jax.nn.sigmoid(model.apply(
+            {"params": params}, jax.tree.map(jnp.asarray, batch))))
+        mask = np.asarray(batch.graph_mask)
+        diff = float(np.max(np.abs(got[mask] - want[mask])))
+
+    result = {
+        "metric": "serving_roundtrip_max_abs_diff",
+        "value": diff,
+        "unit": "probability",
+        "vs_baseline": None,
+        "backend": backend,
+        "device_kind": jax.devices()[0].device_kind,
+        "tolerance": TOL,
+        "ok": diff <= TOL,
+    }
+    # rc stays 0 even on a tolerance failure: the artifact carries ok:false
+    # + the measured diff — a nonzero rc would make the watchdog misread a
+    # numerical regression as device trouble, discard this JSON, and
+    # overwrite it with a passing CPU fallback
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    import os
+
+    if os.environ.get("_BENCH_CHILD") == "1":
+        main()
+    else:
+        from bench import run_with_device_watchdog
+
+        raise SystemExit(run_with_device_watchdog(__file__, sys.argv[1:]))

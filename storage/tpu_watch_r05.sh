@@ -21,7 +21,7 @@ mkdir -p "$ART"
 # (a stage added to one but not the other once risked a false
 # "battery complete")
 STAGES=(bench_ggnn_segment bench_int8_prefill bench_int8_decode
-        bench_llm_qlora bench_ggnn_dense perf_eval_full)
+        bench_llm_qlora bench_ggnn_dense serving_check perf_eval_full)
 log() { echo "[$(date -u +%H:%M:%S)] $*" >>"$LOG"; }
 
 probe() {
@@ -78,6 +78,8 @@ while true; do
     run_one bench_int8_decode   4500 python scripts/bench_int8_llm.py --decode 128 --batch 8
     run_one bench_llm_qlora     4500 python bench_llm.py
     run_one bench_ggnn_dense    4500 python bench.py --layout dense
+    # serving artifact executes ON the chip (cpu leg is suite-covered)
+    run_one serving_check       4500 python scripts/check_serving.py
     # quality-on-chip: the reference's 3-stage protocol (DeepDFA / LineVul /
     # DeepDFA+LineVul) end-to-end on the TPU — wall times + test F1. Runs
     # after every throughput stage: it compiles many distinct programs

@@ -94,8 +94,6 @@ def test_servable_rejects_missing_feature_keys(tmp_path):
     """The servable conforms batches to its manifest — a batch missing a
     required feature column fails with the manifest's key list, not a
     pytree-structure stack trace."""
-    import dataclasses as dc
-
     import jax
     import jax.numpy as jnp
 
