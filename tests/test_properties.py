@@ -214,3 +214,46 @@ def test_vocab_serialisation_roundtrip_property(data):
     ]
     for h in probes:
         assert back.feature_id(h) == voc.feature_id(h), h
+
+
+@settings(max_examples=12, deadline=None)
+@given(st.data())
+def test_dense_segment_forward_parity_property(data):
+    """The dense-adjacency forward must agree with the segment forward on
+    shared params for ANY corpus shape — random graph counts, sizes, seeds
+    and aggregators, not just the fixed parity fixtures. The segment path
+    is the DGL-parity anchor, so this chains every dense configuration to
+    the reference semantics."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import GGNNConfig
+    from deepdfa_tpu.data.dense import batch_dense
+    from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+    from deepdfa_tpu.data.synthetic import random_dataset
+    from deepdfa_tpu.models.ggnn import GGNN
+    from deepdfa_tpu.models.ggnn_dense import GGNNDense
+
+    input_dim = 23
+    n = data.draw(st.integers(2, 8))
+    seed = data.draw(st.integers(0, 10_000))
+    mean_nodes = data.draw(st.integers(4, 20))
+    agg = data.draw(st.sampled_from(["sum", "union_relu", "union_simple"]))
+    graphs = random_dataset(n, seed=seed, input_dim=input_dim,
+                            mean_nodes=mean_nodes)
+
+    sparse = next(GraphBatcher(
+        [BucketSpec(n + 1, 2048, 4096)]).batches(graphs))
+    dense = batch_dense(graphs, max_graphs=n,
+                        nodes_per_graph=max(g.n_nodes for g in graphs))
+
+    cfg = GGNNConfig(hidden_dim=4, n_steps=2, num_output_layers=2,
+                     aggregation=agg)
+    sm = GGNN(cfg=cfg, input_dim=input_dim)
+    dm = GGNNDense(cfg=cfg, input_dim=input_dim)
+    sb = jax.tree.map(jnp.asarray, sparse)
+    db = jax.tree.map(jnp.asarray, dense)
+    params = sm.init(jax.random.key(seed % 7), sb)["params"]
+    out_s = np.asarray(sm.apply({"params": params}, sb))
+    out_d = np.asarray(dm.apply({"params": params}, db))
+    np.testing.assert_allclose(out_d[:n], out_s[:n], rtol=2e-4, atol=2e-4)
