@@ -1142,12 +1142,13 @@ def _build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--baseline-steps", type=int, default=20)
     ap.add_argument("--batches", type=int, default=4)
     ap.add_argument("--skip-baseline", action="store_true")
-    ap.add_argument("--peak-batches", type=_peak_list, default="1024,2048",
+    ap.add_argument("--peak-batches", type=_peak_list, default="1024",
                     help="comma-separated superbatch sizes for the peak "
-                    "stage ('' skips it). The 2048 superbatch is a ~113k-"
-                    "node unrolled compile — through a flaky tunnel it can "
-                    "outlive the whole stage budget (round 5 lost 28+ min "
-                    "to it), so batteries can run the safe sizes only.")
+                    "stage ('' skips it). 2048 is opt-in: its ~113k-node "
+                    "unrolled compile hung TPU runs for 28+ min twice in "
+                    "round 5 and has never completed on the chip — the "
+                    "default protocol must not gamble the driver's one "
+                    "round-end run on it.")
     ap.add_argument("--layout", choices=("both", "segment", "dense"),
                     default="both",
                     help="segment: skip the dense-adjacency stage; dense: "
