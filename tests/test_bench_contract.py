@@ -526,3 +526,34 @@ def test_replay_banked_adopts_cpu_fallback_baseline(tmp_path, monkeypatch,
     assert out["value"] == 76580.0  # the TPU number, never the CPU one
     assert out["baseline_graphs_per_sec"] == 877.7
     assert out["vs_baseline"] == round(76580.0 / 877.7, 2)
+
+
+@pytest.mark.slow
+def test_round_end_replay_from_repo_artifacts():
+    """The driver-scenario dress rehearsal, pinned: `python bench.py` with
+    a dead device backend must emit the REAL banked on-chip artifact from
+    storage/tpu_artifacts_r*/ (backend tpu, non-null vs_baseline) — if
+    someone deletes or breaks the banked evidence, this fails loudly
+    before the round-end run does."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    if not list(repo.glob("storage/tpu_artifacts_r*/bench_ggnn*.json")):
+        pytest.skip("no banked artifacts in this checkout")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "bogus"  # device probe fails fast
+    env["BENCH_DEVICE_PROBE_TIMEOUT_S"] = "10"
+    env.pop("BENCH_BANKED_ROOT", None)
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench.py")], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["backend"] == "tpu"
+    assert out["value"] and out["value"] > 1000
+    assert out["vs_baseline"] is not None
+    assert out["replayed_from_banked"]
