@@ -28,6 +28,17 @@ ALL_SUBKEYS = ("api", "datatype", "literal", "operator")
 # ``datasets.py:550-556``): datatype has exactly one value per def.
 SINGLE_SUBKEYS = {"api": False, "datatype": True, "literal": False, "operator": False}
 
+# Extra abstract-dataflow feature families from the static-analysis suite
+# (cpg/analyses.py), enabled by ``FeatureConfig.dataflow_families``. Unlike
+# the vocabulary subkeys these are small closed value sets, so each family
+# gets its own fixed-size embedding table:
+#   live_out — |live_out(n)| clipped to DFA_LIVE_OUT_CLIP (values 0..clip);
+#   uninit   — node reads a possibly-uninitialized local (0/1);
+#   taint    — 0 untouched / 1 uses tainted var / 2 introduces taint.
+DFA_FAMILIES = ("live_out", "uninit", "taint")
+DFA_LIVE_OUT_CLIP = 16
+DFA_FEATURE_DIMS = {"live_out": DFA_LIVE_OUT_CLIP + 1, "uninit": 2, "taint": 3}
+
 
 @dataclass(frozen=True)
 class FeatureConfig:
@@ -42,6 +53,10 @@ class FeatureConfig:
     limit_all: int | None = 1000
     combined: bool = True  # the "_all" combined-hash vocabulary
     include_unknown: bool = False  # "includeunknown" variant
+    # emit the static-analysis feature families (DFA_FAMILIES) alongside the
+    # vocabulary subkeys; propagated to GGNNConfig.dataflow_families by
+    # ExperimentConfig so the model widens its input in lockstep
+    dataflow_families: bool = False
 
     def __post_init__(self):
         for k in self.subkeys:
@@ -106,12 +121,19 @@ class GGNNConfig:
     # the TPU fast path; models/ggnn_dense.py). Same parameter tree either
     # way: checkpoints interchange between layouts.
     layout: str = "segment"
+    # widen the input with the static-analysis families (DFA_FAMILIES): one
+    # hidden_dim-sized embedding table per family, concatenated after the
+    # subkey embeddings — usually set via FeatureConfig.dataflow_families
+    dataflow_families: bool = False
 
     @property
     def out_dim(self) -> int:
         """Pooled embedding width: embed + hidden, each ×4 when concatenating
-        all four subkey embeddings (``ggnn.py:47-64``)."""
+        all four subkey embeddings (``ggnn.py:47-64``), plus one hidden_dim
+        slice per static-analysis family when enabled."""
         mult = len(ALL_SUBKEYS) if self.concat_all_absdf else 1
+        if self.dataflow_families:
+            mult += len(DFA_FAMILIES)
         return 2 * self.hidden_dim * mult
 
 
@@ -217,6 +239,15 @@ class ExperimentConfig:
     # tensorboard/xprof) — the TPU analogue of the reference's torch CUDA
     # event + DeepSpeed profiling pair (SURVEY.md §5)
     trace: bool = False
+
+    def __post_init__(self):
+        # data→model link for the static-analysis families (same spirit as
+        # the input_dim property below): when the data pipeline emits them,
+        # the model must widen — a standalone model flag stays untouched
+        if self.data.feature.dataflow_families and not self.model.dataflow_families:
+            object.__setattr__(
+                self, "model", dataclasses.replace(self.model, dataflow_families=True)
+            )
 
     @property
     def input_dim(self) -> int:

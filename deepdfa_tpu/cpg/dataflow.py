@@ -13,24 +13,28 @@ Semantics match the reference's executable spec
 - a definition of ``v`` **kills** every other definition of ``v``;
 - MOP fixpoint over the CFG via a chaotic-iteration worklist.
 
-Three solvers, one semantics (cross-checked in tests):
-
-1. :meth:`ReachingDefinitions.solve` — reference-shaped Python sets worklist.
-2. :func:`solve_bitvec` — NumPy bit-matrix worklist (defs as bit positions).
-3. :func:`solve_native` — C++ worklist over CSR arrays
-   (``native/dfa_solver.cpp``) via ctypes; the throughput path for corpus
-   preprocessing, where the reference leaned on the JVM.
+Reaching definitions is now the first *client* of the generic monotone
+framework in :mod:`deepdfa_tpu.cpg.analyses` rather than the owner of the
+solver machinery: the operator model and the three backends (Python sets /
+NumPy bit-matrix / C++ CSR worklist) live there, and this module keeps the
+historical API on top — :meth:`ReachingDefinitions.solve`,
+:func:`solve_bitvec`, :func:`solve_native` — with unchanged return contracts
+(cross-checked in tests). The static gen/kill formulation is equivalent to
+the reference's dynamic ``kill(n, in_n)``: removing only the *reaching* other
+defs of ``v`` from ``in_n`` equals removing all of them.
 """
 
 from __future__ import annotations
 
-import ctypes
-import dataclasses
-import subprocess
-from pathlib import Path
-
-import numpy as np
-
+from deepdfa_tpu.cpg import analyses
+from deepdfa_tpu.cpg.analyses import (
+    ASSIGNMENT_OPS,
+    INC_DEC_OPS,
+    MOD_OPS,
+    Problem,
+    VariableDefinition,
+    reaching_definitions,
+)
 from deepdfa_tpu.cpg.schema import CPG
 
 __all__ = [
@@ -43,51 +47,9 @@ __all__ = [
     "solve_native",
 ]
 
-ASSIGNMENT_OPS = tuple(
-    "<operator>." + n
-    for n in (
-        "assignment",
-        "assignmentAnd",
-        "assignmentArithmeticShiftRight",
-        "assignmentDivision",
-        "assignmentExponentiation",
-        "assignmentLogicalShiftRight",
-        "assignmentMinus",
-        "assignmentModulo",
-        "assignmentMultiplication",
-        "assignmentOr",
-        "assignmentPlus",
-        "assignmentShiftLeft",
-        "assignmentXor",
-    )
-)
-INC_DEC_OPS = tuple(
-    "<operator>." + n
-    for n in ("incBy", "postDecrement", "postIncrement", "preDecrement", "preIncrement")
-)
-# Joern emits "<operators>" for some programs; accept both spellings.
-MOD_OPS = frozenset(
-    ASSIGNMENT_OPS
-    + INC_DEC_OPS
-    + tuple(op.replace("<operator>", "<operators>") for op in ASSIGNMENT_OPS + INC_DEC_OPS)
-)
-
-
-@dataclasses.dataclass(frozen=True)
-class VariableDefinition:
-    var: str
-    node: int
-    code: str = ""
-
-    def __hash__(self):
-        return self.node
-
-    def __eq__(self, other):
-        return self.node == other.node
-
 
 class ReachingDefinitions:
-    """Gen/kill construction + Python worklist solver over a CPG's CFG."""
+    """Gen/kill construction + solver entry points over a CPG's CFG."""
 
     def __init__(self, cpg: CPG):
         self.cpg = cpg
@@ -107,20 +69,9 @@ class ReachingDefinitions:
         return set().union(*self.gen.values()) if self.gen else set()
 
     def assigned_variable(self, nid: int) -> str | None:
-        """The defined variable's source text, or None.
-
-        First ARGUMENT child by ``order`` of a mod-op call; the child's
-        ``code`` is the variable expression (handles ``*p``, ``a[i]`` the way
-        the reference does — textually).
-        """
-        node = self.cpg.nodes.get(nid)
-        if node is None or node.name not in MOD_OPS:
-            return None
-        args = self.cpg.arguments(nid)
-        if not args:
-            return None
-        first = args[min(args)]
-        return self.cpg.nodes[first].code if first in self.cpg.nodes else None
+        """The defined variable's source text, or None (first ARGUMENT child
+        by ``order`` of a mod-op call; textual, handles ``*p``, ``a[i]``)."""
+        return analyses.assigned_variable(self.cpg, nid)
 
     def kill(self, nid: int, defs: set[VariableDefinition]) -> set[VariableDefinition]:
         var = self.assigned_variable(nid)
@@ -128,179 +79,37 @@ class ReachingDefinitions:
             return set()
         return {d for d in defs if d.var == var and d.node != nid}
 
+    def to_problem(self) -> Problem:
+        """The framework formulation of this instance (forward-may)."""
+        return reaching_definitions(self.cpg)
+
     def solve(self) -> tuple[dict[int, set], dict[int, set]]:
-        """Worklist MOP fixpoint; returns (in_sets, out_sets) keyed by CFG node."""
-        out_sets: dict[int, set] = {n: set() for n in self.cfg_nodes}
-        in_sets: dict[int, set] = {n: set() for n in self.cfg_nodes}
-        work = list(self.cfg_nodes)
-        while work:
-            n = work.pop()
-            in_n: set = set()
-            for p in self.cpg.predecessors(n, "CFG"):
-                in_n |= out_sets.get(p, set())
-            in_sets[n] = in_n
-            new_out = self.gen.get(n, set()) | (in_n - self.kill(n, in_n))
-            if new_out != out_sets[n]:
-                work.extend(self.cpg.successors(n, "CFG"))
-            out_sets[n] = new_out
-        return in_sets, out_sets
+        """Worklist MOP fixpoint; returns (in_sets, out_sets) of
+        :class:`VariableDefinition` keyed by CFG node."""
+        sol = analyses.solve_sets(self.to_problem())
+        return sol.in_facts, sol.out_facts
 
     def __str__(self):
         dom = self.domain
         return f"{len(dom)} defs: {sorted(d.code for d in dom)}"
 
 
-def _encode_problem(rd: ReachingDefinitions):
-    """Index CFG nodes and definitions; build CSR predecessors and gen/kill
-    bit masks shared by the vectorised and native solvers."""
-    nodes = rd.cfg_nodes
-    idx = {n: i for i, n in enumerate(nodes)}
-    defs = sorted(rd.domain, key=lambda d: d.node)
-    didx = {d.node: j for j, d in enumerate(defs)}
-    n, m = len(nodes), len(defs)
-
-    gen = np.zeros((n, m), dtype=bool)
-    kill = np.zeros((n, m), dtype=bool)
-    by_var: dict[str, list[int]] = {}
-    for j, d in enumerate(defs):
-        by_var.setdefault(d.var, []).append(j)
-    for nid in nodes:
-        i = idx[nid]
-        for d in rd.gen.get(nid, ()):
-            gen[i, didx[d.node]] = True
-        var = rd.assigned_variable(nid)
-        if var is not None:
-            for j in by_var.get(var, ()):
-                if defs[j].node != nid:
-                    kill[i, j] = True
-
-    preds_list = [[idx[p] for p in rd.cpg.predecessors(nid, "CFG") if p in idx] for nid in nodes]
-    succs_list = [[idx[s] for s in rd.cpg.successors(nid, "CFG") if s in idx] for nid in nodes]
-    return nodes, defs, gen, kill, preds_list, succs_list
+def _as_ids(sets: dict[int, set]) -> dict[int, set[int]]:
+    return {nid: {d.node for d in s} for nid, s in sets.items()}
 
 
 def solve_bitvec(rd: ReachingDefinitions):
     """NumPy bit-matrix worklist; returns (in_sets, out_sets) as
     {node_id: set[def_node_id]}."""
-    nodes, defs, gen, kill, preds, succs = _encode_problem(rd)
-    n, m = gen.shape
-    out = np.zeros((n, m), dtype=bool)
-    inn = np.zeros((n, m), dtype=bool)
-    work = list(range(n))
-    in_work = [True] * n
-    while work:
-        i = work.pop()
-        in_work[i] = False
-        if preds[i]:
-            x = np.logical_or.reduce(out[preds[i]], axis=0)
-        else:
-            x = np.zeros(m, dtype=bool)
-        inn[i] = x
-        new_out = gen[i] | (x & ~kill[i])
-        if not np.array_equal(new_out, out[i]):
-            out[i] = new_out
-            for s in succs[i]:
-                if not in_work[s]:
-                    work.append(s)
-                    in_work[s] = True
-    def_ids = np.array([d.node for d in defs], dtype=np.int64)
-    to_sets = lambda mat: {
-        nid: set(def_ids[mat[i]].tolist()) for i, nid in enumerate(nodes)
-    }
-    return to_sets(inn), to_sets(out)
-
-
-# ---------------------------------------------------------------- native --
-
-_LIB: ctypes.CDLL | None = None
-
-
-def _native_lib() -> ctypes.CDLL:
-    global _LIB
-    if _LIB is not None:
-        return _LIB
-    root = Path(__file__).resolve().parent.parent.parent / "native"
-    so = root / "libdfa_solver.so"
-    if not (root / "dfa_solver.cpp").exists():
-        raise RuntimeError(
-            "the C++ reaching-definitions solver needs a source checkout "
-            f"(native/dfa_solver.cpp not found under {root}); installed-"
-            "package users: call rd.solve() (Python sets) or solve_bitvec "
-            "instead — identical fixpoints, cross-checked by the test suite"
-        )
-    # Always invoke make: it is a no-op when up to date and rebuilds after
-    # source edits (a stale .so would otherwise be loaded silently).
-    subprocess.run(["make", "-C", str(root), "-s"], check=True)
-    lib = ctypes.CDLL(str(so))
-    lib.solve_reaching_defs.restype = ctypes.c_int
-    lib.solve_reaching_defs.argtypes = [
-        ctypes.c_int32,  # n_nodes
-        ctypes.c_int32,  # n_defs
-        ctypes.POINTER(ctypes.c_int32),  # pred_indptr [n+1]
-        ctypes.POINTER(ctypes.c_int32),  # pred_indices
-        ctypes.POINTER(ctypes.c_int32),  # succ_indptr [n+1]
-        ctypes.POINTER(ctypes.c_int32),  # succ_indices
-        ctypes.POINTER(ctypes.c_uint64),  # gen  [n * words]
-        ctypes.POINTER(ctypes.c_uint64),  # kill [n * words]
-        ctypes.POINTER(ctypes.c_uint64),  # out: in  [n * words]
-        ctypes.POINTER(ctypes.c_uint64),  # out: out [n * words]
-    ]
-    _LIB = lib
-    return lib
-
-
-def _pack_bits(mat: np.ndarray) -> np.ndarray:
-    """bool [n, m] → uint64 [n, ceil(m/64)] little-endian bit packing."""
-    n, m = mat.shape
-    words = max((m + 63) // 64, 1)
-    padded = np.zeros((n, words * 64), dtype=bool)
-    padded[:, :m] = mat
-    b = np.packbits(padded, axis=1, bitorder="little")
-    return b.reshape(n, words, 8).view(np.uint64).reshape(n, words)
-
-
-def _unpack_bits(packed: np.ndarray, m: int) -> np.ndarray:
-    n, words = packed.shape
-    bytes_ = packed.reshape(n, words, 1).view(np.uint8).reshape(n, words * 8)
-    bits = np.unpackbits(bytes_, axis=1, bitorder="little")
-    return bits[:, :m].astype(bool)
-
-
-def _csr(lists: list[list[int]]) -> tuple[np.ndarray, np.ndarray]:
-    indptr = np.zeros(len(lists) + 1, dtype=np.int32)
-    for i, l in enumerate(lists):
-        indptr[i + 1] = indptr[i] + len(l)
-    indices = np.concatenate([np.array(l, dtype=np.int32) for l in lists]) if any(lists) else np.zeros(0, np.int32)
-    return indptr, indices
+    sol = analyses.solve_bitvec(rd.to_problem())
+    return _as_ids(sol.in_facts), _as_ids(sol.out_facts)
 
 
 def solve_native(rd: ReachingDefinitions):
-    """C++ worklist solver; identical output contract to :func:`solve_bitvec`."""
-    nodes, defs, gen, kill, preds, succs = _encode_problem(rd)
-    n, m = gen.shape
-    if n == 0:
+    """C++ worklist solver; identical output contract to :func:`solve_bitvec`.
+    Falls back to the bit-vector solver (one warning) on toolchain-less
+    machines — see :func:`deepdfa_tpu.cpg.analyses.solve_native`."""
+    sol = analyses.solve_native(rd.to_problem())
+    if not sol.in_facts and not sol.out_facts:
         return {}, {}
-    words = max((m + 63) // 64, 1)
-    gen_p = np.ascontiguousarray(_pack_bits(gen))
-    kill_p = np.ascontiguousarray(_pack_bits(kill))
-    in_p = np.zeros((n, words), dtype=np.uint64)
-    out_p = np.zeros((n, words), dtype=np.uint64)
-    pp, pi = _csr(preds)
-    sp, si = _csr(succs)
-
-    lib = _native_lib()
-    u64p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
-    i32p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
-    rc = lib.solve_reaching_defs(
-        n, m, i32p(pp), i32p(pi), i32p(sp), i32p(si),
-        u64p(gen_p), u64p(kill_p), u64p(in_p), u64p(out_p),
-    )
-    if rc != 0:
-        raise RuntimeError(f"native solver failed with rc={rc}")
-    def_ids = np.array([d.node for d in defs], dtype=np.int64)
-    inn = _unpack_bits(in_p, m)
-    out = _unpack_bits(out_p, m)
-    to_sets = lambda mat: {
-        nid: set(def_ids[mat[i]].tolist()) for i, nid in enumerate(nodes)
-    }
-    return to_sets(inn), to_sets(out)
+    return _as_ids(sol.in_facts), _as_ids(sol.out_facts)

@@ -48,6 +48,7 @@ __all__ = [
     "line_dependencies",
     "dep_add_lines",
     "add_dependence_edges",
+    "dataflow_node_features",
 ]
 
 # Definition detection for *feature extraction*: the reference's
@@ -207,6 +208,30 @@ def features_to_hashes(feature_df: pd.DataFrame, subkeys: Iterable[str]) -> pd.D
         .reset_index()
     )
     return out.sort_values(["graph_id", "node_id"]).reset_index(drop=True)
+
+
+def dataflow_node_features(cpg: CPG) -> dict[str, dict[int, int]]:
+    """Per-CFG-node raw values for the static-analysis feature families
+    (``config.DFA_FAMILIES``), solved with the native backend (which falls
+    back to the bit-vector solver on toolchain-less hosts):
+
+    - ``live_out`` — |live_out(n)| clipped to ``DFA_LIVE_OUT_CLIP``;
+    - ``uninit`` — 1 iff ``n`` reads a possibly-uninitialized local;
+    - ``taint`` — 0 untouched / 1 uses a tainted variable / 2 introduces
+      taint (source call, tainted assignment, parameter entry).
+
+    Nodes outside the CFG are absent; carriers default them to 0.
+    """
+    from deepdfa_tpu.config import DFA_LIVE_OUT_CLIP
+    from deepdfa_tpu.cpg import analyses
+
+    live = analyses.solve_native(analyses.liveness(cpg))
+    live_out = {n: min(len(s), DFA_LIVE_OUT_CLIP) for n, s in live.out_facts.items()}
+    uninit_sol = analyses.solve_native(analyses.uninitialized(cpg))
+    flagged = analyses.uninitialized_uses(cpg, uninit_sol)
+    uninit = {n: int(n in flagged) for n in uninit_sol.in_facts}
+    taint = analyses.taint_node_codes(cpg, solver=analyses.solve_native)
+    return {"live_out": live_out, "uninit": uninit, "taint": taint}
 
 
 # ---------------------------------------------------------------------------

@@ -38,7 +38,9 @@ __all__ = ["DenseBatch", "batch_dense", "DenseBatcher", "derive_dense_size",
 class DenseBatch(NamedTuple):
     """Device-ready dense batch. All shapes static.
 
-    node_feats: dict of ``[max_graphs, nodes_per_graph, ...]`` arrays.
+    node_feats: dict of ``[max_graphs, nodes_per_graph, ...]`` arrays,
+    carried generically (any key present on the input graphs — including the
+    ``_DFA_*`` static-analysis families — is padded and batched unchanged).
     adj: ``[max_graphs, n, n]`` — ``adj[g, j, i]`` = #edges j→i (compute
     dtype is chosen by the model; stored f32 to keep counts exact).
     node_mask: ``[max_graphs, n]`` bool. graph_mask: ``[max_graphs]`` bool.

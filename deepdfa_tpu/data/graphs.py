@@ -47,7 +47,10 @@ class Graph:
     """A single (host-side, numpy) graph.
 
     ``node_feats`` values are ``[n_nodes, ...]`` arrays; integer feature ids,
-    labels (``_VULN``), dataflow bit-vectors etc. all live here.
+    labels (``_VULN``), dataflow bit-vectors etc. all live here. The dict is
+    carried generically through batching/sharding — new feature families
+    (e.g. the ``_DFA_{live_out,uninit,taint}`` static-analysis ids emitted
+    when ``FeatureConfig.dataflow_families`` is on) need no carrier changes.
     """
 
     senders: np.ndarray  # [n_edges] int32, source node index

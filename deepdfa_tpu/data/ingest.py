@@ -49,6 +49,7 @@ __all__ = [
     "named_splits",
     "splits_map",
     "partition",
+    "validate_cpgs",
     "VulnDataset",
 ]
 
@@ -562,6 +563,31 @@ def partition(
     if part != "all":
         df = df[df.label == part]
     return df
+
+
+# ---------------------------------------------------------------------------
+# structural validation at ingestion
+
+
+def validate_cpgs(cpgs: dict, drop_errors: bool = True) -> tuple[dict, dict]:
+    """Run the CPG structural validator (``cpg/validate.py``) over an
+    ingested ``{graph_id: CPG}`` corpus.
+
+    Returns ``(kept_cpgs, summary)``: graphs with error-severity diagnostics
+    are dropped from ``kept_cpgs`` when ``drop_errors`` (the ingestion
+    default — a malformed graph silently corrupts features downstream,
+    see the validator's module docstring); the summary is
+    ``validate_corpus``'s per-check aggregate, suitable for the per-dataset
+    report ``scripts/preprocess.py`` prints.
+    """
+    from deepdfa_tpu.cpg.validate import validate_corpus
+
+    summary = dict(validate_corpus(cpgs.items()))
+    if not drop_errors:
+        return cpgs, summary
+    bad = set(summary["error_graph_ids"])
+    kept = {gid: cpg for gid, cpg in cpgs.items() if gid not in bad}
+    return kept, summary
 
 
 # ---------------------------------------------------------------------------

@@ -28,8 +28,12 @@ from typing import Iterable, Mapping
 import numpy as np
 import pandas as pd
 
-from deepdfa_tpu.config import ALL_SUBKEYS, FeatureConfig
-from deepdfa_tpu.cpg.features import extract_features, features_to_hashes
+from deepdfa_tpu.config import ALL_SUBKEYS, DFA_FEATURE_DIMS, FeatureConfig
+from deepdfa_tpu.cpg.features import (
+    dataflow_node_features,
+    extract_features,
+    features_to_hashes,
+)
 from deepdfa_tpu.cpg.schema import CPG
 from deepdfa_tpu.data.graphs import Graph
 from deepdfa_tpu.data.vocab import Vocabulary, build_vocab
@@ -191,6 +195,14 @@ class CorpusBuilder:
                 name: {n: voc.feature_id(h) for n, h in hashes.items()}
                 for name, voc in vocabs.items()
             }
+            if self.feature.dataflow_families:
+                # static-analysis families: no vocab, raw values clipped into
+                # their fixed embedding-table range (config.DFA_FEATURE_DIMS)
+                for fam, values in dataflow_node_features(cpg).items():
+                    dim = DFA_FEATURE_DIMS[fam]
+                    feat_ids[f"_DFA_{fam}"] = {
+                        n: min(max(int(v), 0), dim - 1) for n, v in values.items()
+                    }
             g = graph_from_cpg(
                 cpg,
                 gid,

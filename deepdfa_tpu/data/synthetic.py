@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from deepdfa_tpu.config import ALL_SUBKEYS
+from deepdfa_tpu.config import ALL_SUBKEYS, DFA_FAMILIES, DFA_FEATURE_DIMS
 from deepdfa_tpu.data.graphs import Graph
 
 __all__ = ["random_graph", "random_dataset"]
@@ -25,6 +25,7 @@ def random_graph(
     mean_nodes: int = 50,
     vul: bool | None = None,
     def_rate: float = 0.35,
+    dataflow_families: bool = False,
 ) -> Graph:
     n = max(3, int(rng.lognormal(mean=np.log(mean_nodes), sigma=0.6)))
     # CFG backbone: a chain with branch/merge shortcuts, like real control flow.
@@ -44,6 +45,14 @@ def random_graph(
     # Combined-vocab id (the golden-config feature `_ABS_DATAFLOW..._all`).
     ids = rng.integers(1, input_dim, size=n, dtype=np.int32)
     feats["_ABS_DATAFLOW"] = np.where(is_def, ids, 0).astype(np.int32)
+
+    if dataflow_families:
+        # static-analysis families (config.DFA_FAMILIES): values drawn from
+        # each family's closed range, like preprocess emits them
+        for fam in DFA_FAMILIES:
+            feats[f"_DFA_{fam}"] = rng.integers(
+                0, DFA_FEATURE_DIMS[fam], size=n, dtype=np.int32
+            )
 
     if vul is None:
         vul = bool(rng.random() < 0.06)
@@ -71,12 +80,15 @@ def random_dataset(
     input_dim: int = 1002,
     mean_nodes: int = 50,
     vul_rate: float = 0.06,
+    dataflow_families: bool = False,
 ) -> list[Graph]:
     rng = np.random.default_rng(seed)
     out = []
     for i in range(n_graphs):
         g = random_graph(
-            rng, input_dim=input_dim, mean_nodes=mean_nodes, vul=bool(rng.random() < vul_rate)
+            rng, input_dim=input_dim, mean_nodes=mean_nodes,
+            vul=bool(rng.random() < vul_rate),
+            dataflow_families=dataflow_families,
         )
         g.gid = i
         out.append(g)

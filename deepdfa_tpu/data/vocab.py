@@ -31,7 +31,7 @@ import pandas as pd
 
 from deepdfa_tpu.config import ALL_SUBKEYS, SINGLE_SUBKEYS, FeatureConfig
 
-__all__ = ["Vocabulary", "build_vocab", "encode_nodes", "UNKNOWN"]
+__all__ = ["Vocabulary", "build_vocab", "encode_nodes", "encode_dfa_nodes", "UNKNOWN"]
 
 UNKNOWN = "UNKNOWN"
 
@@ -147,3 +147,18 @@ def encode_nodes(
     stage-2 hash JSON for that graph's definitions; non-definition nodes
     get 0."""
     return [vocab.feature_id(graph_hashes.get(int(n))) for n in node_ids]
+
+
+def encode_dfa_nodes(
+    node_ids: Iterable[int], family_values: Mapping[int, int], family: str
+) -> list[int]:
+    """Feature ids for one static-analysis family (``config.DFA_FAMILIES``).
+
+    These families have small closed value sets instead of learned vocabs,
+    so encoding is just clipping into the family's embedding-table range
+    (``DFA_FEATURE_DIMS``); nodes the analysis didn't touch get 0.
+    """
+    from deepdfa_tpu.config import DFA_FEATURE_DIMS
+
+    dim = DFA_FEATURE_DIMS[family]
+    return [min(max(int(family_values.get(int(n), 0)), 0), dim - 1) for n in node_ids]

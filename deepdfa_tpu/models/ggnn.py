@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepdfa_tpu.config import ALL_SUBKEYS, GGNNConfig
+from deepdfa_tpu.config import ALL_SUBKEYS, DFA_FAMILIES, DFA_FEATURE_DIMS, GGNNConfig
 from deepdfa_tpu.data.graphs import BatchedGraphs
 from deepdfa_tpu.ops.segment import gather, segment_softmax, segment_sum
 
@@ -213,6 +213,21 @@ class GGNN(nn.Module):
                 self.input_dim, embed_dim, dtype=self.compute_dtype, name="embed"
             )
             hidden_dim = cfg.hidden_dim
+        if cfg.dataflow_families:
+            # static-analysis families (liveness/uninit/taint): small closed
+            # value sets, one hidden_dim-wide table each, concatenated after
+            # the subkey embeddings (widths from config.DFA_FEATURE_DIMS)
+            self.dfa_embeddings = {
+                fam: nn.Embed(
+                    DFA_FEATURE_DIMS[fam],
+                    cfg.hidden_dim,
+                    dtype=self.compute_dtype,
+                    name=f"embed_dfa_{fam}",
+                )
+                for fam in DFA_FAMILIES
+            }
+            embed_dim += cfg.hidden_dim * len(DFA_FAMILIES)
+            hidden_dim += cfg.hidden_dim * len(DFA_FAMILIES)
         self.ggnn = GatedGraphConv(
             out_feats=hidden_dim,
             n_steps=cfg.n_steps,
@@ -232,6 +247,22 @@ class GGNN(nn.Module):
                 for i in range(cfg.num_output_layers)
             ]
 
+    def _embed_dfa(self, batch: BatchedGraphs) -> jnp.ndarray:
+        # same fused-gather trick as the subkey tables: the family tables
+        # differ in row count but share the hidden width, so they stack along
+        # axis 0 with cumulative row offsets into the ids.
+        table = jnp.concatenate(
+            [self.dfa_embeddings[fam].embedding for fam in DFA_FAMILIES], axis=0
+        ).astype(self.compute_dtype)
+        ids_cols = []
+        offset = 0
+        for fam in DFA_FAMILIES:
+            ids_cols.append(batch.node_feats[f"_DFA_{fam}"] + offset)
+            offset += DFA_FEATURE_DIMS[fam]
+        ids = jnp.stack(ids_cols, axis=-1)
+        out = jnp.take(table, ids, axis=0)
+        return out.reshape(*ids.shape[:-1], -1)
+
     def embed_nodes(self, batch: BatchedGraphs) -> jnp.ndarray:
         if self.cfg.concat_all_absdf:
             # One fused gather instead of 4: stack the per-subkey tables into
@@ -250,8 +281,12 @@ class GGNN(nn.Module):
                 axis=-1,
             )
             out = jnp.take(table, ids, axis=0)
-            return out.reshape(*ids.shape[:-1], -1)
-        return self.embedding(batch.node_feats["_ABS_DATAFLOW"])
+            out = out.reshape(*ids.shape[:-1], -1)
+        else:
+            out = self.embedding(batch.node_feats["_ABS_DATAFLOW"])
+        if self.cfg.dataflow_families:
+            out = jnp.concatenate([out, self._embed_dfa(batch)], axis=-1)
+        return out
 
     def __call__(self, batch: BatchedGraphs, taps: tuple | None = None) -> jnp.ndarray:
         cfg = self.cfg

@@ -35,7 +35,7 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from deepdfa_tpu.config import ALL_SUBKEYS, GGNNConfig
+from deepdfa_tpu.config import ALL_SUBKEYS, DFA_FAMILIES, DFA_FEATURE_DIMS, GGNNConfig
 from deepdfa_tpu.data.dense import DenseBatch
 from deepdfa_tpu.models.ggnn import GRUCell
 
@@ -134,6 +134,20 @@ class GGNNDense(nn.Module):
                 self.input_dim, embed_dim, dtype=self.compute_dtype, name="embed"
             )
             hidden_dim = cfg.hidden_dim
+        if cfg.dataflow_families:
+            # lockstep with GGNN.setup — same table names/shapes so the
+            # parameter trees stay checkpoint-interchangeable
+            self.dfa_embeddings = {
+                fam: nn.Embed(
+                    DFA_FEATURE_DIMS[fam],
+                    cfg.hidden_dim,
+                    dtype=self.compute_dtype,
+                    name=f"embed_dfa_{fam}",
+                )
+                for fam in DFA_FAMILIES
+            }
+            embed_dim += cfg.hidden_dim * len(DFA_FAMILIES)
+            hidden_dim += cfg.hidden_dim * len(DFA_FAMILIES)
         self.ggnn = GatedGraphConvDense(
             out_feats=hidden_dim,
             n_steps=cfg.n_steps,
@@ -153,6 +167,20 @@ class GGNNDense(nn.Module):
                 for i in range(cfg.num_output_layers)
             ]
 
+    def _embed_dfa(self, batch: DenseBatch) -> jnp.ndarray:
+        # lockstep with GGNN._embed_dfa, shapes [G, n] instead of [N]
+        table = jnp.concatenate(
+            [self.dfa_embeddings[fam].embedding for fam in DFA_FAMILIES], axis=0
+        ).astype(self.compute_dtype)
+        ids_cols = []
+        offset = 0
+        for fam in DFA_FAMILIES:
+            ids_cols.append(batch.node_feats[f"_DFA_{fam}"] + offset)
+            offset += DFA_FEATURE_DIMS[fam]
+        ids = jnp.stack(ids_cols, axis=-1)
+        out = jnp.take(table, ids, axis=0)
+        return out.reshape(*ids.shape[:-1], -1)
+
     def embed_nodes(self, batch: DenseBatch) -> jnp.ndarray:
         if self.cfg.concat_all_absdf:
             # fused single gather across the 4 stacked subkey tables (same
@@ -168,8 +196,12 @@ class GGNNDense(nn.Module):
                 axis=-1,
             )
             out = jnp.take(table, ids, axis=0)
-            return out.reshape(*ids.shape[:-1], -1)
-        return self.embedding(batch.node_feats["_ABS_DATAFLOW"])
+            out = out.reshape(*ids.shape[:-1], -1)
+        else:
+            out = self.embedding(batch.node_feats["_ABS_DATAFLOW"])
+        if self.cfg.dataflow_families:
+            out = jnp.concatenate([out, self._embed_dfa(batch)], axis=-1)
+        return out
 
     def __call__(self, batch: DenseBatch) -> jnp.ndarray:
         cfg = self.cfg

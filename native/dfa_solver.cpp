@@ -1,12 +1,20 @@
-// Reaching-definitions worklist solver over a CSR-encoded CFG.
+// Generic monotone gen/kill dataflow worklist solver over a CSR-encoded CFG.
 //
-// Native throughput path for corpus preprocessing: the reference ran this
-// fixpoint inside Joern's JVM (DataFlowSolver / ReachingDefProblem, invoked
-// from DDFA/storage/external/get_func_graph.sc) and kept a Python reference
-// implementation (DDFA/code_gnn/analysis/dataflow.py:155-177). Same MOP
-// semantics here: in[n] = U out[p], out[n] = gen[n] | (in[n] & ~kill[n]),
-// chaotic iteration until fixpoint. Definitions are bit positions in
-// 64-bit word vectors; callers pack/unpack (see cpg/dataflow.py).
+// Native throughput path for corpus preprocessing: the reference ran its
+// reaching-defs fixpoint inside Joern's JVM (DataFlowSolver /
+// ReachingDefProblem, invoked from DDFA/storage/external/get_func_graph.sc)
+// and kept a Python reference implementation
+// (DDFA/code_gnn/analysis/dataflow.py:155-177). This solver generalises the
+// same MOP semantics to any gen/kill instance:
+//
+//   in[n]  = MEET over preds p of out[p]   (may: OR, must: AND)
+//   out[n] = gen[n] | (in[n] & ~kill[n])
+//
+// chaotic iteration until fixpoint. Direction is the caller's concern: a
+// backward analysis passes the reversed CFG (pred/succ swapped) and re-labels
+// the outputs (see cpg/analyses.py). For must-meet the caller initialises
+// out_out to all-ones (TOP); boundary nodes (no preds) always get in = 0.
+// Facts are bit positions in 64-bit word vectors; callers pack/unpack.
 //
 // Exposed via ctypes; no Python.h dependency.
 
@@ -14,15 +22,15 @@
 #include <cstring>
 #include <vector>
 
-extern "C" int solve_reaching_defs(
-    int32_t n_nodes, int32_t n_defs,
+extern "C" int solve_dataflow(
+    int32_t n_nodes, int32_t n_facts, int32_t meet_is_must,
     const int32_t* pred_indptr, const int32_t* pred_indices,
     const int32_t* succ_indptr, const int32_t* succ_indices,
     const uint64_t* gen, const uint64_t* kill,
     uint64_t* in_out, uint64_t* out_out) {
-  if (n_nodes < 0 || n_defs < 0) return 1;
+  if (n_nodes < 0 || n_facts < 0) return 1;
   if (n_nodes == 0) return 0;
-  const int32_t words = n_defs > 0 ? (n_defs + 63) / 64 : 1;
+  const int32_t words = n_facts > 0 ? (n_facts + 63) / 64 : 1;
 
   std::vector<uint64_t> scratch(words);
   std::vector<int32_t> work;
@@ -36,10 +44,20 @@ extern "C" int solve_reaching_defs(
     in_work[n] = 0;
 
     uint64_t* in_n = in_out + static_cast<size_t>(n) * words;
-    std::memset(in_n, 0, sizeof(uint64_t) * words);
-    for (int32_t e = pred_indptr[n]; e < pred_indptr[n + 1]; ++e) {
-      const uint64_t* out_p = out_out + static_cast<size_t>(pred_indices[e]) * words;
-      for (int32_t w = 0; w < words; ++w) in_n[w] |= out_p[w];
+    const int32_t p_begin = pred_indptr[n], p_end = pred_indptr[n + 1];
+    if (meet_is_must && p_begin != p_end) {
+      std::memset(in_n, 0xFF, sizeof(uint64_t) * words);
+      for (int32_t e = p_begin; e < p_end; ++e) {
+        const uint64_t* out_p = out_out + static_cast<size_t>(pred_indices[e]) * words;
+        for (int32_t w = 0; w < words; ++w) in_n[w] &= out_p[w];
+      }
+    } else {
+      // may-meet union; must-meet boundary (no preds) is pinned to 0
+      std::memset(in_n, 0, sizeof(uint64_t) * words);
+      for (int32_t e = p_begin; e < p_end; ++e) {
+        const uint64_t* out_p = out_out + static_cast<size_t>(pred_indices[e]) * words;
+        for (int32_t w = 0; w < words; ++w) in_n[w] |= out_p[w];
+      }
     }
 
     const uint64_t* gen_n = gen + static_cast<size_t>(n) * words;
@@ -63,4 +81,16 @@ extern "C" int solve_reaching_defs(
     }
   }
   return 0;
+}
+
+// Historical entry point: reaching definitions is forward-may with in/out
+// buffers zero-initialised by the caller.
+extern "C" int solve_reaching_defs(
+    int32_t n_nodes, int32_t n_defs,
+    const int32_t* pred_indptr, const int32_t* pred_indices,
+    const int32_t* succ_indptr, const int32_t* succ_indices,
+    const uint64_t* gen, const uint64_t* kill,
+    uint64_t* in_out, uint64_t* out_out) {
+  return solve_dataflow(n_nodes, n_defs, 0, pred_indptr, pred_indices,
+                        succ_indptr, succ_indices, gen, kill, in_out, out_out);
 }

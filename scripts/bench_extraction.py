@@ -127,6 +127,21 @@ def main(argv=None) -> dict:
     big_rd = ReachingDefinitions(parse_function(big_src))
     big = _time_solvers([big_rd], reps=5)
 
+    # per-analysis solver throughput over the generic framework
+    # (cpg/analyses.py): RD vs. liveness vs. uninit vs. taint, bitvec vs.
+    # native, on the same corpus — functions/sec per (analysis, backend)
+    from deepdfa_tpu.cpg import analyses
+
+    per_analysis: dict[str, dict[str, float]] = {}
+    for name in analyses.ANALYSES:
+        per_analysis[name] = {}
+        for backend in ("bitvec", "native"):
+            t0 = time.perf_counter()
+            for c in cpgs:
+                analyses.solve_analysis(name, c, backend=backend)
+            dt = time.perf_counter() - t0
+            per_analysis[name][backend] = round(len(cpgs) / dt, 1) if dt else None
+
     import os
 
     n = len(sources)
@@ -156,6 +171,7 @@ def main(argv=None) -> dict:
                 big["rd_python"] / big["rd_native_cpp"], 1
             ) if big["rd_native_cpp"] else None,
         },
+        "per_analysis_functions_per_sec": per_analysis,
         "parallel": {
             "workers": args.workers,
             "host_cpus": os.cpu_count(),

@@ -155,6 +155,16 @@ def main(argv=None) -> dict:
                         "needs it at preprocess time, not just at fit.")
     parser.add_argument("--dataflow-labels", action="store_true",
                         help="attach _DF_IN/_DF_OUT solver-solution node labels")
+    parser.add_argument("--dataflow-families", action="store_true",
+                        help="emit the static-analysis feature families "
+                             "(_DFA_live_out/_DFA_uninit/_DFA_taint, "
+                             "cpg/analyses.py) alongside the vocab subkeys; "
+                             "train with FeatureConfig.dataflow_families=true")
+    parser.add_argument("--validate", action="store_true",
+                        help="run the CPG structural validator "
+                             "(cpg/validate.py) after extraction, drop "
+                             "graphs with error diagnostics, and report "
+                             "per-check counts in the summary")
     parser.add_argument("--no-cache", action="store_true",
                         help="disable the per-function CPG extraction cache")
     args = parser.parse_args(argv)
@@ -234,6 +244,16 @@ def main(argv=None) -> dict:
             file=sys.stderr,
         )
 
+    # 2b. structural validation (per-dataset summary; errors = graphs whose
+    # invariant violations would silently corrupt features downstream)
+    validation = None
+    if args.validate:
+        from deepdfa_tpu.data.ingest import validate_cpgs
+
+        cpgs, validation = validate_cpgs(cpgs)
+        validation.pop("error_graph_ids", None)
+        print(f"[preprocess] validator: {json.dumps(validation)}", file=sys.stderr)
+
     # 3. labels: removed ∪ dep-add for line-level corpora, via the corpus-wide
     # statement-labels cache (statement_labels.pkl parity, evaluate.py:239-255)
     row_of = {r["id"]: r for r in records}
@@ -299,7 +319,8 @@ def main(argv=None) -> dict:
 
     # 5. materialize
     builder = CorpusBuilder(
-        FeatureConfig(limit_all=args.limit_all, limit_subkeys=args.limit_subkeys)
+        FeatureConfig(limit_all=args.limit_all, limit_subkeys=args.limit_subkeys,
+                      dataflow_families=args.dataflow_families)
     )
     graphs, vocabs = builder.build(
         cpgs, splits["train"], vuln_lines=vuln_lines, graph_labels=graph_labels,
@@ -331,6 +352,10 @@ def main(argv=None) -> dict:
         "shards": n_shards,
         "vul_graphs": int(sum(g.node_feats["_VULN"].max() > 0 for g in graphs)),
     }
+    if validation is not None:
+        summary["validation"] = validation
+    if args.dataflow_families:
+        summary["dataflow_families"] = True
     print(json.dumps(summary))
     return summary
 

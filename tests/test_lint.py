@@ -1,0 +1,39 @@
+"""Fast lint gate: run ruff over ``deepdfa_tpu/`` with the pyproject config.
+
+Runs only when ruff is importable/installed (it is not a hard dependency of
+this repo); otherwise the test skips so hermetic environments stay green.
+"""
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _ruff_cmd() -> list[str] | None:
+    exe = shutil.which("ruff")
+    if exe is not None:
+        return [exe]
+    try:
+        import ruff  # noqa: F401
+    except ImportError:
+        return None
+    return [sys.executable, "-m", "ruff"]
+
+
+def test_ruff_clean_on_library():
+    cmd = _ruff_cmd()
+    if cmd is None:
+        pytest.skip("ruff not installed")
+    proc = subprocess.run(
+        [*cmd, "check", "deepdfa_tpu/"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, f"ruff findings:\n{proc.stdout}\n{proc.stderr}"

@@ -1,0 +1,161 @@
+"""CPG structural validator: each corruption class yields its diagnostic,
+every frontend-produced graph (realworld fixtures and generated corpus) is
+clean, and the corpus/ingestion aggregation drops exactly the bad graphs."""
+
+from pathlib import Path
+
+import pytest
+
+from deepdfa_tpu.cpg.frontend import parse_function, parse_source
+from deepdfa_tpu.cpg.schema import CPG, Node
+from deepdfa_tpu.cpg.validate import (
+    KNOWN_OPERATOR_NAMES,
+    Diagnostic,
+    validate_cpg,
+    validate_corpus,
+)
+
+FIXTURES = sorted((Path(__file__).parent / "fixtures" / "realworld").glob("*.c"))
+
+
+def _clean_cpg():
+    return parse_function("int f(int a) { int x = a + 1; return x; }")
+
+
+def _checks(diags):
+    return {d.check for d in diags}
+
+
+def test_frontend_graph_is_clean():
+    assert validate_cpg(_clean_cpg()) == []
+
+
+@pytest.mark.parametrize("path", FIXTURES, ids=lambda p: p.stem)
+def test_realworld_fixtures_clean(path):
+    """Acceptance: zero diagnostics — not even warnings — on every
+    real-world fixture."""
+    assert validate_cpg(parse_source(path.read_text())) == []
+
+
+def test_dangling_cfg_edge():
+    cpg = _clean_cpg()
+    bad = CPG(list(cpg.nodes.values()), list(cpg.edges) + [(1, 999999, "CFG")])
+    diags = validate_cpg(bad)
+    d = next(x for x in diags if x.check == "dangling-edge")
+    assert d.severity == "error"
+    assert d.edge == (1, 999999, "CFG")
+    assert "999999" in d.message
+
+
+def test_duplicate_argument_order():
+    cpg = _clean_cpg()
+    # give an assignment call two ARGUMENT children with the same order
+    call = next(n for n in cpg.nodes.values()
+                if n.label == "CALL" and "assignment" in n.name)
+    args = cpg.arguments(call.id)
+    a, b = (args[k] for k in sorted(args)[:2])
+    nodes = [
+        Node(n.id, n.label, name=n.name, code=n.code, line=n.line,
+             order=(cpg.nodes[a].order if n.id == b else n.order))
+        for n in cpg.nodes.values()
+    ]
+    diags = validate_cpg(CPG(nodes, list(cpg.edges)))
+    d = next(x for x in diags if x.check == "argument-order-duplicate")
+    assert d.severity == "error" and d.node == call.id
+
+
+def test_unreachable_method_return():
+    cpg = _clean_cpg()
+    ret = next(n.id for n in cpg.nodes.values() if n.label == "METHOD_RETURN")
+    # sever every CFG edge INTO the METHOD_RETURN: the exit state becomes
+    # unreachable from the entry
+    edges = [(s, d, e) for s, d, e in cpg.edges if not (e == "CFG" and d == ret)]
+    diags = validate_cpg(CPG(list(cpg.nodes.values()), edges))
+    assert "unreachable-return" in _checks(diags)
+    d = next(x for x in diags if x.check == "unreachable-return")
+    assert d.severity == "error" and d.node == ret
+
+
+def test_unknown_operator():
+    cpg = _clean_cpg()
+    free = max(cpg.nodes) + 1
+    method = next(n.id for n in cpg.nodes.values() if n.label == "METHOD")
+    nodes = list(cpg.nodes.values()) + [
+        Node(free, "CALL", name="<operator>.frobnicate", code="x frob y", line=1),
+    ]
+    edges = list(cpg.edges) + [(method, free, "AST"), (method, free, "CFG")]
+    diags = validate_cpg(CPG(nodes, edges))
+    d = next(x for x in diags if x.check == "unknown-operator")
+    assert d.severity == "error" and d.node == free
+    # known spellings — either prefix — do not trip the check
+    assert "<operator>.assignment" in KNOWN_OPERATOR_NAMES
+    assert "<operators>.assignment" in KNOWN_OPERATOR_NAMES
+
+
+def test_no_method():
+    nodes = [Node(1, "BLOCK", code="b", line=1), Node(2, "BLOCK", code="c", line=2)]
+    diags = validate_cpg(CPG(nodes, [(1, 2, "CFG")]))
+    checks = _checks(diags)
+    assert "no-method" in checks
+    assert "method-root" in checks  # the component has zero METHOD roots
+
+
+def test_sparse_argument_order_is_warning_only():
+    cpg = _clean_cpg()
+    call = next(n for n in cpg.nodes.values()
+                if n.label == "CALL" and "assignment" in n.name)
+    args = cpg.arguments(call.id)
+    b = args[max(args)]
+    nodes = [
+        Node(n.id, n.label, name=n.name, code=n.code, line=n.line,
+             order=(7 if n.id == b else n.order))
+        for n in cpg.nodes.values()
+    ]
+    diags = validate_cpg(CPG(nodes, list(cpg.edges)))
+    assert [d.check for d in diags] == ["argument-order-sparse"]
+    assert diags[0].severity == "warning"
+
+
+def test_errors_sort_before_warnings():
+    cpg = _clean_cpg()
+    call = next(n for n in cpg.nodes.values()
+                if n.label == "CALL" and "assignment" in n.name)
+    args = cpg.arguments(call.id)
+    b = args[max(args)]
+    nodes = [
+        Node(n.id, n.label, name=n.name, code=n.code, line=n.line,
+             order=(7 if n.id == b else n.order))
+        for n in cpg.nodes.values()
+    ]
+    edges = list(cpg.edges) + [(1, 999999, "AST")]
+    diags = validate_cpg(CPG(nodes, edges))
+    assert [d.severity for d in diags] == ["error", "warning"]
+    assert "[error] dangling-edge:" in str(diags[0])
+
+
+def test_validate_corpus_aggregates_and_flags():
+    good = _clean_cpg()
+    bad = CPG(list(good.nodes.values()), list(good.edges) + [(1, 999999, "CFG")])
+    summary = validate_corpus([("g0", good), ("g1", bad), ("g2", _clean_cpg())])
+    assert summary["graphs"] == 3
+    assert summary["graphs_with_errors"] == 1
+    assert summary["error_graph_ids"] == ["g1"]
+    assert summary["by_check"].get("dangling-edge", 0) >= 1
+
+
+def test_ingest_validate_cpgs_drops_errors():
+    from deepdfa_tpu.data.ingest import validate_cpgs
+
+    good = _clean_cpg()
+    bad = CPG(list(good.nodes.values()), list(good.edges) + [(1, 999999, "CFG")])
+    kept, summary = validate_cpgs({10: good, 11: bad})
+    assert set(kept) == {10}
+    assert summary["graphs_with_errors"] == 1
+    kept_all, _ = validate_cpgs({10: good, 11: bad}, drop_errors=False)
+    assert set(kept_all) == {10, 11}
+
+
+def test_diagnostic_str_roundtrip():
+    d = Diagnostic("dangling-edge", "error", "oops", edge=(1, 2, "CFG"))
+    s = str(d)
+    assert "dangling-edge" in s and "error" in s and "(1, 2, 'CFG')" in s
