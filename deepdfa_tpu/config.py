@@ -118,8 +118,11 @@ class GGNNConfig:
     dtype: str = "float32"  # compute dtype; bfloat16 for TPU speed runs
     # graph layout: segment (flat edge lists, gather/scatter) | dense
     # (per-graph [n,n] adjacency, message passing as batched MXU matmuls —
-    # the TPU fast path; models/ggnn_dense.py). Same parameter tree either
-    # way: checkpoints interchange between layouts.
+    # the TPU fast path; models/ggnn_dense.py) | fused (segment batches fed
+    # to ONE Pallas kernel holding node states VMEM-resident across all
+    # n_steps rounds; models/ggnn_fused.py + ops/fused_ggnn.py — the
+    # scatter-bound rescue path). Same parameter tree in every layout:
+    # checkpoints interchange between them.
     layout: str = "segment"
     # widen the input with the static-analysis families (DFA_FAMILIES): one
     # hidden_dim-sized embedding table per family, concatenated after the

@@ -6,15 +6,21 @@ __all__ = ["make_model"]
 
 
 def make_model(cfg: GGNNConfig, input_dim: int):
-    """The flagship model in the configured graph layout. Both layouts share
-    one parameter tree (parity-tested), so a checkpoint trained in either
-    restores into the other."""
+    """The flagship model in the configured graph layout. All layouts share
+    one parameter tree (parity-tested), so a checkpoint trained in any
+    restores into the others."""
     if cfg.layout == "dense":
         from deepdfa_tpu.models.ggnn_dense import GGNNDense
 
         return GGNNDense(cfg=cfg, input_dim=input_dim)
+    if cfg.layout == "fused":
+        from deepdfa_tpu.models.ggnn_fused import GGNNFused
+
+        return GGNNFused(cfg=cfg, input_dim=input_dim)
     if cfg.layout != "segment":
-        raise ValueError(f"unknown layout {cfg.layout!r} (segment | dense)")
+        raise ValueError(
+            f"unknown layout {cfg.layout!r} (segment | dense | fused)"
+        )
     from deepdfa_tpu.models.ggnn import GGNN
 
     return GGNN(cfg=cfg, input_dim=input_dim)

@@ -1,0 +1,154 @@
+"""GGNN with the Pallas VMEM-resident fused message-passing conv.
+
+Same model as :class:`deepdfa_tpu.models.ggnn.GGNN` — it *is* a subclass
+consuming the same segment-layout :class:`BatchedGraphs`, with an identical
+parameter tree (the conv's param containers reproduce ``nn.Dense``'s
+``{kernel, bias}`` leaves under the same ``ggnn/edge_linear`` and
+``ggnn/gru/{x,h}_proj`` scopes, with the same initialisers, so fresh inits
+are bit-identical and checkpoints interchange across all three layouts) —
+but the unrolled conv runs as ONE Pallas kernel with node states resident
+in VMEM across all ``n_steps`` rounds (:mod:`deepdfa_tpu.ops.fused_ggnn`),
+instead of ``n_steps`` dispatches of gather + ``segment_sum`` + GRU.
+
+Embedding lookup, attention pooling, and the classifier head are inherited
+unchanged: only the scatter-bound middle is swapped. Parity with the
+segment forward is asserted by ``tests/test_fused_ggnn.py`` on shared
+parameters (forward ≤1e-5, gradients through the ``custom_vjp``).
+
+Trade-off vs the dense layout: fused keeps O(Ed) FLOPs (no n² adjacency)
+and the segment batch pipeline, but requires the per-bucket working set to
+fit VMEM — the :class:`~deepdfa_tpu.train.loop.Trainer` routes oversized
+buckets through its segment-twin fallback, exactly like the dense layout's
+overflow handling.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.models.ggnn import GGNN
+from deepdfa_tpu.ops.fused_ggnn import fused_ggnn
+
+__all__ = ["GGNNFused", "GatedGraphConvFused"]
+
+
+class _DenseParams(nn.Module):
+    """Parameter container replicating ``nn.Dense``'s param leaves (same
+    names, shapes, initialisers, f32 param dtype) without the apply logic —
+    the fused kernel consumes the raw arrays. Identical scope paths + init
+    fns make fresh inits bit-identical to the segment/dense layouts."""
+
+    in_features: int
+    features: int
+
+    def setup(self):
+        self.kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (self.in_features, self.features), jnp.float32,
+        )
+        self.bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,), jnp.float32
+        )
+
+
+class _GRUParams(nn.Module):
+    """``GRUCell``'s parameter tree (fused 3-gate x/h projections)."""
+
+    features: int
+
+    def setup(self):
+        self.x_proj = _DenseParams(self.features, 3 * self.features)
+        self.h_proj = _DenseParams(self.features, 3 * self.features)
+
+
+class GatedGraphConvFused(nn.Module):
+    """Drop-in for :class:`GatedGraphConv` (sum aggregation) backed by the
+    single-kernel VMEM-resident forward.
+
+    ``interpret``: None (default) auto-selects — Pallas interpreter on
+    non-TPU backends so the CPU suite exercises the real kernel; compiled
+    Mosaic on TPU. The union-lattice aggregators and per-step ``taps``
+    diagnostics are segment/dense-layout features; requesting them here
+    raises rather than silently diverging.
+    """
+
+    out_feats: int
+    n_steps: int
+    aggregation: str = "sum"
+    edges_sorted: bool = True
+    dtype: Any = jnp.float32
+    interpret: bool | None = None
+
+    def setup(self):
+        if self.aggregation != "sum":
+            raise ValueError(
+                f"layout=fused supports aggregation='sum' only (DGL parity "
+                f"path); got {self.aggregation!r} — use layout=segment for "
+                f"the union-lattice aggregators"
+            )
+        self.edge_linear = _DenseParams(self.out_feats, self.out_feats)
+        self.gru = _GRUParams(self.out_feats)
+
+    def __call__(
+        self, h: jnp.ndarray, senders: jnp.ndarray, receivers: jnp.ndarray,
+        taps: tuple | None = None,
+    ) -> jnp.ndarray:
+        if taps is not None:
+            raise ValueError(
+                "per-step taps are a segment-layout diagnostic — the fused "
+                "kernel does not materialise per-round states (use "
+                "layout=segment for tap-based gradient probes)"
+            )
+        # same eager receiver-sort validation as GatedGraphConv: a false
+        # edges_sorted promise makes the backward's sorted segment sum
+        # silently wrong
+        if self.edges_sorted and not isinstance(receivers, jax.core.Tracer):
+            r = np.asarray(receivers)
+            if r.size and np.any(np.diff(r) < 0):
+                raise ValueError(
+                    "edges_sorted=True but receivers are not sorted by "
+                    "receiver — pass edges_sorted=False for hand-built edge "
+                    "lists, or sort them (batch_np does this on the host)"
+                )
+        if h.shape[-1] > self.out_feats:
+            raise ValueError("in_feats must be <= out_feats (DGL contract)")
+        if h.shape[-1] < self.out_feats:
+            pad = jnp.zeros((h.shape[0], self.out_feats - h.shape[-1]), h.dtype)
+            h = jnp.concatenate([h, pad], axis=-1)
+        interpret = self.interpret
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        out = fused_ggnn(
+            h,
+            senders,
+            receivers,
+            self.edge_linear.kernel,
+            self.edge_linear.bias,
+            self.gru.x_proj.kernel,
+            self.gru.x_proj.bias,
+            self.gru.h_proj.kernel,
+            self.gru.h_proj.bias,
+            n_steps=self.n_steps,
+            interpret=interpret,
+            edges_sorted=self.edges_sorted,
+        )
+        return out.astype(self.dtype)
+
+
+class GGNNFused(GGNN):
+    """:class:`GGNN` with the conv swapped for the fused Pallas kernel
+    (``model.layout=fused``). Everything else — embeddings, pooling, head,
+    the ``BatchedGraphs`` input contract — is inherited."""
+
+    def _conv(self, hidden_dim: int) -> nn.Module:
+        return GatedGraphConvFused(
+            out_feats=hidden_dim,
+            n_steps=self.cfg.n_steps,
+            aggregation=self.cfg.aggregation,
+            dtype=self.compute_dtype,
+        )

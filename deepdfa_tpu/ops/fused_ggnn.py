@@ -1,0 +1,292 @@
+"""VMEM-resident fused GatedGraphConv forward — Pallas TPU kernel.
+
+The r03/r05 traces pin the segment-layout GGNN step as **scatter-issue-
+bound** (SCALING.md "GGNN ceiling analysis"): the gather + sorted
+``segment_sum`` chain runs at ~10% of HBM bandwidth and the step sits at
+2.55% of nominal. The working set is tiny — node states ~3.6 MB, edge
+index vectors ~0.1 MB, weights ~0.23 MB vs the v5e's 128 MiB VMEM — so
+this kernel runs the ENTIRE unrolled forward (per-round edge-type linear,
+edge gather, receiver-ordered accumulation, fused GRU update) with the
+node-state matrix resident in VMEM across all ``n_steps`` rounds: one HBM
+read of the embeddings in, one HBM write of the final node states out.
+Every intermediate HBM round-trip of the per-op dispatch — and with it the
+scatter-issue bottleneck — disappears; the bound becomes VMEM gather
+latency (~20× HBM). This is the classic sparse-GNN-on-dense-hardware move
+(arXiv:1906.11786) and the whole-propagation fusion arXiv:2512.01678 shows
+dominates per-op dispatch for small-hidden GNNs.
+
+Kernel layout (the ``ops/int8_matmul.py`` pattern): grid ``(n_steps,)`` —
+on TPU the grid is executed sequentially over the last axis, so the output
+block (the node states ``h``) and the ``msg``/``agg`` scratch stay resident
+in VMEM across rounds; the wrapper is invoked once per graph *bucket*
+(each bucket shape compiles once, exactly like the segment forward's
+per-bucket jit). The matmuls (edge linear, the two fused 3-gate GRU
+projections) hit the MXU; the gather/accumulate runs as an in-VMEM edge
+loop over the receiver-sorted edge list. ``interpret=True`` (any non-TPU
+backend) runs the same kernel under the Pallas interpreter so the CPU
+suite exercises it without hardware.
+
+Differentiable via ``custom_vjp``: the backward re-runs the unrolled
+forward from the banked inputs in plain XLA ops (the working set is tiny,
+recompute is cheaper than banking five rounds of states) and reverse-
+differentiates it — gradient parity with the segment path is exact because
+the math is identical (``tests/test_fused_ggnn.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_ggnn", "working_set_bytes", "fits_vmem", "VMEM_CAP_BYTES"]
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _round_up(a: int, b: int) -> int:
+    return _cdiv(a, b) * b
+
+
+# v5e/v5p VMEM is 128 MiB per core (SCALING.md "GGNN ceiling analysis").
+# The planning cap is deliberately conservative — Mosaic needs headroom for
+# double-buffered DMA and register spills — and is enforced two ways: the
+# Trainer routes any bucket whose working set exceeds it through the
+# segment-layout fallback twin (same params), and the static guard test
+# (tests/test_fused_ggnn.py) walks every bucket shape the corpus-derived
+# bucketing can emit so a config change fails in CI rather than on-chip.
+VMEM_BYTES = 128 * 2**20
+VMEM_CAP_BYTES = 96 * 2**20
+
+
+def working_set_bytes(n_nodes: int, n_edges: int, width: int) -> int:
+    """Conservative per-bucket VMEM working set of the fused kernel.
+
+    Counts the resident f32 node-state blocks (``h`` in, ``h`` out, ``msg``
+    and ``agg`` scratch), the GRU intermediates (two 3-gate projection
+    outputs plus the r/z/n gate temps — transient, but Mosaic materialises
+    vector temporaries in VMEM), the padded weight/bias blocks, and the
+    edge index vectors (stored ``(1, E)`` so the lane axis carries E; the
+    sublane axis pads to 8). Shapes are padded exactly as the wrapper pads
+    them.
+    """
+    np_ = _round_up(max(n_nodes, 8), 8)
+    dp = _round_up(max(width, 1), 128)
+    ep = _round_up(max(n_edges, 1), 128)
+    node_blocks = 4 * np_ * dp * 4            # h_in, h_out, msg, agg
+    gru_temps = (2 * 3 * dp + 3 * dp) * np_ * 4   # xp, hp, r/z/n
+    weights = (dp * dp + 2 * dp * 3 * dp + 7 * dp) * 4  # ew, xw, hw + biases
+    edges = 2 * 8 * ep * 4                    # senders, receivers
+    return node_blocks + gru_temps + weights + edges
+
+
+def fits_vmem(n_nodes: int, n_edges: int, width: int) -> bool:
+    """Whether a bucket shape is safe for the fused kernel on-chip. Buckets
+    over the cap (e.g. the worst-case overflow rescue bucket) take the
+    segment-layout fallback — correctness is never gated on VMEM."""
+    return working_set_bytes(n_nodes, n_edges, width) <= VMEM_CAP_BYTES
+
+
+def _pack_gates(w: jnp.ndarray, d: int, dp: int) -> jnp.ndarray:
+    """Pad a ``[d, 3d]`` fused-gate weight to ``[dp, 3dp]`` per-gate: the
+    r|z|n column blocks must stay aligned to the PADDED width or the
+    kernel's split at ``dp`` boundaries would mix gates."""
+    w3 = w.reshape(d, 3, d)
+    w3 = jnp.pad(w3, ((0, dp - d), (0, 0), (0, dp - d)))
+    return w3.reshape(dp, 3 * dp)
+
+
+def _pack_gate_bias(b: jnp.ndarray, d: int, dp: int) -> jnp.ndarray:
+    b3 = jnp.pad(b.reshape(3, d), ((0, 0), (0, dp - d)))
+    return b3.reshape(1, 3 * dp)
+
+
+def _kernel(h0_ref, snd_ref, rcv_ref, ew_ref, eb_ref, xw_ref, xb_ref,
+            hw_ref, hb_ref, out_ref, msg_ref, agg_ref, *, n_edges: int,
+            width: int):
+    """One message round. Grid axis 0 is the round index: TPU executes the
+    last grid axis sequentially, so ``out_ref`` (the node states) and the
+    scratch persist in VMEM across all rounds — the whole unrolled forward
+    touches HBM exactly twice (embeddings in, final states out)."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _load():
+        out_ref[:] = h0_ref[:]
+
+    h = out_ref[:]
+    # edge-type linear on the MXU (n_etypes=1 commutes it to per-node,
+    # exactly as the segment forward does)
+    msg_ref[:] = (
+        jnp.dot(h, ew_ref[:], preferred_element_type=jnp.float32) + eb_ref[:]
+    )
+    agg_ref[:] = jnp.zeros_like(agg_ref)
+
+    # Receiver-ordered accumulation in VMEM: the edge list arrives sorted
+    # by receiver (the ``batch_np`` contract), so this loop IS the sorted-
+    # segment sum — at VMEM latency instead of the HBM scatter path.
+    def edge_body(e, carry):
+        s = snd_ref[0, e]
+        r = rcv_ref[0, e]
+        agg_ref[pl.ds(r, 1), :] += msg_ref[pl.ds(s, 1), :]
+        return carry
+
+    jax.lax.fori_loop(0, n_edges, edge_body, 0)
+
+    # fused GRU update (torch r|z|n gate layout, parity with models.GRUCell)
+    xp = jnp.dot(agg_ref[:], xw_ref[:], preferred_element_type=jnp.float32) + xb_ref[:]
+    hp = jnp.dot(h, hw_ref[:], preferred_element_type=jnp.float32) + hb_ref[:]
+    d = width
+    r = jax.nn.sigmoid(xp[:, :d] + hp[:, :d])
+    z = jax.nn.sigmoid(xp[:, d:2 * d] + hp[:, d:2 * d])
+    n = jnp.tanh(xp[:, 2 * d:] + r * hp[:, 2 * d:])
+    out_ref[:] = (1.0 - z) * n + z * h
+
+
+def _unrolled_reference(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
+                        n_steps: int, edges_sorted: bool):
+    """The same math in plain XLA ops — the recompute the backward
+    differentiates. Bitwise-equivalent reductions: both paths accumulate
+    edges in list order per receiver."""
+    n_nodes = h0.shape[0]
+    h = h0
+    for _ in range(n_steps):
+        msg = h @ ew + eb
+        agg = jax.ops.segment_sum(
+            jnp.take(msg, senders, axis=0), receivers,
+            num_segments=n_nodes, indices_are_sorted=edges_sorted,
+        )
+        xp = agg @ xw + xb
+        hp = h @ hw + hb
+        xr, xz, xn = jnp.split(xp, 3, axis=-1)
+        hr, hz, hn = jnp.split(hp, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h = (1.0 - z) * n + z * h
+    return h
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+def _fused_ggnn(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
+                n_steps: int, interpret: bool, edges_sorted: bool):
+    n, d = h0.shape
+    e = senders.shape[0]
+    if n_steps == 0:
+        return h0.astype(jnp.float32)
+    np_ = _round_up(max(n, 8), 8)
+    dp = _round_up(max(d, 1), 128)
+    ep = _round_up(max(e, 1), 128)
+
+    h0p = jnp.pad(h0.astype(jnp.float32), ((0, np_ - n), (0, dp - d)))
+    # (1, E) layout: the lane axis carries E (a padded (E, 1) column would
+    # burn 128 lanes per edge index)
+    sndp = jnp.pad(senders.astype(jnp.int32), (0, ep - e)).reshape(1, ep)
+    rcvp = jnp.pad(receivers.astype(jnp.int32), (0, ep - e)).reshape(1, ep)
+    ewp = jnp.pad(ew.astype(jnp.float32), ((0, dp - d), (0, dp - d)))
+    ebp = jnp.pad(eb.astype(jnp.float32), (0, dp - d)).reshape(1, dp)
+    xwp = _pack_gates(xw.astype(jnp.float32), d, dp)
+    xbp = _pack_gate_bias(xb.astype(jnp.float32), d, dp)
+    hwp = _pack_gates(hw.astype(jnp.float32), d, dp)
+    hbp = _pack_gate_bias(hb.astype(jnp.float32), d, dp)
+
+    full = lambda shape: pl.BlockSpec(shape, lambda s: (0, 0),
+                                      memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_kernel, n_edges=e, width=dp),
+        grid=(n_steps,),
+        in_specs=[
+            full((np_, dp)),            # h0
+            full((1, ep)),              # senders
+            full((1, ep)),              # receivers
+            full((dp, dp)),             # edge_linear kernel
+            full((1, dp)),              # edge_linear bias
+            full((dp, 3 * dp)),         # gru x_proj kernel
+            full((1, 3 * dp)),          # gru x_proj bias
+            full((dp, 3 * dp)),         # gru h_proj kernel
+            full((1, 3 * dp)),          # gru h_proj bias
+        ],
+        out_specs=full((np_, dp)),
+        out_shape=jax.ShapeDtypeStruct((np_, dp), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((np_, dp), jnp.float32),   # msg
+            pltpu.VMEM((np_, dp), jnp.float32),   # agg
+        ],
+        interpret=interpret,
+    )(h0p, sndp, rcvp, ewp, ebp, xwp, xbp, hwp, hbp)
+    return out[:n, :d]
+
+
+def _fused_ggnn_fwd(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
+                    n_steps, interpret, edges_sorted):
+    out = _fused_ggnn(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
+                      n_steps, interpret, edges_sorted)
+    # recompute-based backward: bank the (tiny) inputs, not per-round states
+    return out, (h0, senders, receivers, ew, eb, xw, xb, hw, hb)
+
+
+def _fused_ggnn_bwd(n_steps, interpret, edges_sorted, res, g):
+    h0, senders, receivers, ew, eb, xw, xb, hw, hb = res
+
+    def ref(h0_, ew_, eb_, xw_, xb_, hw_, hb_):
+        return _unrolled_reference(
+            h0_.astype(jnp.float32), senders, receivers,
+            ew_.astype(jnp.float32), eb_.astype(jnp.float32),
+            xw_.astype(jnp.float32), xb_.astype(jnp.float32),
+            hw_.astype(jnp.float32), hb_.astype(jnp.float32),
+            n_steps, edges_sorted,
+        )
+
+    _, vjp = jax.vjp(ref, h0, ew, eb, xw, xb, hw, hb)
+    dh0, dew, deb, dxw, dxb, dhw, dhb = vjp(g.astype(jnp.float32))
+    # integer primals take float0 cotangents (JAX's tangent space for ints)
+    dsnd = np.zeros(senders.shape, jax.dtypes.float0)
+    drcv = np.zeros(receivers.shape, jax.dtypes.float0)
+    return (dh0.astype(h0.dtype), dsnd, drcv, dew.astype(ew.dtype),
+            deb.astype(eb.dtype), dxw.astype(xw.dtype), dxb.astype(xb.dtype),
+            dhw.astype(hw.dtype), dhb.astype(hb.dtype))
+
+
+_fused_ggnn.defvjp(_fused_ggnn_fwd, _fused_ggnn_bwd)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_steps", "interpret", "edges_sorted"))
+def fused_ggnn(
+    h0: jnp.ndarray,
+    senders: jnp.ndarray,
+    receivers: jnp.ndarray,
+    ew: jnp.ndarray,
+    eb: jnp.ndarray,
+    xw: jnp.ndarray,
+    xb: jnp.ndarray,
+    hw: jnp.ndarray,
+    hb: jnp.ndarray,
+    *,
+    n_steps: int,
+    interpret: bool = False,
+    edges_sorted: bool = True,
+) -> jnp.ndarray:
+    """``n_steps`` rounds of (edge linear → gather(senders) →
+    receiver-ordered sum → GRU) with ``h`` VMEM-resident throughout.
+
+    ``h0``: ``[n_nodes, width]`` node embeddings (already padded to the
+    conv width). ``senders``/``receivers``: ``[n_edges]`` int32, sorted by
+    receiver (the ``batch_np`` contract — required only by the backward's
+    sorted segment sum; pass ``edges_sorted=False`` for hand-built lists).
+    ``ew``/``eb``: edge_linear kernel/bias; ``xw``/``xb``/``hw``/``hb``:
+    the fused 3-gate GRU projections (torch r|z|n layout, exactly the
+    ``models.GRUCell`` parameter tree). Computes in f32 regardless of input
+    dtype (the VMEM-resident state is the accuracy-critical accumulator).
+    ``interpret=True`` runs the same kernel under the Pallas interpreter
+    (CPU tests). Differentiable w.r.t. ``h0`` and all weights via a
+    recompute-based ``custom_vjp``.
+    """
+    return _fused_ggnn(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
+                       n_steps, interpret, edges_sorted)

@@ -33,10 +33,14 @@ def segment_sum(
 
 
 def segment_max(
-    data: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int
+    data: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    num_segments: int,
+    indices_are_sorted: bool = False,
 ) -> jnp.ndarray:
     return jax.ops.segment_max(
-        data, segment_ids, num_segments=num_segments, indices_are_sorted=False
+        data, segment_ids, num_segments=num_segments,
+        indices_are_sorted=indices_are_sorted,
     )
 
 
@@ -45,14 +49,22 @@ def segment_mean(
     segment_ids: jnp.ndarray,
     num_segments: int,
     mask: jnp.ndarray | None = None,
+    indices_are_sorted: bool = False,
 ) -> jnp.ndarray:
+    """``indices_are_sorted``: promise that ``segment_ids`` is non-decreasing
+    (the ``batch_np`` contract for ``node_gidx``) — every scatter inside takes
+    XLA's sorted-segment fast path, worth ~15% on TPU (r05). A false promise
+    makes TPU reductions silently wrong; leave False for hand-built ids."""
     trailing = (1,) * (data.ndim - 1)
     if mask is not None:
         data = jnp.where(mask.reshape(mask.shape[0], *trailing), data, 0)
-        counts = segment_sum(mask.astype(data.dtype), segment_ids, num_segments)
+        counts = segment_sum(mask.astype(data.dtype), segment_ids, num_segments,
+                             indices_are_sorted=indices_are_sorted)
     else:
-        counts = segment_sum(jnp.ones(data.shape[0], data.dtype), segment_ids, num_segments)
-    totals = segment_sum(data, segment_ids, num_segments)
+        counts = segment_sum(jnp.ones(data.shape[0], data.dtype), segment_ids,
+                             num_segments, indices_are_sorted=indices_are_sorted)
+    totals = segment_sum(data, segment_ids, num_segments,
+                         indices_are_sorted=indices_are_sorted)
     counts = jnp.maximum(counts, 1)
     return totals / counts.reshape(num_segments, *trailing)
 
@@ -62,23 +74,31 @@ def segment_softmax(
     segment_ids: jnp.ndarray,
     num_segments: int,
     mask: jnp.ndarray | None = None,
+    indices_are_sorted: bool = False,
 ) -> jnp.ndarray:
     """Numerically stable softmax within each segment.
 
     ``mask`` (bool, per-row) excludes padding rows: their weight is exactly 0
     and they do not shift the max. This is the core of attention pooling over
     padded graph batches (reference's ``GlobalAttentionPooling``).
+
+    ``indices_are_sorted``: promise that ``segment_ids`` is non-decreasing
+    (the ``batch_np`` contract for ``node_gidx``) — the max and both sums
+    inside take XLA's sorted-segment fast path. A false promise makes TPU
+    reductions silently wrong; leave False for hand-built ids.
     """
     if mask is not None:
         neg = jnp.asarray(-jnp.inf, logits.dtype)
         logits = jnp.where(mask if logits.ndim == 1 else mask[:, None], logits, neg)
-    maxes = segment_max(logits, segment_ids, num_segments)
+    maxes = segment_max(logits, segment_ids, num_segments,
+                        indices_are_sorted=indices_are_sorted)
     # Padding-only segments have max -inf; zero them to keep the sub finite.
     maxes = jnp.where(jnp.isfinite(maxes), maxes, 0)
     shifted = logits - jnp.take(maxes, segment_ids, axis=0)
     exp = jnp.exp(shifted)
     if mask is not None:
         exp = jnp.where(mask if exp.ndim == 1 else mask[:, None], exp, 0)
-    denom = segment_sum(exp, segment_ids, num_segments)
+    denom = segment_sum(exp, segment_ids, num_segments,
+                        indices_are_sorted=indices_are_sorted)
     denom = jnp.where(denom == 0, 1, denom)
     return exp / jnp.take(denom, segment_ids, axis=0)

@@ -172,6 +172,9 @@ def _batcher(cfg: ExperimentConfig, graphs: list[Graph] | None = None):
             ),
             graphs,
         )
+    # segment AND fused layouts batch identically (fused consumes segment
+    # BatchedGraphs; the Trainer drops VMEM-oversized buckets to its segment
+    # twin per batch, so no batcher-side special-casing is needed)
     if b.auto_buckets and graphs:
         from deepdfa_tpu.data.graphs import derive_buckets
 

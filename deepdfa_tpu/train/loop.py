@@ -67,7 +67,12 @@ def graph_labels(batch) -> jnp.ndarray:
     if not hasattr(batch, "node_gidx"):  # dense layout
         return jnp.max(jnp.where(batch.node_mask, vuln, 0.0), axis=1)
     # _VULN ∈ {0,1}; empty-segment identity is -inf, so clamp at 0.
-    return jnp.maximum(segment_max(vuln, batch.node_gidx, batch.max_graphs), 0.0)
+    # node_gidx is non-decreasing by construction (batch_np) → sorted fast path
+    return jnp.maximum(
+        segment_max(vuln, batch.node_gidx, batch.max_graphs,
+                    indices_are_sorted=True),
+        0.0,
+    )
 
 
 def extract_labels(
@@ -207,7 +212,8 @@ class Trainer:
     logging, profiling — parity with ``main_cli.py``) composes this.
 
     Layout-polymorphic: ``model`` may be the segment-layout :class:`GGNN`
-    fed :class:`BatchedGraphs`, or the dense-layout
+    or the fused-kernel :class:`~deepdfa_tpu.models.ggnn_fused.GGNNFused`
+    (both fed :class:`BatchedGraphs`), or the dense-layout
     :class:`~deepdfa_tpu.models.ggnn_dense.GGNNDense` fed
     :class:`~deepdfa_tpu.data.dense.DenseBatch` — label extraction is the
     only layout-aware step (:func:`graph_labels`)."""
@@ -238,10 +244,13 @@ class Trainer:
         # the segment-layout twin with the SAME params (identical tree,
         # parity-tested) — eval completeness, not a second model. jit is
         # lazy, so the fallback steps cost nothing unless an oversize batch
-        # actually arrives.
+        # actually arrives. fused layout: same twin, different trigger — a
+        # bucket whose VMEM working set exceeds the kernel's planning cap
+        # (e.g. the worst-case overflow rescue bucket) takes the segment
+        # steps instead; correctness is never gated on VMEM.
         self.fallback_train_step = self.fallback_eval_step = None
         self._seg_twin = None
-        if self.cfg.model.layout == "dense":
+        if self.cfg.model.layout in ("dense", "fused"):
             import dataclasses as _dc
 
             from deepdfa_tpu.models import make_model
@@ -267,6 +276,17 @@ class Trainer:
         """(train_step, eval_step) for this batch's layout."""
         is_segment = hasattr(batch, "node_gidx")
         if is_segment and self.fallback_train_step is not None:
+            if self.cfg.model.layout == "fused":
+                # fused consumes segment batches natively; only buckets whose
+                # static shape blows the VMEM plan drop to the segment twin
+                from deepdfa_tpu.ops.fused_ggnn import fits_vmem
+
+                if fits_vmem(
+                    batch.node_mask.shape[0],
+                    batch.senders.shape[0],
+                    self.cfg.model.out_dim // 2,
+                ):
+                    return self.train_step, self.eval_step
             return self.fallback_train_step, self.fallback_eval_step
         return self.train_step, self.eval_step
 
@@ -274,10 +294,15 @@ class Trainer:
         rng = jax.random.key(self.cfg.seed)
         rng, init_rng = jax.random.split(rng)
         model = self.model
-        if hasattr(example_batch, "node_gidx") and self._seg_twin is not None:
+        if (
+            hasattr(example_batch, "node_gidx")
+            and self._seg_twin is not None
+            and self.cfg.model.layout == "dense"
+        ):
             # layouts share one param tree, so a segment example initialises
             # the dense model too (possible when every sampled graph was
-            # oversize and only the fallback route produced a batch)
+            # oversize and only the fallback route produced a batch); the
+            # fused model consumes segment batches natively, no twin needed
             model = self._seg_twin
         params = model.init(init_rng, example_batch)["params"]
         return TrainState(params, self.optimizer.init(params), rng, jnp.zeros((), jnp.int32))

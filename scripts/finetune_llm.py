@@ -78,7 +78,7 @@ def main(argv=None) -> dict:
     from deepdfa_tpu import utils
     from deepdfa_tpu.llm.dataset import HashTokenizer
     from deepdfa_tpu.llm.finetune import FinetuneConfig, LoraFinetuner
-    from deepdfa_tpu.llm.llama import LlamaForCausalLM, codellama_7b, codellama_13b, tiny_llama
+    from deepdfa_tpu.llm.llama import LlamaForCausalLM, tiny_llama
     from deepdfa_tpu.llm.selfinstruct import FINETUNE_PRESETS, encode_multitask
 
     preset = FINETUNE_PRESETS[args.preset] if args.preset else None

@@ -20,8 +20,9 @@ mkdir -p "$ART"
 # ONE stage list: the run section and the completion check both iterate it
 # (a stage added to one but not the other once risked a false
 # "battery complete")
-STAGES=(bench_ggnn_segment bench_int8_prefill bench_int8_decode
-        bench_llm_qlora bench_ggnn_dense serving_check perf_eval_full)
+STAGES=(bench_ggnn_segment bench_ggnn_fused bench_int8_prefill
+        bench_int8_decode bench_llm_qlora bench_ggnn_dense serving_check
+        perf_eval_full)
 log() { echo "[$(date -u +%H:%M:%S)] $*" >>"$LOG"; }
 
 probe() {
@@ -74,6 +75,11 @@ while true; do
     # segment run for 28+ min this round — the battery runs the safe
     # superbatch only; a full-peak run is an operator action.
     run_one bench_ggnn_segment  4500 python bench.py --layout segment --peak-batches 1024
+    # fused-VMEM Pallas layout (ops/fused_ggnn.py): its own stage so the
+    # replay merge can promote whichever of the three layouts wins even
+    # when another stage wedges; early (a first-ever Mosaic compile is
+    # less wedge-prone than the dense per-shape compile train)
+    run_one bench_ggnn_fused    4500 python bench.py --layout fused
     run_one bench_int8_prefill  4500 python scripts/bench_int8_llm.py
     run_one bench_int8_decode   4500 python scripts/bench_int8_llm.py --decode 128 --batch 8
     run_one bench_llm_qlora     4500 python bench_llm.py

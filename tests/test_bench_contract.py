@@ -528,6 +528,136 @@ def test_replay_banked_adopts_cpu_fallback_baseline(tmp_path, monkeypatch,
     assert out["vs_baseline"] == round(76580.0 / 877.7, 2)
 
 
+def test_assemble_fused_schema_and_winner():
+    """The fused stage's columns land in the artifact and the headline goes
+    to the fastest validated layout; the loser's RAW number survives in
+    layout_compare instead of being discarded."""
+    res = bench._assemble_result(
+        "tpu", "TPU v5 lite", 169.5e12, {"nodes": 0.8, "edges": 0.8},
+        243.0,
+        {"graphs_per_sec": 76580.0, "flops_per_step": 19.3e9, "k": 128,
+         "step_ms": 3.2, "wall_s": 0.4},
+        fused={"graphs_per_sec": 120000.0, "flops_per_step": 9.6e9,
+               "k": 128, "step_ms": 1.0, "wall_s": 0.2},
+        fused_real=121.5, fused_batch_graphs=128,
+        dense_error="skipped (--layout fused)",
+    )
+    assert res["layout"] == "fused" and res["value"] == 120000.0
+    assert res["fused_graphs_per_sec"] == 120000.0
+    assert res["fused_step_ms"] == 1.0
+    assert res["fused_flops_per_step"] == 9.6e9
+    assert res["fused_graphs_per_batch"] == 121.5
+    assert res["fused_batch_graphs"] == 128
+    assert res["fused_error"] is None
+    assert res["dense_error"] == "skipped (--layout fused)"
+    lc = res["layout_compare"]
+    assert lc["winner"] == "fused"
+    assert lc["fused"] == {"graphs_per_sec_raw": 120000.0,
+                           "graphs_per_sec": 120000.0}
+    # the losing segment rate is recorded, not discarded (round-5 gap)
+    assert lc["segment"] == {"graphs_per_sec_raw": 76580.0,
+                             "graphs_per_sec": 76580.0}
+
+
+def test_assemble_fused_refusal_keeps_raw_in_layout_compare():
+    """A fused rate past the roofline is refused from the headline and the
+    fused column, but the raw measurement stays in layout_compare."""
+    res = bench._assemble_result(
+        "tpu", "TPU v5 lite", 169.5e12, {"nodes": 0.8, "edges": 0.8},
+        243.0,
+        {"graphs_per_sec": 76580.0, "flops_per_step": 19.3e9, "k": 128,
+         "step_ms": 3.2, "wall_s": 0.4},
+        fused={"graphs_per_sec": 1e9, "flops_per_step": 57.9e9,
+               "k": 128, "step_ms": 0.01, "wall_s": 0.2},
+        fused_real=128.0, fused_batch_graphs=128,
+    )
+    assert res["layout"] == "segment" and res["value"] == 76580.0
+    assert res["fused_graphs_per_sec"] is None
+    assert "fused_graphs_per_sec" in res["refused"]
+    assert res["layout_compare"]["fused"]["graphs_per_sec_raw"] == 1e9
+    assert res["layout_compare"]["fused"]["graphs_per_sec"] is None
+    assert res["layout_compare"]["winner"] == "segment"
+
+
+def test_replay_banked_merges_fused_winner(tmp_path, monkeypatch, capsys):
+    """A fused-battery artifact banked separately must merge with the
+    segment artifact (same anchors) and take the headline when faster,
+    carrying its raw layout_compare entry across the merge."""
+    monkeypatch.setenv("BENCH_BANKED_ROOT", str(tmp_path))
+    _banked(tmp_path, "bench_ggnn_segment", _SEG_ART)
+    fused = {
+        **_SEG_ART,
+        # slower own segment anchor keeps the base pick deterministic
+        "segment_graphs_per_sec": 76000.0,
+        "fused_graphs_per_sec": 300000.0, "fused_step_ms": 0.9,
+        "fused_flops_per_step": 19.3e9, "fused_graphs_per_batch": 121.5,
+        "fused_batch_graphs": 128, "fused_error": None,
+        "layout_compare": {
+            "segment": {"graphs_per_sec_raw": 76000.0,
+                        "graphs_per_sec": 76000.0},
+            "fused": {"graphs_per_sec_raw": 300000.0,
+                      "graphs_per_sec": 300000.0},
+            "winner": "fused"},
+    }
+    _banked(tmp_path, "bench_ggnn_fused", fused)
+    assert bench.replay_banked("wedged grant") is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["layout"] == "fused" and out["value"] == 300000.0
+    assert out["segment_graphs_per_sec"] == 76580.0  # base anchor preserved
+    assert out["fused_step_ms"] == 0.9
+    assert out["fused_batch_graphs"] == 128
+    assert len(out["replayed_from_banked"]) == 2
+    lc = out["layout_compare"]
+    assert lc["winner"] == "fused"
+    assert lc["fused"]["graphs_per_sec_raw"] == 300000.0
+    # implied TFLOP/s self-consistent with the fused per-graph FLOPs
+    implied = 300000.0 * (19.3e9 / 121.5) / 1e12
+    assert out["implied_tflops"] == round(implied, 2)
+    assert out["vs_baseline"] == round(300000.0 / 877.7, 2)
+
+
+def test_replay_banked_no_fused_merge_on_anchor_mismatch(tmp_path,
+                                                         monkeypatch,
+                                                         capsys):
+    """Fused columns measured under a different workload config must not be
+    grafted onto the segment artifact's anchors."""
+    monkeypatch.setenv("BENCH_BANKED_ROOT", str(tmp_path))
+    _banked(tmp_path, "bench_ggnn_segment", _SEG_ART)
+    _banked(tmp_path, "bench_ggnn_fused", {
+        **_SEG_ART, "segment_graphs_per_sec": None,
+        "fused_graphs_per_sec": 300000.0, "fused_step_ms": 0.9,
+        "fused_flops_per_step": 19.3e9, "fused_graphs_per_batch": 121.5,
+        "config": "hidden64_steps5_concat4_batch256",  # different workload
+    })
+    assert bench.replay_banked("dead tunnel") is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["layout"] == "segment" and out["value"] == 76580.0
+    assert out.get("fused_graphs_per_sec") is None
+    assert len(out["replayed_from_banked"]) == 1
+
+
+def test_replay_banked_refuses_over_roofline_fused(tmp_path, monkeypatch,
+                                                   capsys):
+    """The merged fused challenger passes the same physics gate: an implied
+    FLOP/s above the banked roofline is refused, the headline falls back to
+    segment, and the raw rate survives in layout_compare."""
+    monkeypatch.setenv("BENCH_BANKED_ROOT", str(tmp_path))
+    _banked(tmp_path, "bench_ggnn_segment", {
+        **_SEG_ART,
+        # implied = 1e9 g/s × (57.9e9 / 100 flops/graph) = 579 PFLOP/s —
+        # orders of magnitude past the banked 169.5 TFLOP/s roofline
+        "fused_graphs_per_sec": 1e9, "fused_step_ms": 0.1,
+        "fused_flops_per_step": 57.9e9, "fused_graphs_per_batch": 100.0,
+    })
+    assert bench.replay_banked("dead tunnel") is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["layout"] == "segment" and out["value"] == 76580.0
+    assert "replayed_fused_graphs_per_sec" in out["refused"]
+    assert out["fused_graphs_per_sec"] is None  # refused ⇒ reported null
+    assert out["layout_compare"]["fused"]["graphs_per_sec_raw"] == 1e9
+    assert out["layout_compare"]["fused"]["graphs_per_sec"] is None
+
+
 @pytest.mark.slow
 def test_round_end_replay_from_repo_artifacts():
     """The driver-scenario dress rehearsal, pinned: `python bench.py` with
