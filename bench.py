@@ -475,6 +475,75 @@ def bench_jax(batches, steps: int, train: bool, dtype: str = "bfloat16"):
     }
 
 
+def sentinel_overhead_pct(plain_s: float, guarded_s: float) -> float:
+    """Relative per-step cost of the in-jit divergence-sentinel guard, in
+    percent. Negative = guard measured faster (timing noise)."""
+    if plain_s <= 0:
+        raise ValueError(f"plain_s must be > 0, got {plain_s}")
+    return (guarded_s - plain_s) / plain_s * 100.0
+
+
+def sentinel_guard_ok(pct: float, budget: float = 2.0) -> bool:
+    """The resilience invariant (ROADMAP): the sentinel's isfinite-and-select
+    guard must cost < ``budget`` percent of a training step."""
+    return pct <= budget
+
+
+def bench_sentinel_overhead(batches, steps: int = 20, dtype: str = "bfloat16",
+                            repeats: int = 3):
+    """Median train-step time with the divergence-sentinel guard compiled in
+    vs out (``ResilienceConfig.sentinel``) — the guard is a handful of
+    ``isfinite`` reductions + a predicated tree-select fused into the update,
+    so its cost must stay under the 2% budget."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import ExperimentConfig, ResilienceConfig
+    from deepdfa_tpu.models import make_model
+    from deepdfa_tpu.train.loop import Trainer
+    from deepdfa_tpu.train.metrics import ConfusionState
+
+    dev = [jax.tree.map(jnp.asarray, b) for b in batches]
+
+    def _median_step(sentinel: bool) -> float:
+        cfg = ExperimentConfig()
+        cfg = dataclasses.replace(
+            cfg,
+            model=dataclasses.replace(cfg.model, dtype=dtype),
+            resilience=ResilienceConfig(sentinel=sentinel),
+        )
+        model = make_model(cfg.model, input_dim=cfg.input_dim)
+        trainer = Trainer(model=model, cfg=cfg, pos_weight=15.0)
+        state = trainer.init_state(dev[0])
+        step = trainer.train_step
+        metrics = ConfusionState.zeros()
+        state, metrics, loss, _ = step(state, dev[0], metrics)  # compile
+        jax.block_until_ready(loss)
+        box = {"state": state, "metrics": metrics, "i": 0}
+
+        def run_once():
+            b = dev[box["i"] % len(dev)]
+            box["i"] += 1
+            box["state"], box["metrics"], loss, _ = step(
+                box["state"], b, box["metrics"]
+            )
+            return loss
+
+        return min(_timed(run_once, steps)[0] for _ in range(repeats))
+
+    plain = _median_step(False)
+    guarded = _median_step(True)
+    pct = sentinel_overhead_pct(plain, guarded)
+    return {
+        "plain_step_ms": round(plain * 1e3, 3),
+        "guarded_step_ms": round(guarded * 1e3, 3),
+        "overhead_pct": round(pct, 2),
+        "ok": sentinel_guard_ok(pct),
+    }
+
+
 def bench_torch_cpu(batches, steps: int):
     """Same-semantics torch-CPU inference baseline (real graphs/sec)."""
     import torch
@@ -1285,7 +1354,7 @@ def main():
     dense = dense_occ = dense_real = None
     dense_error = dense_dropped = dense_by_shape = None
     fused = fused_real = fused_error = None
-    chained_train = strict = None
+    chained_train = strict = sentinel_stats = None
     peak_runs: dict[str, tuple] = {}
     peak_errors: dict[str, str] = {}
     base_gps = None
@@ -1307,6 +1376,8 @@ def main():
             dense_by_shape, fused, fused_real, fused_error,
             FUSED_BATCH_GRAPHS)
         r["partial_through_stage"] = stage
+        if sentinel_stats is not None:
+            r["sentinel"] = sentinel_stats
         tmp = partial_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(r, f)
@@ -1330,6 +1401,22 @@ def main():
         _progress("single-dispatch strict/pipelined")
         strict = bench_jax(batches, args.steps, train=False)
         bank("strict")
+        # Resilience invariant guard: the divergence sentinel must cost
+        # < 2% of a train step (its isfinite+select fuses into the update).
+        # Failures/overruns are recorded, never fatal — timing on a loaded
+        # host is noisy and the artifact must still emit.
+        _progress("sentinel overhead")
+        try:
+            sentinel_stats = bench_sentinel_overhead(
+                batches, steps=max(args.steps // 2, 10))
+            if not sentinel_stats["ok"]:
+                _progress(
+                    f"WARNING: sentinel overhead "
+                    f"{sentinel_stats['overhead_pct']:.1f}% exceeds the 2% "
+                    "budget")
+        except Exception as e:  # recorded verbatim, never swallowed
+            sentinel_stats = {"error": f"{type(e).__name__}: {e}"}
+        bank("sentinel")
 
     # Peak throughput at superbatches: same model, larger static batches -
     # bigger kernels per dispatch, higher arithmetic intensity. Failures are
@@ -1414,6 +1501,8 @@ def main():
         dense, dense_real, dense_occ, dense_dropped, dense_error,
         chained_train, strict, peak_runs, peak_errors, base_gps,
         dense_by_shape, fused, fused_real, fused_error, FUSED_BATCH_GRAPHS)
+    if sentinel_stats is not None:
+        result["sentinel"] = sentinel_stats
     print(json.dumps(result))
 
 

@@ -228,12 +228,38 @@ class CheckpointConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance knobs (deepdfa_tpu/resilience): the divergence
+    sentinel (non-finite steps are always *skipped* in-jit when ``sentinel``
+    is on; after ``sentinel_patience`` consecutive skips the trainer rolls
+    back to the last good checkpoint at ``lr * lr_backoff``), and the
+    rollback budget before the run aborts for real."""
+
+    sentinel: bool = True
+    sentinel_patience: int = 3  # consecutive non-finite steps → rollback
+    sentinel_lag: int = 2  # host checks the loss N steps behind (no sync stall)
+    lr_backoff: float = 0.5  # LR scale applied per rollback
+    max_rollbacks: int = 3  # rollbacks before the run gives up
+
+    def __post_init__(self):
+        if self.sentinel_patience < 1:
+            raise ValueError("sentinel_patience must be >= 1")
+        if self.sentinel_lag < 0:
+            raise ValueError("sentinel_lag must be >= 0")
+        if not 0.0 < self.lr_backoff <= 1.0:
+            raise ValueError("lr_backoff must be in (0, 1]")
+        if self.max_rollbacks < 0:
+            raise ValueError("max_rollbacks must be >= 0")
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     data: DataConfig = field(default_factory=DataConfig)
     model: GGNNConfig = field(default_factory=GGNNConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     seed: int = 0
     run_name: str | None = None
     profile: bool = False
@@ -294,6 +320,7 @@ _NESTED: dict[tuple[str, str], type] = {
     ("ExperimentConfig", "optim"): OptimConfig,
     ("ExperimentConfig", "mesh"): MeshConfig,
     ("ExperimentConfig", "checkpoint"): CheckpointConfig,
+    ("ExperimentConfig", "resilience"): ResilienceConfig,
 }
 
 

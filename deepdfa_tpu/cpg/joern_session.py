@@ -21,7 +21,15 @@ import threading
 import time
 from pathlib import Path
 
-__all__ = ["JoernSession", "strip_ansi", "marshal_params", "joern_available"]
+from deepdfa_tpu.resilience import faults
+
+__all__ = [
+    "JoernSession",
+    "JoernTimeout",
+    "strip_ansi",
+    "marshal_params",
+    "joern_available",
+]
 
 _ANSI_RE = re.compile(
     r"\x1b(?:[@-Z\\-_]|\[[0-?]*[ -/]*[@-~])"  # 7-bit C1: ESC + CSI sequences
@@ -35,6 +43,17 @@ def strip_ansi(text: str) -> str:
     """Remove ANSI escape sequences (the REPL colors its prompt even under
     ``--nocolors`` on some terminals)."""
     return _ANSI_RE.sub("", text)
+
+
+class JoernTimeout(TimeoutError):
+    """No prompt within the deadline. ``partial`` carries the full
+    ANSI-stripped buffer accumulated so far (the message keeps only the
+    tail) — the extraction supervisor logs it so quarantine entries say
+    *why* a function hung, not just that it did."""
+
+    def __init__(self, message: str, partial: str = ""):
+        super().__init__(message)
+        self.partial = partial
 
 
 def _scala_str(val: str | Path) -> str:
@@ -146,15 +165,22 @@ class JoernSession:
                     )
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise TimeoutError(
+                    buffered = strip_ansi(text)
+                    raise JoernTimeout(
                         f"no joern prompt within {timeout or self.timeout}s; "
-                        f"buffered: {strip_ansi(text)[-500:]!r}"
+                        f"buffered: {buffered[-500:]!r}",
+                        partial=buffered,
                     )
                 self._cond.wait(min(remaining, 1.0))
 
     def run_command(self, command: str, timeout: float | None = None) -> str:
-        self.proc.stdin.write(command + "\n")
-        self.proc.stdin.flush()
+        # chaos points: a JVM that dies under a command, and one that eats
+        # the command whole (no output, no prompt → timeout path)
+        if faults.fire("joern.die"):
+            self.proc.kill()
+        elif not faults.fire("joern.hang"):
+            self.proc.stdin.write(command + "\n")
+            self.proc.stdin.flush()
         return self.read_until_prompt(timeout=timeout)
 
     # -- joern commands -----------------------------------------------------

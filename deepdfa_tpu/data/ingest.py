@@ -50,6 +50,9 @@ __all__ = [
     "splits_map",
     "partition",
     "validate_cpgs",
+    "QUARANTINE_FILE",
+    "read_quarantine",
+    "write_quarantine",
     "VulnDataset",
 ]
 
@@ -588,6 +591,33 @@ def validate_cpgs(cpgs: dict, drop_errors: bool = True) -> tuple[dict, dict]:
     bad = set(summary["error_graph_ids"])
     kept = {gid: cpg for gid, cpg in cpgs.items() if gid not in bad}
     return kept, summary
+
+
+# ---------------------------------------------------------------------------
+# extraction quarantine report
+
+QUARANTINE_FILE = "quarantine.json"
+
+
+def write_quarantine(out_dir: str | Path, report: dict) -> Path:
+    """Persist an :class:`~deepdfa_tpu.resilience.ExtractionSupervisor`
+    report (``{"restarts": int, "quarantined": [entry, ...]}``) next to the
+    shard output, atomically — poison functions are *recorded*, never the
+    reason a corpus build aborts. Returns the file path."""
+    from deepdfa_tpu.resilience.journal import atomic_write_text
+
+    path = Path(out_dir) / QUARANTINE_FILE
+    atomic_write_text(path, json.dumps(report, indent=2, default=str))
+    return path
+
+
+def read_quarantine(out_dir: str | Path) -> dict:
+    """The recorded quarantine report, or an empty one if the build had no
+    poison functions (the file is only written when non-empty)."""
+    path = Path(out_dir) / QUARANTINE_FILE
+    if not path.exists():
+        return {"restarts": 0, "quarantined": []}
+    return json.loads(path.read_text())
 
 
 # ---------------------------------------------------------------------------

@@ -28,6 +28,8 @@ import queue
 import threading
 from typing import Any, Iterable, Iterator
 
+from deepdfa_tpu.resilience import faults
+
 __all__ = ["prefetch_to_device"]
 
 _SENTINEL = object()
@@ -73,6 +75,9 @@ def prefetch_to_device(
     def produce():
         try:
             for item in iterator:
+                # chaos point: a batcher blowing up mid-stream inside the
+                # thread (must surface at the consumer's next(), never hang)
+                faults.raise_if("prefetch.producer_raises")
                 staged = (
                     jax.device_put(item, device)
                     if device is not None
@@ -96,4 +101,21 @@ def prefetch_to_device(
                 raise item.exc
             yield item
     finally:
+        # Thread-leak fix: stop.set() alone only *asks* the producer to
+        # exit — an early-exiting consumer (break / exception / abandoned
+        # iterator) used to leave the thread and its staged device batches
+        # alive until interpreter exit. The producer's _put loop polls
+        # ``stop`` every 0.1 s, so this join completes promptly; the
+        # timeout is a backstop against a producer wedged inside
+        # device_put, and a still-alive thread after it is a bug worth
+        # surfacing loudly.
         stop.set()
+        t.join(timeout=5.0)
+        if t.is_alive():  # pragma: no cover — requires a wedged device_put
+            import warnings
+
+            warnings.warn(
+                "prefetch_to_device producer thread failed to exit within 5s",
+                RuntimeWarning,
+                stacklevel=2,
+            )

@@ -1,0 +1,46 @@
+"""Fault tolerance for the training and extraction pipelines.
+
+Four pieces, wired through ``train``, ``data`` and ``cpg``:
+
+- :mod:`~deepdfa_tpu.resilience.faults` — named, seed-deterministic fault
+  injection points (armed via the ``DEEPDFA_FAULTS`` env var) that make
+  the rest testable;
+- :mod:`~deepdfa_tpu.resilience.journal` — atomic small-file commits and
+  the durable per-run :class:`RunJournal` behind ``fit --resume``;
+- :mod:`~deepdfa_tpu.resilience.sentinel` — the divergence watchdog that
+  turns non-finite train steps into checkpoint rollback + LR backoff
+  instead of a dead run;
+- :mod:`~deepdfa_tpu.resilience.retry` / ``supervisor`` — capped-backoff
+  retry and the Joern session supervisor with poison-function quarantine.
+
+Invariants this package guarantees (recorded in ROADMAP "Open items"):
+a checkpoint step dir either has a committed ``meta.json`` or is garbage;
+a journal read returns the old record or the new one, never a torn one;
+a non-finite step never mutates params/opt-state; a quarantined function
+costs one report row, never the corpus.
+"""
+
+from deepdfa_tpu.resilience import faults
+from deepdfa_tpu.resilience.journal import RunJournal, atomic_write_text, fsync_dir
+from deepdfa_tpu.resilience.retry import RetryExhausted, RetryPolicy, retry_call
+from deepdfa_tpu.resilience.sentinel import DivergenceError, DivergenceSentinel
+from deepdfa_tpu.resilience.supervisor import (
+    ExtractionSupervisor,
+    QuarantinedError,
+    SESSION_ERRORS,
+)
+
+__all__ = [
+    "faults",
+    "RunJournal",
+    "atomic_write_text",
+    "fsync_dir",
+    "RetryExhausted",
+    "RetryPolicy",
+    "retry_call",
+    "DivergenceError",
+    "DivergenceSentinel",
+    "ExtractionSupervisor",
+    "QuarantinedError",
+    "SESSION_ERRORS",
+]

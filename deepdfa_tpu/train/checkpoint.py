@@ -18,11 +18,23 @@ Orbax-backed parity with the reference's checkpoint stack:
 Checkpoints are written under ``{dir}/{step:08d}`` with a JSON metadata
 sidecar; orbax handles the array payload (and, on TPU slices, the
 distributed-array layout).
+
+Commit discipline (resilience invariant): a step is written into
+``{step:08d}.tmp`` — state payload, optional ``aux`` payload (opt-state /
+rng for ``fit --resume``), then ``meta.json`` — and only then atomically
+renamed into place. ``meta.json`` inside a committed dir is therefore the
+commit marker: ``_scan`` garbage-collects ``*.tmp`` leftovers and
+marker-less step dirs (partial writes from pre-atomic crashes), and
+:meth:`CheckpointManager.restore_resume` walks newest→oldest past any
+checkpoint whose payload fails to load, so one corrupted step costs one
+step of progress, never the run.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import re
 import shutil
 from pathlib import Path
 from typing import Any
@@ -31,6 +43,8 @@ import jax
 import orbax.checkpoint as ocp
 
 from deepdfa_tpu.config import CheckpointConfig
+from deepdfa_tpu.resilience import faults
+from deepdfa_tpu.resilience.journal import fsync_dir
 
 __all__ = [
     "CheckpointManager",
@@ -60,7 +74,22 @@ class CheckpointManager:
         self._saved: list[dict] = self._scan()
 
     # -- bookkeeping -------------------------------------------------------
+    _STEP_DIR = re.compile(r"\d{8}")
+
     def _scan(self) -> list[dict]:
+        # GC before indexing: a crash mid-commit leaves either a *.tmp dir
+        # (atomic path, never renamed) or — from pre-atomic writers — a
+        # step-shaped dir without its meta.json commit marker. Both are
+        # unreadable garbage and must not shadow good checkpoints.
+        for entry in self.dir.iterdir():
+            if not entry.is_dir():
+                continue
+            partial = entry.name.endswith(".tmp") or (
+                self._STEP_DIR.fullmatch(entry.name)
+                and not (entry / "meta.json").exists()
+            )
+            if partial:
+                shutil.rmtree(entry, ignore_errors=True)
         out = []
         for meta_file in sorted(self.dir.glob("*/meta.json")):
             try:
@@ -83,9 +112,13 @@ class CheckpointManager:
         state: Any,
         metrics: dict[str, float] | None = None,
         epoch: int | None = None,
+        aux: Any | None = None,
     ) -> bool:
         """Save if any policy wants this step; apply retention. Returns
-        whether a checkpoint was written."""
+        whether a checkpoint was written. ``aux`` is a second pytree saved
+        alongside ``state`` (the trainer's opt-state/rng for ``--resume``)
+        — restored via :meth:`restore_aux`, invisible to plain
+        :meth:`restore` callers."""
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         reasons = []
         if self.cfg.save_last:
@@ -100,12 +133,25 @@ class CheckpointManager:
         if not reasons:
             return False
 
+        # Atomic commit: build the whole step sideways, meta.json last, then
+        # one os.replace into the final name. A crash at ANY point (the
+        # ckpt.crash_between_state_and_meta fault drives the worst spot)
+        # leaves only a .tmp dir for _scan to GC — restore can never see a
+        # state payload without its committed metadata.
         path = self._path(step)
+        tmp = path.with_name(path.name + ".tmp")
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        self._ckptr.save(tmp / "state", state)
+        if aux is not None:
+            self._ckptr.save(tmp / "aux", aux)
+        faults.crash_if("ckpt.crash_between_state_and_meta")
+        meta = dict(step=int(step), epoch=epoch, metrics=metrics, reasons=reasons)
+        (tmp / "meta.json").write_text(json.dumps(meta))
         if path.exists():
             shutil.rmtree(path)
-        self._ckptr.save(path / "state", state)
-        meta = dict(step=int(step), epoch=epoch, metrics=metrics, reasons=reasons)
-        (path / "meta.json").write_text(json.dumps(meta))
+        os.replace(tmp, path)
+        fsync_dir(self.dir)
         # overwriting a step (e.g. a re-run resuming at the same step) must
         # replace its bookkeeping entry, not duplicate it
         self._saved = [m for m in self._saved if m["step"] != int(step)]
@@ -182,6 +228,42 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError("no checkpoints")
         return self.restore(step, template)
+
+    def restore_aux(self, step: int, template: Any | None = None) -> Any:
+        """Restore the ``aux`` payload (see :meth:`save`) of a step."""
+        path = self._path(step) / "aux"
+        if not path.exists():
+            raise FileNotFoundError(f"checkpoint {step} has no aux payload ({path})")
+        if template is not None:
+            return self._ckptr.restore(path, item=template)
+        return self._ckptr.restore(path)
+
+    def restore_resume(
+        self, template: Any | None = None, aux_template: Any | None = None
+    ) -> tuple[int, dict, Any, Any]:
+        """Walk checkpoints newest→oldest and return the first that restores
+        cleanly as ``(step, meta, state, aux)``; a corrupted/truncated
+        newest checkpoint costs one step of progress instead of the run.
+        ``aux`` is ``None`` when ``aux_template`` is ``None``; a checkpoint
+        without the required aux payload is treated as unrestorable (resume
+        needs the full trainer state)."""
+        last_exc: Exception | None = None
+        for m in reversed(self._saved):
+            step = int(m["step"])
+            try:
+                state = self.restore(step, template)
+                aux = (
+                    self.restore_aux(step, aux_template)
+                    if aux_template is not None
+                    else None
+                )
+                return step, m, state, aux
+            except Exception as exc:  # noqa: BLE001 — fall back to older step
+                last_exc = exc
+                continue
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self.dir}"
+        ) from last_exc
 
     def meta(self, step: int) -> dict:
         return json.loads((self._path(step) / "meta.json").read_text())

@@ -337,7 +337,10 @@ def _tb_writer(run_dir: Path):
     return SummaryWriter(log_dir=str(run_dir / "tb"))
 
 
-def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
+def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str, float]:
+    from deepdfa_tpu.resilience import DivergenceError, DivergenceSentinel, RunJournal
+    from deepdfa_tpu.train.loop import TrainState
+
     corpus = load_corpus(cfg)
     train, val = corpus["train"], corpus["val"]
     train_labels = np.array([int(g.node_feats["_VULN"].max()) for g in train])
@@ -356,16 +359,98 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
     )
     state = trainer.init_state(example)
     ckpts = CheckpointManager(run_dir / "checkpoints", cfg.checkpoint)
+    journal = RunJournal(run_dir / "journal.json")
+    res = cfg.resilience
+    sentinel = (
+        DivergenceSentinel(patience=res.sentinel_patience, lag=res.sentinel_lag)
+        if res.sentinel
+        else None
+    )
     tuning_file = run_dir / "tuning.jsonl"
     tb = _tb_writer(run_dir)
 
+    def _aux(s: TrainState) -> dict:
+        # the trainer state beyond params — what bit-identical resume needs
+        # (typed PRNG keys serialise via key_data / wrap_key_data)
+        return {
+            "opt_state": s.opt_state,
+            "rng": jax.random.key_data(s.rng),
+            "step": s.step,
+        }
+
+    aux_template = _aux(state)
+
+    def _restore_full(reason: str) -> tuple[TrainState, int]:
+        """(restored TrainState, checkpointed epoch); walks past corrupt
+        steps (restore_resume), so a damaged newest checkpoint falls back
+        to the previous good one."""
+        step, meta, payload, aux = ckpts.restore_resume(
+            template={"params": state.params}, aux_template=aux_template
+        )
+        restored = TrainState(
+            payload["params"],
+            aux["opt_state"],
+            jax.random.wrap_key_data(aux["rng"]),
+            aux["step"],
+        )
+        logger.info("%s: restored checkpoint step=%d (epoch %s)",
+                    reason, step, meta.get("epoch"))
+        return restored, int(meta.get("epoch", -1))
+
+    start_epoch = 0
+    n_rollbacks = 0
+    if resume:
+        rec = journal.read()
+        if rec is None or ckpts.latest_step() is None:
+            logger.warning(
+                "--resume: no journal/checkpoint under %s — starting fresh", run_dir
+            )
+        else:
+            # the checkpoint's recorded epoch (its commit is atomic) decides
+            # where training restarts; the journal carries the advisory
+            # run-level extras (rollback count, LR escalation)
+            state, ckpt_epoch = _restore_full("resume")
+            start_epoch = ckpt_epoch + 1
+            n_rollbacks = int(rec.get("rollbacks", 0))
+            lr_scale = float(rec.get("lr_scale", 1.0))
+            if lr_scale != trainer.lr_scale:
+                trainer.rescale_lr(lr_scale / trainer.lr_scale)
+            logger.info(
+                "resume: epoch %d..%d (rollbacks=%d lr_scale=%.3g)",
+                start_epoch, cfg.optim.max_epochs - 1, n_rollbacks, trainer.lr_scale,
+            )
+
     last_val: dict[str, float] = {}
     route: dict[str, int] = {}
-    for epoch in range(cfg.optim.max_epochs):
+    epoch = start_epoch
+    while epoch < cfg.optim.max_epochs:
         epoch_gs = _epoch_graphs(train, train_labels, cfg, epoch)
-        state, train_m, train_loss = trainer.train_epoch(
-            state, _batch_stream(batcher, epoch_gs, shuffle_seed=cfg.seed + epoch)
-        )
+        try:
+            state, train_m, train_loss = trainer.train_epoch(
+                state,
+                _batch_stream(batcher, epoch_gs, shuffle_seed=cfg.seed + epoch),
+                sentinel=sentinel,
+            )
+        except DivergenceError as err:
+            n_rollbacks += 1
+            sentinel.reset()
+            if n_rollbacks > res.max_rollbacks:
+                logger.error(
+                    "divergence persisted past %d rollbacks — aborting",
+                    res.max_rollbacks,
+                )
+                raise
+            trainer.rescale_lr(res.lr_backoff)
+            if ckpts.latest_step() is not None:
+                state, _ = _restore_full(f"rollback ({err})")
+            else:
+                logger.warning("diverged before the first checkpoint — re-initialising")
+                state = trainer.init_state(example)
+            logger.warning(
+                "rollback %d/%d: lr_scale=%.3g, retrying epoch %d",
+                n_rollbacks, res.max_rollbacks, trainer.lr_scale, epoch,
+            )
+            continue
         route = _oversize_stats(batcher, "_train")
         val_m, val_loss = trainer.evaluate(state.params, _batch_stream(batcher, val))
         route |= _oversize_stats(batcher, "_val")
@@ -385,9 +470,26 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
             int(state.step), {"params": state.params},
             metrics={"val_loss": val_loss, "val_F1Score": val_m["val_F1Score"]},
             epoch=epoch,
+            aux=_aux(state),
+        )
+        journal.write(
+            epoch=epoch,
+            global_step=int(state.step),
+            seed=cfg.seed,
+            sampler={
+                "seed": cfg.data.seed,
+                "undersample": cfg.data.undersample,
+                "oversample": cfg.data.oversample,
+                "epoch": epoch,
+            },
+            best_metric=ckpts.best_metric(),
+            lr_scale=trainer.lr_scale,
+            rollbacks=n_rollbacks,
+            **(sentinel.stats() if sentinel is not None else {}),
         )
         with open(tuning_file, "a") as f:
             f.write(json.dumps({"epoch": epoch, "val_F1Score": val_m["val_F1Score"]}) + "\n")
+        epoch += 1
 
     # post-fit: restore best checkpoint and re-validate (main_cli.py:175-184)
     best_step = ckpts.best_step()
@@ -405,6 +507,19 @@ def fit(cfg: ExperimentConfig, run_dir: Path) -> dict[str, float]:
     # pass's, under distinct keys — "n_dropped must stay 0" is then checked
     # against the corpus the trainer actually consumed, not just val
     last_val = dict(last_val) | route
+    last_val["n_rollbacks"] = n_rollbacks
+    last_val["lr_scale"] = trainer.lr_scale
+    if sentinel is not None:
+        last_val |= sentinel.stats()
+    journal.write(
+        epoch=cfg.optim.max_epochs - 1,
+        global_step=int(state.step),
+        seed=cfg.seed,
+        best_metric=ckpts.best_metric(),
+        lr_scale=trainer.lr_scale,
+        rollbacks=n_rollbacks,
+        completed=True,
+    )
     (run_dir / "final_metrics.json").write_text(json.dumps(last_val, indent=2))
     if tb is not None:
         tb.close()
@@ -871,6 +986,9 @@ def main(argv: Sequence[str] | None = None) -> dict:
     parser.add_argument("--set", action="append", default=[], dest="overrides",
                         help="dotted overrides, e.g. --set optim.max_epochs=3")
     parser.add_argument("--run-dir", default=None)
+    parser.add_argument("--resume", action="store_true",
+                        help="fit: resume from the run dir's latest good "
+                        "checkpoint + journal (fresh run if none found)")
     parser.add_argument("--ckpt-dir", default=None,
                         help="checkpoint dir for test/predict/export")
     parser.add_argument("--source", action="append", default=[],
@@ -924,7 +1042,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
 
     try:
         if args.command == "fit":
-            return fit(cfg, run_dir)
+            return fit(cfg, run_dir, resume=args.resume)
         if args.command == "test":
             return test(cfg, run_dir, Path(args.ckpt_dir) if args.ckpt_dir else None)
         if args.command == "predict":

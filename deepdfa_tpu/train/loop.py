@@ -22,6 +22,7 @@ Everything here is single-device; the multi-device wrapper lives in
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Any, Callable, Iterable, NamedTuple
 
@@ -30,6 +31,7 @@ import jax.numpy as jnp
 import optax
 
 from deepdfa_tpu.config import ExperimentConfig
+from deepdfa_tpu.resilience import faults
 from deepdfa_tpu.data.graphs import BatchedGraphs
 from deepdfa_tpu.models.ggnn import GGNN
 from deepdfa_tpu.ops.segment import segment_max
@@ -150,32 +152,57 @@ def make_train_step(
     label_style: str = "graph",
     pos_weight: float | None = None,
     undersample_node_on_loss_factor: float | None = None,
+    sentinel_guard: bool = True,
 ) -> Callable:
     """Build the jitted train step: forward, masked loss, grads, update,
-    in-step metric accumulation."""
+    in-step metric accumulation.
 
-    def loss_fn(params, batch, rng):
+    ``sentinel_guard`` (the in-jit half of the divergence sentinel,
+    :mod:`deepdfa_tpu.resilience.sentinel`): when the loss or ANY gradient
+    leaf is non-finite the step keeps the previous params/opt-state/metrics
+    and reports its loss as NaN — the host detects the skipped step from
+    the NaN loss alone (covering the grads-NaN-but-loss-finite case) with
+    no extra device sync. The optional trailing ``loss_scale`` argument
+    (default 1.0, exact under IEEE) exists for the ``step.nan_grads`` fault
+    point: scaling the loss poisons every gradient through the chain rule.
+    """
+
+    def loss_fn(params, batch, rng, loss_scale):
         logits = model.apply({"params": params}, batch)
         labels, weights = extract_labels(batch, label_style)
         if label_style == "node" and undersample_node_on_loss_factor is not None:
             weights = _node_loss_undersample_weights(
                 rng, labels, weights, undersample_node_on_loss_factor
             )
-        loss = bce_with_logits(logits, labels, weights, pos_weight)
+        loss = bce_with_logits(logits, labels, weights, pos_weight) * loss_scale
         return loss, (logits, labels, weights)
 
     @jax.jit
-    def train_step(state: TrainState, batch: BatchedGraphs, metrics: ConfusionState):
+    def train_step(
+        state: TrainState,
+        batch: BatchedGraphs,
+        metrics: ConfusionState,
+        loss_scale: float = 1.0,
+    ):
         rng, sub = jax.random.split(state.rng)
         (loss, (logits, labels, weights)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
-        )(state.params, batch, sub)
+        )(state.params, batch, sub, loss_scale)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         probs = jax.nn.sigmoid(logits)
-        metrics = update_confusion(metrics, probs, labels, weights > 0)
+        new_metrics = update_confusion(metrics, probs, labels, weights > 0)
+        if sentinel_guard:
+            good = jnp.isfinite(loss)
+            for g in jax.tree.leaves(grads):
+                good = good & jnp.all(jnp.isfinite(g))
+            sel = lambda new, old: jnp.where(good, new, old)
+            params = jax.tree.map(sel, params, state.params)
+            opt_state = jax.tree.map(sel, opt_state, state.opt_state)
+            new_metrics = jax.tree.map(sel, new_metrics, metrics)
+            loss = jnp.where(good, loss, jnp.nan)
         new_state = TrainState(params, opt_state, rng, state.step + 1)
-        return new_state, metrics, loss, jnp.sum(weights)
+        return new_state, new_metrics, loss, jnp.sum(weights)
 
     return train_step
 
@@ -199,11 +226,20 @@ def _weighted_mean(losses: list, wsums: list) -> float:
     """Per-example mean over the epoch: per-batch means re-weighted by their
     real (masked-in) example counts, matching the reference's batch_size-
     weighted Lightning loss logging (``base_module.py:139-146``). The greedy
-    packer emits a ragged final batch, so an unweighted mean would be biased."""
-    total_w = float(sum(float(w) for w in wsums))
+    packer emits a ragged final batch, so an unweighted mean would be biased.
+
+    Non-finite batch losses are excluded: a sentinel-skipped step reports
+    NaN by contract (no update was applied) and must not poison the epoch
+    mean."""
+    pairs = [
+        (float(l), float(w))
+        for l, w in zip(losses, wsums)
+        if math.isfinite(float(l))
+    ]
+    total_w = sum(w for _, w in pairs)
     if total_w == 0:
         return 0.0
-    return float(sum(float(l) * float(w) for l, w in zip(losses, wsums))) / total_w
+    return float(sum(l * w for l, w in pairs)) / total_w
 
 
 @dataclasses.dataclass
@@ -221,19 +257,28 @@ class Trainer:
     model: GGNN
     cfg: ExperimentConfig
     pos_weight: float | None = None
+    # divergence-rollback LR escalation state: the effective learning rate
+    # is optim.lr * lr_scale (see rescale_lr)
+    lr_scale: float = 1.0
 
     def __post_init__(self):
+        self._build()
+
+    def _build(self):
         o = self.cfg.optim
-        tx = optax.adamw(o.lr, weight_decay=o.weight_decay)
+        tx = optax.adamw(o.lr * self.lr_scale, weight_decay=o.weight_decay)
         if o.grad_clip:
             tx = optax.chain(optax.clip_by_global_norm(o.grad_clip), tx)
         self.optimizer = tx
+        res = getattr(self.cfg, "resilience", None)
+        sentinel_guard = res.sentinel if res is not None else True
         self.train_step = make_train_step(
             self.model,
             self.optimizer,
             label_style=self.cfg.model.label_style,
             pos_weight=self.pos_weight if o.use_weighted_loss else None,
             undersample_node_on_loss_factor=o.undersample_node_on_loss_factor,
+            sentinel_guard=sentinel_guard,
         )
         self.eval_step = make_eval_step(
             self.model,
@@ -265,12 +310,23 @@ class Trainer:
                 label_style=self.cfg.model.label_style,
                 pos_weight=self.pos_weight if o.use_weighted_loss else None,
                 undersample_node_on_loss_factor=o.undersample_node_on_loss_factor,
+                sentinel_guard=sentinel_guard,
             )
             self.fallback_eval_step = make_eval_step(
                 seg_twin,
                 label_style=self.cfg.model.label_style,
                 pos_weight=self.pos_weight if o.use_weighted_loss else None,
             )
+
+    def rescale_lr(self, factor: float) -> float:
+        """Divergence-rollback escalation: rebuild the optimizer and every
+        jitted step at ``optim.lr * lr_scale * factor``. adamw's state tree
+        is LR-independent (the rate only scales the applied update), so a
+        checkpointed/restored opt_state remains valid under the rescaled
+        optimizer. Returns the new cumulative scale."""
+        self.lr_scale *= float(factor)
+        self._build()
+        return self.lr_scale
 
     def steps_for(self, batch) -> tuple[Callable, Callable]:
         """(train_step, eval_step) for this batch's layout."""
@@ -320,16 +376,43 @@ class Trainer:
         )
 
     def train_epoch(
-        self, state: TrainState, batches: Iterable[BatchedGraphs]
+        self,
+        state: TrainState,
+        batches: Iterable[BatchedGraphs],
+        sentinel=None,
     ) -> tuple[TrainState, dict[str, float], float]:
+        """One pass. ``sentinel``: an optional
+        :class:`~deepdfa_tpu.resilience.sentinel.DivergenceSentinel`
+        observing every per-step loss — it raises ``DivergenceError`` after
+        ``patience`` consecutive skipped (non-finite) steps so the caller
+        can roll back to the last good checkpoint. The ``step.nan_grads``
+        fault point poisons selected steps' gradients via the step's
+        ``loss_scale`` argument (chaos battery)."""
         metrics = ConfusionState.zeros()
         losses, wsums = [], []
-        for batch in self._stream(batches):
-            batch = jax.tree.map(jnp.asarray, batch)
-            step, _ = self.steps_for(batch)
-            state, metrics, loss, wsum = step(state, batch, metrics)
-            losses.append(loss)
-            wsums.append(wsum)
+        nan_armed = faults.active("step.nan_grads")
+        stream = self._stream(batches)
+        try:
+            for batch in stream:
+                batch = jax.tree.map(jnp.asarray, batch)
+                step, _ = self.steps_for(batch)
+                if nan_armed and faults.fire("step.nan_grads"):
+                    state, metrics, loss, wsum = step(
+                        state, batch, metrics, float("nan")
+                    )
+                else:
+                    state, metrics, loss, wsum = step(state, batch, metrics)
+                if sentinel is not None:
+                    sentinel.observe(loss)
+                losses.append(loss)
+                wsums.append(wsum)
+            if sentinel is not None:
+                sentinel.flush()
+        finally:
+            # deterministic producer shutdown even when the sentinel raises
+            # mid-epoch (prefetch_to_device joins its thread on close)
+            if hasattr(stream, "close"):
+                stream.close()
         return state, compute_metrics(metrics, "train_"), _weighted_mean(losses, wsums)
 
     def evaluate(

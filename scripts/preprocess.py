@@ -81,24 +81,28 @@ def _extract_with_joern(records: list[dict], dataset: str):
     query script (``cpg/queries/export_func_graph.sc``), and the artifacts are
     read back with :func:`deepdfa_tpu.cpg.joern.load_cpg`.
 
-    Parallel scale-out = one :class:`JoernSession` per worker id (sessions use
-    private ``workers/{id}`` workspaces); kept sequential here because the
-    JVM spin-up dominates only once per corpus. Returns ``(cpgs, failures,
-    parse_after)`` where ``parse_after`` extracts an after-patch CPG for the
-    statement labeler through the same session."""
+    The session is driven through an :class:`ExtractionSupervisor`: a REPL
+    that hangs or dies mid-function is restarted (spawn retried with
+    backoff) and the function retried on the fresh session; a function that
+    keeps killing sessions is quarantined — one failure row, the build
+    continues. Returns ``(cpgs, failures, parse_after, supervisor)`` where
+    ``parse_after`` extracts an after-patch CPG for the statement labeler
+    through the same supervised session and ``supervisor.report()`` feeds
+    the ingest summary/quarantine file."""
     import hashlib
 
     from deepdfa_tpu import utils
     from deepdfa_tpu.cpg.joern import load_cpg
     from deepdfa_tpu.cpg.joern_session import JoernSession
+    from deepdfa_tpu.resilience import ExtractionSupervisor, QuarantinedError
 
     src_dir = utils.get_dir(utils.processed_dir() / dataset / "before")
     after_dir = utils.get_dir(utils.processed_dir() / dataset / "after")
-    session = JoernSession(worker_id=0)
+    supervisor = ExtractionSupervisor(lambda: JoernSession(worker_id=0))
     cpgs: dict[int, object] = {}
     failures: list[str] = []
 
-    def _export_and_load(c_path: Path):
+    def _export_and_load(session, c_path: Path):
         stem = str(c_path)
         if not (Path(stem + ".nodes.json").exists() and Path(stem + ".edges.json").exists()):
             session.run_script("export_func_graph", {"filename": stem})
@@ -114,11 +118,15 @@ def _extract_with_joern(records: list[dict], dataset: str):
             if not c_path.exists():
                 c_path.write_text(str(row["before"]))
             try:
-                cpgs[fid] = _export_and_load(c_path)
+                cpgs[fid] = supervisor.run(
+                    fid, lambda s, p=c_path: _export_and_load(s, p)
+                )
+            except QuarantinedError as exc:
+                failures.append(f"{fid}\tQuarantined: {exc.reason}")
             except Exception as exc:  # noqa: BLE001 — failure-file protocol
                 failures.append(f"{fid}\t{type(exc).__name__}: {exc}")
     except BaseException:
-        session.close()
+        supervisor.close()
         raise
 
     def parse_after(source: str):
@@ -126,9 +134,11 @@ def _extract_with_joern(records: list[dict], dataset: str):
         c_path = after_dir / f"{digest}.c"
         if not c_path.exists():
             c_path.write_text(source)
-        return _export_and_load(c_path)
+        return supervisor.run(
+            f"after:{digest}", lambda s: _export_and_load(s, c_path)
+        )
 
-    return cpgs, failures, parse_after, session
+    return cpgs, failures, parse_after, supervisor
 
 
 def main(argv=None) -> dict:
@@ -218,9 +228,9 @@ def main(argv=None) -> dict:
     # pickle cache makes interrupted runs resume where they stopped)
     records = df.to_dict("records")
     parse_after = parse_source
-    joern_session = None
+    supervisor = None
     if args.frontend == "joern":
-        cpgs, failures, parse_after, joern_session = _extract_with_joern(
+        cpgs, failures, parse_after, supervisor = _extract_with_joern(
             records, args.dataset
         )
     else:
@@ -286,8 +296,8 @@ def main(argv=None) -> dict:
                 for fid in cpgs
             }
     finally:  # the session is a JVM — never leak it past the labeling stage
-        if joern_session is not None:
-            joern_session.close()
+        if supervisor is not None:
+            supervisor.close()
 
     # 4. split: seeded random 70/10/20, the dataset's fixed protocol split,
     # or a named (cross-project fold) split file — the choice defines the
@@ -354,6 +364,16 @@ def main(argv=None) -> dict:
     }
     if validation is not None:
         summary["validation"] = validation
+    if supervisor is not None:
+        from deepdfa_tpu.data.ingest import write_quarantine
+
+        report = supervisor.report()
+        summary["extraction"] = {
+            "restarts": report["restarts"],
+            "quarantined": len(report["quarantined"]),
+        }
+        if report["quarantined"]:
+            summary["quarantine_file"] = str(write_quarantine(out_dir, report))
     if args.dataflow_families:
         summary["dataflow_families"] = True
     print(json.dumps(summary))

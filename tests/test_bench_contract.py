@@ -687,3 +687,25 @@ def test_round_end_replay_from_repo_artifacts():
     assert out["value"] and out["value"] > 1000
     assert out["vs_baseline"] is not None
     assert out["replayed_from_banked"]
+
+
+# ---------------------------------------------------------------------------
+# sentinel-overhead guard (resilience invariant: guard < 2% of a step)
+
+
+@pytest.mark.faults
+def test_sentinel_overhead_pct_math():
+    assert bench.sentinel_overhead_pct(1.0, 1.015) == pytest.approx(1.5)
+    assert bench.sentinel_overhead_pct(2.0, 2.0) == 0.0
+    # guard measured FASTER than plain = timing noise, reported negative
+    assert bench.sentinel_overhead_pct(1.0, 0.99) == pytest.approx(-1.0)
+    with pytest.raises(ValueError):
+        bench.sentinel_overhead_pct(0.0, 1.0)
+
+
+@pytest.mark.faults
+def test_sentinel_guard_budget():
+    assert bench.sentinel_guard_ok(1.99)
+    assert bench.sentinel_guard_ok(-3.0)
+    assert not bench.sentinel_guard_ok(2.01)
+    assert bench.sentinel_guard_ok(4.9, budget=5.0)

@@ -144,6 +144,101 @@ def test_encoder_partial_load_and_freeze():
                 assert float(np.abs(np.asarray(leaf)).max()) == 0
 
 
+# ---------------------------------------------------------------------------
+# crash-safe commit + resume (resilience layer)
+
+
+@pytest.mark.faults
+def test_scan_garbage_collects_partial_checkpoints(tmp_path):
+    """A crash mid-commit leaves a *.tmp dir (atomic path) or a markerless
+    step dir (pre-atomic). Both must be GC'd, never shadow good steps."""
+    mgr = CheckpointManager(tmp_path, CheckpointConfig())
+    mgr.save(1, _state(1.0), {"val_loss": 0.5}, epoch=1)
+
+    # simulate the two partial-write shapes
+    (tmp_path / "00000002.tmp" / "state").mkdir(parents=True)
+    (tmp_path / "00000003").mkdir()  # step-shaped, no meta.json commit marker
+    (tmp_path / "00000003" / "junk.bin").write_bytes(b"\x00")
+
+    mgr2 = CheckpointManager(tmp_path, CheckpointConfig())
+    assert mgr2.steps == [1]
+    assert not (tmp_path / "00000002.tmp").exists()
+    assert not (tmp_path / "00000003").exists()
+    restored = mgr2.restore_latest()
+    assert float(np.asarray(restored["params"]["dense"]["kernel"])[0, 0]) == 1.0
+
+
+@pytest.mark.faults
+def test_save_commit_is_rename_only(tmp_path):
+    """After save() the final dir holds state + meta.json and no sideways
+    .tmp remains — the commit is one os.replace."""
+    mgr = CheckpointManager(tmp_path, CheckpointConfig())
+    mgr.save(7, _state(7.0), {"val_loss": 0.1}, epoch=7)
+    step_dir = tmp_path / "00000007"
+    assert (step_dir / "meta.json").exists()
+    assert (step_dir / "state").exists()
+    assert list(tmp_path.glob("*.tmp")) == []
+
+
+@pytest.mark.faults
+def test_aux_payload_roundtrip(tmp_path):
+    mgr = CheckpointManager(tmp_path, CheckpointConfig())
+    aux = {"opt_state": {"mu": jnp.arange(3.0)}, "step": jnp.asarray(9)}
+    mgr.save(9, _state(9.0), {"val_loss": 0.3}, epoch=9, aux=aux)
+    out = mgr.restore_aux(9, template=aux)
+    np.testing.assert_array_equal(np.asarray(out["opt_state"]["mu"]),
+                                  np.asarray(aux["opt_state"]["mu"]))
+    assert int(np.asarray(out["step"])) == 9
+    # a step saved WITHOUT aux refuses restore_aux loudly
+    mgr.save(10, _state(10.0), {"val_loss": 0.2}, epoch=10)
+    with pytest.raises(FileNotFoundError, match="no aux payload"):
+        mgr.restore_aux(10)
+
+
+@pytest.mark.faults
+def test_restore_resume_walks_past_corrupt_newest(tmp_path):
+    """A corrupted newest checkpoint costs one step of progress, not the
+    run: restore_resume falls back to the previous restorable step."""
+    import shutil
+
+    mgr = CheckpointManager(tmp_path, CheckpointConfig(keep=3))
+    aux = {"step": jnp.asarray(0)}
+    for step in (1, 2, 3):
+        mgr.save(step, _state(float(step)), {"val_loss": 1.0 / step},
+                 epoch=step, aux={"step": jnp.asarray(step)})
+    # corrupt the newest payload but keep its commit marker
+    shutil.rmtree(tmp_path / "00000003" / "state")
+    step, meta, payload, raux = mgr.restore_resume(
+        template=_state(0.0), aux_template=aux
+    )
+    assert step == 2 and meta["epoch"] == 2
+    assert float(np.asarray(payload["params"]["dense"]["kernel"])[0, 0]) == 2.0
+    assert int(np.asarray(raux["step"])) == 2
+
+
+@pytest.mark.faults
+def test_restore_resume_requires_aux_when_asked(tmp_path):
+    """Resume needs the full trainer state: a checkpoint without aux is
+    skipped when an aux_template is given, used when it is not."""
+    mgr = CheckpointManager(tmp_path, CheckpointConfig())
+    mgr.save(1, _state(1.0), {"val_loss": 0.5}, epoch=1,
+             aux={"step": jnp.asarray(1)})
+    mgr.save(2, _state(2.0), {"val_loss": 0.4}, epoch=2)  # no aux
+    step, _, _, raux = mgr.restore_resume(
+        template=_state(0.0), aux_template={"step": jnp.asarray(0)}
+    )
+    assert step == 1 and int(np.asarray(raux["step"])) == 1
+    # without aux_template the newest wins
+    step2, _, _, no_aux = mgr.restore_resume(template=_state(0.0))
+    assert step2 == 2 and no_aux is None
+
+
+@pytest.mark.faults
+def test_restore_resume_empty_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no restorable checkpoint"):
+        CheckpointManager(tmp_path, CheckpointConfig()).restore_resume()
+
+
 def test_resave_same_step_replaces_bookkeeping(tmp_path):
     """Saving the same step twice (a resumed run re-hitting its save point)
     replaces the entry — steps stay unique, retention counts stay right."""

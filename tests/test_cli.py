@@ -216,7 +216,7 @@ def test_golden_configs_load():
 def test_crash_renames_log(storage, tmp_path, monkeypatch):
     run_dir = tmp_path / "run"
 
-    def boom(cfg, rd):
+    def boom(cfg, rd, **kw):
         raise RuntimeError("injected")
 
     monkeypatch.setattr(cli, "fit", boom)

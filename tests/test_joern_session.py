@@ -124,6 +124,106 @@ def test_session_timeout_names_buffer(fake_joern, tmp_path):
         sess.close()
 
 
+@pytest.mark.faults
+def test_timeout_attaches_partial_buffer(fake_joern, tmp_path):
+    """JoernTimeout.partial carries the FULL pre-timeout buffer (the message
+    keeps only the tail) — what quarantine entries record as the hang's
+    evidence."""
+    from deepdfa_tpu.cpg.joern_session import JoernTimeout
+
+    sess = JoernSession(cwd=tmp_path, timeout=20)
+    try:
+        sess.proc.stdin.write("exit\n")  # output without a prompt
+        sess.proc.stdin.flush()
+        with pytest.raises(JoernTimeout) as exc_info:
+            sess.read_until_prompt(timeout=1.0)
+        err = exc_info.value
+        assert isinstance(err, TimeoutError)  # callers' except clauses hold
+        assert "really exit?" in err.partial
+    finally:
+        sess.close()
+
+
+@pytest.mark.faults
+def test_die_fault_surfaces_as_repl_death(fake_joern, tmp_path):
+    from deepdfa_tpu.resilience import faults
+
+    sess = JoernSession(cwd=tmp_path, timeout=20)
+    try:
+        with faults.installed("joern.die@1"):
+            with pytest.raises(RuntimeError, match="exited unexpectedly"):
+                sess.run_command("workspace")
+    finally:
+        sess.close()
+
+
+@pytest.mark.faults
+def test_hang_fault_swallows_command_into_timeout(fake_joern, tmp_path):
+    from deepdfa_tpu.cpg.joern_session import JoernTimeout
+    from deepdfa_tpu.resilience import faults
+
+    sess = JoernSession(cwd=tmp_path, timeout=20)
+    try:
+        with faults.installed("joern.hang@1"):
+            with pytest.raises(JoernTimeout):
+                sess.run_command("workspace", timeout=1.0)
+        # next command (fault spent) re-syncs on the same prompt
+        assert sess.run_command("ping") == "ack:ping"
+    finally:
+        sess.close()
+
+
+@pytest.mark.faults
+def test_supervisor_restarts_real_session_after_death(fake_joern, tmp_path):
+    """ExtractionSupervisor over REAL JoernSessions: joern.die kills the
+    JVM mid-command; the supervisor spawns a fresh one and the item
+    succeeds on retry."""
+    from deepdfa_tpu.resilience import ExtractionSupervisor, faults
+
+    sup = ExtractionSupervisor(
+        lambda: JoernSession(cwd=tmp_path, timeout=20), sleep=lambda _s: None
+    )
+    try:
+        with faults.installed("joern.die@1"):
+            out = sup.run("f1", lambda s: s.run_command("extract f1"))
+        assert out == "ack:extract f1"
+        assert sup.restarts == 1
+        assert sup.report()["quarantined"] == []
+    finally:
+        sup.close()
+
+
+@pytest.mark.faults
+def test_supervisor_quarantines_repeat_hangs(fake_joern, tmp_path):
+    """A function that hangs the REPL on every attempt lands on the
+    quarantine list; the next function proceeds on a fresh session."""
+    from deepdfa_tpu.resilience import (
+        ExtractionSupervisor,
+        QuarantinedError,
+        faults,
+    )
+
+    sup = ExtractionSupervisor(
+        lambda: JoernSession(cwd=tmp_path, timeout=20),
+        attempts_per_item=2,
+        sleep=lambda _s: None,
+    )
+    try:
+        with faults.installed("joern.hang@1,2"):
+            with pytest.raises(QuarantinedError):
+                sup.run(
+                    "poison", lambda s: s.run_command("extract poison", timeout=0.5)
+                )
+            out = sup.run("good", lambda s: s.run_command("extract good"))
+        assert out == "ack:extract good"
+        report = sup.report()
+        assert [e["key"] for e in report["quarantined"]] == ["poison"]
+        assert "no joern prompt" in report["quarantined"][0]["error"]
+        assert report["restarts"] == 2
+    finally:
+        sup.close()
+
+
 # ---------------------------------------------------------------------------
 # real-joern integration contract (runs only where a joern install exists)
 

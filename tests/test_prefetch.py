@@ -63,6 +63,52 @@ def test_size_zero_passthrough():
     assert len(out) == 2 and isinstance(out[0], np.ndarray)
 
 
+def _producer_threads():
+    import threading
+
+    return [
+        t for t in threading.enumerate()
+        if t.name == "prefetch_to_device" and t.is_alive()
+    ]
+
+
+@pytest.mark.faults
+def test_abandoned_iterator_joins_producer_thread():
+    """Regression: breaking out of the consumer loop used to leave the
+    producer thread (and its staged device batches) alive for process
+    lifetime — the finally now joins it with a timeout."""
+    items = ({"x": np.full((4,), i, np.float32)} for i in range(100))
+    it = prefetch_to_device(items, size=2)
+    next(it)
+    it.close()  # the abandonment path: GeneratorExit through the finally
+    assert _producer_threads() == []
+
+
+@pytest.mark.faults
+def test_break_mid_stream_joins_producer_thread():
+    for item in prefetch_to_device(
+        ({"x": np.zeros(2, np.float32)} for _ in range(50)), size=2
+    ):
+        break  # consumer walks away; refcount closes the generator
+    assert _producer_threads() == []
+
+
+@pytest.mark.faults
+def test_producer_raises_fault_surfaces_and_joins():
+    """The prefetch.producer_raises chaos point: the injected error must
+    surface at the consumer's next() — never hang — and the thread must be
+    joined afterwards."""
+    from deepdfa_tpu.resilience import faults
+
+    items = [{"x": np.zeros(2, np.float32)} for _ in range(5)]
+    with faults.installed("prefetch.producer_raises@2"):
+        it = prefetch_to_device(iter(items), size=2)
+        next(it)  # item 1 passes (fault arms on hit 2)
+        with pytest.raises(faults.InjectedFault, match="prefetch.producer_raises"):
+            list(it)
+    assert _producer_threads() == []
+
+
 def test_batched_graphs_roundtrip_structure():
     """BatchedGraphs (NamedTuple) survives device_put with structure intact
     (the Trainer's steps_for dispatch reads hasattr node_gidx)."""

@@ -1,0 +1,388 @@
+"""Unit tests for the fault-tolerance layer (deepdfa_tpu/resilience/):
+fault-point determinism, retry backoff under a virtual clock, journal
+atomicity, divergence-sentinel state machine, and the extraction
+supervisor's restart/quarantine protocol against fake sessions."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.resilience import (
+    DivergenceError,
+    DivergenceSentinel,
+    ExtractionSupervisor,
+    QuarantinedError,
+    RetryExhausted,
+    RetryPolicy,
+    RunJournal,
+    faults,
+    retry_call,
+)
+from deepdfa_tpu.resilience.faults import FaultSpec, parse_spec
+from deepdfa_tpu.resilience.journal import atomic_write_text
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------------
+# fault points
+
+
+def test_parse_spec_grammar():
+    specs = parse_spec(
+        "ckpt.crash_between_state_and_meta@2;"
+        "step.nan_grads@3,4,5;"
+        "joern.hang:p=0.25:seed=7:max=2;"
+        "prefetch.producer_raises"
+    )
+    assert specs["ckpt.crash_between_state_and_meta"].at == (2,)
+    assert specs["step.nan_grads"].at == (3, 4, 5)
+    hang = specs["joern.hang"]
+    assert hang.prob == 0.25 and hang.seed == 7 and hang.max_fires == 2
+    assert specs["prefetch.producer_raises"].decide(999)
+
+
+def test_parse_spec_rejects_unknown_option():
+    with pytest.raises(ValueError, match="unknown fault option"):
+        parse_spec("joern.hang:frequency=2")
+
+
+def test_fault_schedule_is_seed_deterministic():
+    """Whether hit n fires is a pure function of (seed, point, n) — the
+    same spec replays the same schedule, different seeds differ."""
+    a = FaultSpec("joern.hang", prob=0.3, seed=1).schedule(200)
+    b = FaultSpec("joern.hang", prob=0.3, seed=1).schedule(200)
+    c = FaultSpec("joern.hang", prob=0.3, seed=2).schedule(200)
+    assert a == b
+    assert a != c
+    assert 20 < sum(a) < 120  # Bernoulli(0.3) over 200: loose sanity band
+
+
+def test_registry_matches_pure_schedule():
+    spec = FaultSpec("joern.die", prob=0.4, seed=5, max_fires=3)
+    with faults.installed({"joern.die": spec}):
+        live = [faults.fire("joern.die") for _ in range(50)]
+    assert live == spec.schedule(50)
+    assert sum(live) == 3  # max_fires cap honoured
+
+
+def test_at_indices_fire_exactly_and_counters_track():
+    with faults.installed("step.nan_grads@2,4"):
+        fired = [faults.fire("step.nan_grads") for _ in range(5)]
+        counts = faults.counters()
+    assert fired == [False, True, False, True, False]
+    assert counts["hits"]["step.nan_grads"] == 5
+    assert counts["fires"]["step.nan_grads"] == 2
+
+
+def test_disarmed_point_never_fires_and_raise_if():
+    faults.clear()
+    assert not faults.fire("joern.hang")
+    assert not faults.active("joern.hang")
+    with faults.installed("prefetch.producer_raises@1"):
+        with pytest.raises(faults.InjectedFault, match="prefetch.producer_raises"):
+            faults.raise_if("prefetch.producer_raises")
+        faults.raise_if("prefetch.producer_raises")  # hit 2: no fire
+
+
+def test_installed_restores_previous_arming():
+    faults.install("joern.hang@1")
+    try:
+        with faults.installed("joern.die@1"):
+            assert faults.active("joern.die") and not faults.active("joern.hang")
+        assert faults.active("joern.hang") and not faults.active("joern.die")
+    finally:
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# retry
+
+
+def _virtual_clock():
+    state = {"t": 0.0}
+
+    def sleep(s):
+        state["t"] += s
+
+    def clock():
+        return state["t"]
+
+    return state, sleep, clock
+
+
+def test_retry_succeeds_after_failures():
+    state, sleep, clock = _virtual_clock()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("pipe")
+        return "ok"
+
+    out = retry_call(
+        flaky, RetryPolicy(attempts=4, base_delay=1.0, jitter=0.0),
+        retry_on=(OSError,), sleep=sleep, clock=clock,
+    )
+    assert out == "ok" and calls["n"] == 3
+    assert state["t"] == pytest.approx(1.0 + 2.0)  # exponential backoff
+
+
+def test_retry_exhausted_carries_cause():
+    _, sleep, clock = _virtual_clock()
+    with pytest.raises(RetryExhausted) as exc_info:
+        retry_call(
+            lambda: (_ for _ in ()).throw(TimeoutError("hang")),
+            RetryPolicy(attempts=2, base_delay=0.1, jitter=0.0),
+            sleep=sleep, clock=clock,
+        )
+    err = exc_info.value
+    assert err.attempts == 2
+    assert isinstance(err.last, TimeoutError)
+    assert isinstance(err.__cause__, TimeoutError)
+
+
+def test_retry_deadline_stops_early():
+    state, sleep, clock = _virtual_clock()
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(RetryExhausted):
+        retry_call(
+            always_fails,
+            RetryPolicy(attempts=10, base_delay=5.0, multiplier=1.0,
+                        jitter=0.0, deadline=12.0),
+            sleep=sleep, clock=clock,
+        )
+    # 5s + 5s sleeps fit in 12s; the third sleep would blow the deadline
+    assert calls["n"] == 3
+    assert state["t"] == pytest.approx(10.0)
+
+
+def test_retry_jitter_is_deterministic_and_bounded():
+    p = RetryPolicy(attempts=3, base_delay=2.0, jitter=0.25)
+    d1 = [p.delay(n, seed=9) for n in (1, 2, 3)]
+    d2 = [p.delay(n, seed=9) for n in (1, 2, 3)]
+    assert d1 == d2
+    for n, d in zip((1, 2, 3), d1):
+        raw = min(2.0 * 2.0 ** (n - 1), p.max_delay)
+        assert raw * 0.75 <= d <= raw * 1.25
+
+
+def test_non_retryable_exception_propagates():
+    _, sleep, clock = _virtual_clock()
+    with pytest.raises(ValueError):
+        retry_call(
+            lambda: (_ for _ in ()).throw(ValueError("bad artifact")),
+            RetryPolicy(attempts=5), retry_on=(OSError,),
+            sleep=sleep, clock=clock,
+        )
+
+
+# ---------------------------------------------------------------------------
+# journal
+
+
+def test_journal_roundtrip_and_overwrite(tmp_path):
+    j = RunJournal(tmp_path / "journal.json")
+    assert j.read() is None
+    j.write(epoch=0, global_step=10, lr_scale=1.0)
+    j.write(epoch=1, global_step=20, lr_scale=0.5)
+    rec = j.read()
+    assert rec["epoch"] == 1 and rec["global_step"] == 20
+    assert rec["schema"] == RunJournal.SCHEMA
+    # no sideways tmp left behind
+    assert list(tmp_path.glob("*.tmp")) == []
+
+
+def test_journal_corrupt_reads_as_fresh(tmp_path):
+    path = tmp_path / "journal.json"
+    path.write_text('{"epoch": 3, "trunc')  # torn write from a non-atomic era
+    assert RunJournal(path).read() is None
+
+
+def test_atomic_write_replaces_not_appends(tmp_path):
+    path = tmp_path / "f.json"
+    atomic_write_text(path, json.dumps({"a": 1}))
+    atomic_write_text(path, json.dumps({"b": 2}))
+    assert json.loads(path.read_text()) == {"b": 2}
+
+
+# ---------------------------------------------------------------------------
+# divergence sentinel
+
+
+def test_sentinel_raises_after_patience_consecutive():
+    s = DivergenceSentinel(patience=3, lag=0)
+    for _ in range(5):
+        s.observe(1.0)
+    s.observe(float("nan"))
+    s.observe(float("nan"))
+    with pytest.raises(DivergenceError) as exc_info:
+        s.observe(float("nan"))
+    assert exc_info.value.consecutive == 3
+    assert s.stats() == {"sentinel_steps": 8, "sentinel_bad_steps": 3}
+
+
+def test_sentinel_good_step_resets_consecutive():
+    s = DivergenceSentinel(patience=2, lag=0)
+    s.observe(float("nan"))
+    s.observe(0.5)  # breaks the run
+    s.observe(float("nan"))
+    assert s.consecutive == 1
+    assert s.n_bad == 2
+
+
+def test_sentinel_lag_defers_and_flush_drains():
+    s = DivergenceSentinel(patience=1, lag=2)
+    s.observe(float("inf"))  # buffered, not yet checked
+    s.observe(1.0)
+    assert s.n_steps == 0
+    with pytest.raises(DivergenceError):
+        s.flush()
+
+
+def test_sentinel_reset_clears_run_keeps_totals():
+    s = DivergenceSentinel(patience=2, lag=0)
+    s.observe(float("nan"))
+    s.reset()
+    assert s.consecutive == 0 and s.n_bad == 1
+    s.observe(float("nan"))  # patience not hit: run restarted clean
+    assert s.consecutive == 1
+
+
+def test_sentinel_accepts_numpy_scalars():
+    s = DivergenceSentinel(patience=1, lag=0)
+    s.observe(np.float32(0.25))
+    with pytest.raises(DivergenceError):
+        s.observe(np.float32("nan"))
+
+
+# ---------------------------------------------------------------------------
+# extraction supervisor (fake sessions — no JVM, no subprocess)
+
+
+class _FakeSession:
+    """Scripted session: ``plan`` maps item key → list of outcomes per
+    attempt; an Exception instance is raised, anything else returned."""
+
+    def __init__(self, plan, log):
+        self.plan = plan
+        self.log = log
+        self.closed = False
+
+    def extract(self, key):
+        outcomes = self.plan.setdefault(key, ["ok"])
+        out = outcomes.pop(0) if len(outcomes) > 1 else outcomes[0]
+        self.log.append((id(self), key, out))
+        if isinstance(out, BaseException):
+            raise out
+        return out
+
+    def close(self):
+        self.closed = True
+
+
+def _supervisor(plan, spawn_failures=0):
+    log: list = []
+    sessions: list = []
+    state = {"spawn_left": spawn_failures}
+
+    def factory():
+        if state["spawn_left"] > 0:
+            state["spawn_left"] -= 1
+            raise RuntimeError("jvm refused to start")
+        s = _FakeSession(plan, log)
+        sessions.append(s)
+        return s
+
+    sup = ExtractionSupervisor(
+        factory,
+        spawn_policy=RetryPolicy(attempts=3, base_delay=0.0, jitter=0.0),
+        attempts_per_item=2,
+        sleep=lambda _s: None,
+    )
+    return sup, sessions, log
+
+
+def test_supervisor_restarts_dead_session_and_retries_item():
+    plan = {"f1": [TimeoutError("no joern prompt; hung"), "cpg1"]}
+    sup, sessions, _log = _supervisor(plan)
+    assert sup.run("f1", lambda s: s.extract("f1")) == "cpg1"
+    assert sup.restarts == 1
+    assert len(sessions) == 2  # fresh session for the retry
+    assert sessions[0].closed  # dead one was torn down
+    assert sup.report() == {"restarts": 1, "quarantined": []}
+
+
+def test_supervisor_quarantines_poison_item_and_continues():
+    err = TimeoutError("no joern prompt")
+    err.partial = "x" * 600 + "TAIL"  # JoernTimeout carries the REPL buffer
+    plan = {"poison": [err, TimeoutError("again"), "never"], "good": ["cpg"]}
+    sup, _sessions, _log = _supervisor(plan)
+    with pytest.raises(QuarantinedError) as exc_info:
+        sup.run("poison", lambda s: s.extract("poison"))
+    assert exc_info.value.key == "poison"
+    # the build continues: the next item succeeds on the replacement session
+    assert sup.run("good", lambda s: s.extract("good")) == "cpg"
+    report = sup.report()
+    assert len(report["quarantined"]) == 1
+    entry = report["quarantined"][0]
+    assert entry["key"] == "poison" and entry["attempts"] == 2
+    assert entry["partial"].endswith("TAIL") and len(entry["partial"]) == 500
+
+
+def test_supervisor_spawn_retries_then_gives_up():
+    # 2 spawn failures, 3 spawn attempts → third succeeds
+    sup, sessions, _ = _supervisor({"f": ["ok"]}, spawn_failures=2)
+    assert sup.run("f", lambda s: s.extract("f")) == "ok"
+    assert len(sessions) == 1
+
+    # more failures than spawn attempts → quarantine without item retries
+    sup2, sessions2, _ = _supervisor({"f": ["ok"]}, spawn_failures=99)
+    with pytest.raises(QuarantinedError, match="retry exhausted"):
+        sup2.run("f", lambda s: s.extract("f"))
+    assert sessions2 == []
+
+
+def test_supervisor_item_error_propagates_unwrapped():
+    """ValueError is the caller's failure-file protocol, not a session
+    fault — no restart, no quarantine."""
+    plan = {"bad": [ValueError("malformed artifact")]}
+    sup, sessions, _ = _supervisor(plan)
+    with pytest.raises(ValueError, match="malformed artifact"):
+        sup.run("bad", lambda s: s.extract("bad"))
+    assert sup.restarts == 0 and sup.report()["quarantined"] == []
+    assert len(sessions) == 1 and not sessions[0].closed
+
+
+def test_supervisor_context_manager_closes():
+    plan = {"f": ["ok"]}
+    sup, sessions, _ = _supervisor(plan)
+    with sup:
+        sup.run("f", lambda s: s.extract("f"))
+    assert sessions[0].closed
+
+
+# ---------------------------------------------------------------------------
+# quarantine report persistence (data/ingest.py)
+
+
+def test_quarantine_report_roundtrip(tmp_path):
+    from deepdfa_tpu.data.ingest import read_quarantine, write_quarantine
+
+    report = {"restarts": 2, "quarantined": [
+        {"key": 7, "attempts": 2, "error": "TimeoutError: no joern prompt"}
+    ]}
+    path = write_quarantine(tmp_path, report)
+    assert path.name == "quarantine.json"
+    assert read_quarantine(tmp_path) == report
+    # absent file reads as the empty report
+    assert read_quarantine(tmp_path / "nowhere") == {
+        "restarts": 0, "quarantined": []
+    }
