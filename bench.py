@@ -489,6 +489,52 @@ def sentinel_guard_ok(pct: float, budget: float = 2.0) -> bool:
     return pct <= budget
 
 
+SERVE_MIN_OCCUPANCY = 0.5
+
+
+def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
+                          p99_ms, mean_batch_occupancy, cache_hit_rate,
+                          cache_hits, requests_total, errors_total,
+                          concurrency=None, notes=None):
+    """ONE-line artifact for the serving stage (scripts/bench_serving.py).
+
+    Shared between the load generator and the bench-contract test so the
+    schema is asserted without standing up a server. ``ok`` encodes the
+    serving acceptance gates: every request answered, batches at least
+    half-full on average (the micro-batcher actually coalesced — a 1-deep
+    "batch" per request would pass a pure throughput check), and the
+    repeated-corpus phase produced real cache hits (asserted via the hit
+    COUNTER, not timing)."""
+    ok = (requests_total > 0 and errors_total == 0
+          and requests_per_sec > 0
+          and mean_batch_occupancy is not None
+          and mean_batch_occupancy >= SERVE_MIN_OCCUPANCY
+          and cache_hits > 0)
+    return {
+        "metric": "serve_requests_per_sec",
+        "value": round(float(requests_per_sec), 2),
+        "unit": "req/s",
+        "vs_baseline": None,
+        "backend": backend,
+        "device_kind": device_kind,
+        "p50_ms": None if p50_ms is None else round(float(p50_ms), 3),
+        "p99_ms": None if p99_ms is None else round(float(p99_ms), 3),
+        "mean_batch_occupancy": (
+            None if mean_batch_occupancy is None
+            else round(float(mean_batch_occupancy), 4)),
+        "min_occupancy": SERVE_MIN_OCCUPANCY,
+        "cache_hit_rate": (
+            None if cache_hit_rate is None
+            else round(float(cache_hit_rate), 4)),
+        "cache_hits": int(cache_hits),
+        "requests_total": int(requests_total),
+        "errors_total": int(errors_total),
+        "concurrency": concurrency,
+        "notes": notes or {},
+        "ok": ok,
+    }
+
+
 def bench_sentinel_overhead(batches, steps: int = 20, dtype: str = "bfloat16",
                             repeats: int = 3):
     """Median train-step time with the divergence-sentinel guard compiled in

@@ -253,6 +253,34 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Online scoring service knobs (``deepdfa_tpu/serve``; CLI:
+    ``--set serve.*``): the micro-batching window, admission control, the
+    content-addressed scan cache, and the HTTP endpoint."""
+
+    host: str = "127.0.0.1"
+    port: int = 8341  # 0 = ephemeral (the bound port is reported at start)
+    max_batch: int = 16  # real graphs per dispatched micro-batch
+    max_wait_ms: float = 5.0  # batching window after the first queued request
+    max_queue: int = 128  # bounded request queue — beyond this, 503 backpressure
+    cache_entries: int = 4096  # scan-cache capacity (content-addressed LRU)
+    drain_timeout_s: float = 10.0  # graceful-shutdown budget for in-flight work
+    latency_window: int = 2048  # ring buffer behind the p50/p99 latency gauges
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        if self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        if self.cache_entries < 0:
+            raise ValueError("cache_entries must be >= 0")
+        if self.latency_window < 1:
+            raise ValueError("latency_window must be >= 1")
+
+
+@dataclass(frozen=True)
 class ExperimentConfig:
     data: DataConfig = field(default_factory=DataConfig)
     model: GGNNConfig = field(default_factory=GGNNConfig)
@@ -260,6 +288,7 @@ class ExperimentConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
     seed: int = 0
     run_name: str | None = None
     profile: bool = False
@@ -321,6 +350,7 @@ _NESTED: dict[tuple[str, str], type] = {
     ("ExperimentConfig", "mesh"): MeshConfig,
     ("ExperimentConfig", "checkpoint"): CheckpointConfig,
     ("ExperimentConfig", "resilience"): ResilienceConfig,
+    ("ExperimentConfig", "serve"): ServeConfig,
 }
 
 

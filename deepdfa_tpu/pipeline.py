@@ -1,0 +1,161 @@
+"""Source → CPG → encoded-graph pipeline shared by predict and serve.
+
+One canonical path from raw C text to model-ready :class:`Graph`s. The
+offline scan CLI (:mod:`deepdfa_tpu.predict`) and the online scoring
+service (:mod:`deepdfa_tpu.serve`) both call :func:`encode_source`, so
+the two surfaces cannot drift: the frontend, the dependence-edge pass,
+the training-vocabulary encoding (NEW code is encoded with the vocab the
+checkpoint was trained on — never a vocabulary rebuilt from the code
+being scanned), and the CFG node selection are decided HERE once.
+
+Also home to the content-addressing primitives the serve cache and the
+export manifest share: :func:`normalize_source`/:func:`source_key` (the
+scan-cache key) and :func:`vocab_content_hash` (the stale-artifact guard
+recorded in ``manifest.json``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from pathlib import Path
+
+from deepdfa_tpu.data.materialize import graph_from_cpg, select_cfg_nodes
+from deepdfa_tpu.data.vocab import Vocabulary
+
+__all__ = [
+    "EncodedFunction",
+    "load_vocabs",
+    "all_subkeys",
+    "encode_cpg",
+    "encode_source",
+    "normalize_source",
+    "source_key",
+    "vocab_content_hash",
+]
+
+
+def load_vocabs(shard_dir: Path | str) -> dict[str, Vocabulary]:
+    """The training vocabularies from a materialised shard dir.
+
+    Requires the full serialised form (``Vocabulary.to_dict``): the legacy
+    ``all_vocab``-only format cannot encode NEW code (UNKNOWN substitution
+    needs the subkey vocabs), so it is rejected with a re-preprocess hint
+    rather than silently mis-encoding every definition.
+    """
+    path = Path(shard_dir) / "vocab.json"
+    data = json.loads(path.read_text())
+    first = next(iter(data.values()), None)
+    if not isinstance(first, dict) or "subkey_vocabs" not in first:
+        raise ValueError(
+            f"{path} is the legacy all_vocab-only format and cannot encode "
+            "new source; re-run scripts/preprocess.py to write the full "
+            "vocabulary (cfg + subkey_vocabs + all_vocab)"
+        )
+    return {name: Vocabulary.from_dict(d) for name, d in data.items()}
+
+
+def all_subkeys(vocabs: dict[str, Vocabulary]) -> tuple[str, ...]:
+    """Union of subkeys across vocabs, in first-seen order. Stage-2 hashes
+    must cover every subkey ANY vocabulary reads — picking one vocab's
+    subkeys would make encoding depend on JSON key order (a single-subkey
+    vocab first ⇒ every other vocab silently degrades to UNKNOWN)."""
+    seen: dict[str, None] = {}
+    for voc in vocabs.values():
+        for sk in voc.cfg.subkeys:
+            seen.setdefault(sk)
+    return tuple(seen)
+
+
+def encode_cpg(cpg, gid: int, vocabs: dict[str, Vocabulary]):
+    """CPG → (Graph with training-vocab feature ids, CFG node-id order)."""
+    from deepdfa_tpu.cpg.features import extract_features, features_to_hashes
+
+    feats = extract_features(cpg, gid)
+    hashes: dict[int, str] = {}
+    if len(feats):
+        hash_df = features_to_hashes(feats, all_subkeys(vocabs))
+        hashes = {
+            int(r.node_id): r.hash for r in hash_df.itertuples(index=False)
+        }
+    feat_ids = {
+        name: {n: voc.feature_id(h) for n, h in hashes.items()}
+        for name, voc in vocabs.items()
+    }
+    selection = select_cfg_nodes(cpg, "cfg")
+    g = graph_from_cpg(cpg, gid, feat_ids, graph_label=0, selection=selection)
+    return g, selection[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class EncodedFunction:
+    """One function out of :func:`encode_source`.
+
+    ``graph is None`` ⇔ ``error`` says why (a function with no CFG nodes is
+    a per-function error row, mirroring the preprocess failure-file policy).
+    ``cpg`` is kept only when the caller needs statement text/lines for
+    ranking (predict); the serve path drops it to keep cache entries small.
+    """
+
+    name: str
+    graph: object | None
+    node_ids: tuple[int, ...]
+    cpg: object | None = None
+    error: str | None = None
+
+
+def encode_source(
+    code: str, vocabs: dict[str, Vocabulary], *, keep_cpg: bool = True
+) -> list[EncodedFunction]:
+    """Parse + dependence-edge + encode every function in ``code``.
+
+    Frontend failures propagate (``FrontendError``/``SyntaxError``) — the
+    caller decides whether that is a per-file error row (predict) or a
+    4xx response (serve); a function that parses but has no scoreable CFG
+    is a per-function :class:`EncodedFunction` with ``error`` set.
+    """
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_functions
+
+    out: list[EncodedFunction] = []
+    for fname, cpg in parse_functions(code):
+        cpg = add_dependence_edges(cpg)
+        g, node_ids = encode_cpg(cpg, 0, vocabs)
+        if g is None:
+            out.append(EncodedFunction(
+                fname, None, (), None, "no CFG nodes survived selection"))
+        else:
+            out.append(EncodedFunction(
+                fname, g, tuple(int(n) for n in node_ids),
+                cpg if keep_cpg else None))
+    return out
+
+
+def normalize_source(code: str) -> str:
+    """Whitespace-canonical form for content addressing: normalized line
+    endings, trailing whitespace stripped, blank lines dropped. Two sources
+    that differ only this way produce identical CPGs, so they must share
+    one cache entry; anything deeper (comments, renames) changes bytes the
+    frontend actually reads and stays a distinct key."""
+    lines = (ln.rstrip() for ln in
+             code.replace("\r\n", "\n").replace("\r", "\n").split("\n"))
+    return "\n".join(ln for ln in lines if ln)
+
+
+def source_key(code: str) -> str:
+    """Content address of a scan request (sha256 of the normalized text)."""
+    return hashlib.sha256(normalize_source(code).encode()).hexdigest()
+
+
+def vocab_content_hash(vocabs: dict[str, Vocabulary]) -> str:
+    """Deterministic digest of the full vocabulary content (every name →
+    ``Vocabulary.to_dict``, key-sorted). Recorded in the export manifest so
+    a server can detect an artifact that was exported against a DIFFERENT
+    training vocabulary than the shards it encodes requests with — the
+    stale-artifact failure mode that otherwise mis-scores silently."""
+    payload = json.dumps(
+        {name: voc.to_dict() for name, voc in sorted(vocabs.items())},
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]

@@ -25,7 +25,6 @@ top-1 in the same study).
 
 from __future__ import annotations
 
-import json
 from pathlib import Path
 from typing import Callable, Sequence
 
@@ -35,65 +34,15 @@ import numpy as np
 
 from deepdfa_tpu.config import ExperimentConfig
 from deepdfa_tpu.data.graphs import _round_up, batch_np
-from deepdfa_tpu.data.materialize import graph_from_cpg, select_cfg_nodes
 from deepdfa_tpu.data.vocab import Vocabulary
+from deepdfa_tpu.pipeline import all_subkeys as _all_subkeys  # noqa: F401 — API compat
+from deepdfa_tpu.pipeline import encode_cpg as _encode  # noqa: F401 — API compat
+from deepdfa_tpu.pipeline import encode_source, load_vocabs
 
 __all__ = [
     "load_vocabs", "make_scorer", "predict_source", "predict_paths",
     "collect_sources",
 ]
-
-
-def load_vocabs(shard_dir: Path | str) -> dict[str, Vocabulary]:
-    """The training vocabularies from a materialised shard dir.
-
-    Requires the full serialised form (``Vocabulary.to_dict``): the legacy
-    ``all_vocab``-only format cannot encode NEW code (UNKNOWN substitution
-    needs the subkey vocabs), so it is rejected with a re-preprocess hint
-    rather than silently mis-encoding every definition.
-    """
-    path = Path(shard_dir) / "vocab.json"
-    data = json.loads(path.read_text())
-    first = next(iter(data.values()), None)
-    if not isinstance(first, dict) or "subkey_vocabs" not in first:
-        raise ValueError(
-            f"{path} is the legacy all_vocab-only format and cannot encode "
-            "new source; re-run scripts/preprocess.py to write the full "
-            "vocabulary (cfg + subkey_vocabs + all_vocab)"
-        )
-    return {name: Vocabulary.from_dict(d) for name, d in data.items()}
-
-
-def _all_subkeys(vocabs: dict[str, Vocabulary]) -> tuple[str, ...]:
-    """Union of subkeys across vocabs, in first-seen order. Stage-2 hashes
-    must cover every subkey ANY vocabulary reads — picking one vocab's
-    subkeys would make encoding depend on JSON key order (a single-subkey
-    vocab first ⇒ every other vocab silently degrades to UNKNOWN)."""
-    seen: dict[str, None] = {}
-    for voc in vocabs.values():
-        for sk in voc.cfg.subkeys:
-            seen.setdefault(sk)
-    return tuple(seen)
-
-
-def _encode(cpg, gid: int, vocabs: dict[str, Vocabulary]):
-    """CPG → (Graph with training-vocab feature ids, CFG node-id order)."""
-    from deepdfa_tpu.cpg.features import extract_features, features_to_hashes
-
-    feats = extract_features(cpg, gid)
-    hashes: dict[int, str] = {}
-    if len(feats):
-        hash_df = features_to_hashes(feats, _all_subkeys(vocabs))
-        hashes = {
-            int(r.node_id): r.hash for r in hash_df.itertuples(index=False)
-        }
-    feat_ids = {
-        name: {n: voc.feature_id(h) for n, h in hashes.items()}
-        for name, voc in vocabs.items()
-    }
-    selection = select_cfg_nodes(cpg, "cfg")
-    g = graph_from_cpg(cpg, gid, feat_ids, graph_label=0, selection=selection)
-    return g, selection[0]
 
 
 def make_scorer(model, label_style: str) -> Callable:
@@ -204,19 +153,16 @@ def predict_source(
     (:func:`_round_up`), so the jitted ``scorer`` compiles once per size
     bucket and similarly-sized functions reuse the executable.
     """
-    from deepdfa_tpu.cpg.features import add_dependence_edges
-    from deepdfa_tpu.cpg.frontend import parse_functions
-
     if saliency not in ("occlusion", "gate"):
         raise ValueError(f"saliency must be 'occlusion' or 'gate', "
                          f"not {saliency!r}")
     results = []
-    for fname, cpg in parse_functions(code):
-        cpg = add_dependence_edges(cpg)
-        g, node_ids = _encode(cpg, 0, vocabs)
+    # the shared pipeline (deepdfa_tpu/pipeline.py) — same path serve takes
+    for enc in encode_source(code, vocabs):
+        fname, g, node_ids, cpg = enc.name, enc.graph, enc.node_ids, enc.cpg
         if g is None:
             results.append({"function": fname, "file": name,
-                            "error": "no CFG nodes survived selection"})
+                            "error": enc.error})
             continue
         batch = batch_np(
             [g], 2, _round_up(g.n_nodes + 2),

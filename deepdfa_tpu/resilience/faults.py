@@ -30,6 +30,12 @@ Known points (grep for ``faults.fire(`` / ``crash_if`` / ``raise_if``):
                                          prompt never returns (cpg)
 ``joern.die``                            kill the joern subprocess before a
                                          command (cpg)
+``serve.drop_request``                   drop one ``/score`` request at
+                                         admission — the client gets a 503,
+                                         the server keeps serving (serve)
+``serve.engine_raises``                  raise inside the scoring engine —
+                                         that batch's requests get 500s,
+                                         the dispatcher survives (serve)
 =======================================  ====================================
 """
 
@@ -66,6 +72,8 @@ KNOWN_POINTS = (
     "prefetch.producer_raises",
     "joern.hang",
     "joern.die",
+    "serve.drop_request",
+    "serve.engine_raises",
 )
 
 

@@ -1,0 +1,36 @@
+"""Online inference service: dynamic micro-batching over warm compiled
+scorers, a content-addressed scan cache, and a stdlib HTTP endpoint with
+Prometheus-style serving metrics.
+
+Composition (one request's path)::
+
+    POST /score ──(drop/backpressure faults, cache lookup)──▶ pipeline
+        encode_source ──▶ MicroBatcher.submit ──▶ size bucket queue
+        ──(max_batch | max_wait_ms)──▶ ScoringEngine.score (padded,
+        per-bucket compiled callable) ──▶ futures resolve ──▶ JSON rows
+
+Entry points: ``python -m deepdfa_tpu.serve.server`` or
+``deepdfa-tpu serve``; load-test with ``scripts/bench_serving.py``.
+"""
+
+from .batcher import MicroBatcher, QueueFullError
+from .cache import ScanCache, ScanEntry
+from .engine import OversizeGraphError, ScoringEngine, ServeBucket, serve_buckets
+from .metrics import LatencyReservoir, ServeMetrics
+from .server import ScoreServer, build_server, serve_command
+
+__all__ = [
+    "MicroBatcher",
+    "QueueFullError",
+    "ScanCache",
+    "ScanEntry",
+    "OversizeGraphError",
+    "ScoringEngine",
+    "ServeBucket",
+    "serve_buckets",
+    "LatencyReservoir",
+    "ServeMetrics",
+    "ScoreServer",
+    "build_server",
+    "serve_command",
+]

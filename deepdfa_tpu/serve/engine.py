@@ -1,0 +1,243 @@
+"""ScoringEngine — warm per-bucket compiled scorers for the online path.
+
+The compiled-shape discipline that rules training rules serving too: XLA
+programs are specialized to static shapes, so the engine owns a small
+ladder of :class:`~deepdfa_tpu.data.graphs.BucketSpec` budgets (size
+classes per *graph*, batch budgets per *bucket*) and keeps one compiled
+callable warm per bucket. Requests are routed to the smallest size class
+that fits their graph (`assign_bucket`), the batcher packs per class, and
+`score` pads + dispatches — after the first `warmup()` no request ever
+pays a compile.
+
+Two constructors, one contract:
+
+- :meth:`from_checkpoint` — live model + restored params through
+  :func:`deepdfa_tpu.predict.make_scorer` (jit; any bucket ladder);
+- :meth:`from_artifact` — a pre-exported StableHLO artifact
+  (:mod:`deepdfa_tpu.serving`), whose ONE baked shape becomes the only
+  bucket; node-label artifacts are reduced to function scores host-side.
+
+`score` is where the ``serve.engine_raises`` fault point lives: an
+injected (or real) engine failure must surface as a per-request error in
+the batcher, never as a dead server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+from deepdfa_tpu.data.graphs import BucketSpec, Graph, _round_up, batch_np
+from deepdfa_tpu.resilience import faults
+
+__all__ = ["OversizeGraphError", "ServeBucket", "serve_buckets", "ScoringEngine"]
+
+
+class OversizeGraphError(ValueError):
+    """The function's graph exceeds every serving bucket — a per-request
+    413, not a reason to grow the compiled-shape ladder at runtime."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeBucket:
+    """A size class: graphs with ``n_nodes <= graph_nodes`` (and edges
+    within the per-graph share) route here; ``spec`` is the padded batch
+    budget the bucket's compiled callable is specialized to."""
+
+    spec: BucketSpec
+    graph_nodes: int
+
+    @property
+    def capacity(self) -> int:
+        """Real-graph slots (one BucketSpec slot is the padding sink)."""
+        return self.spec.max_graphs - 1
+
+    def admits(self, g: Graph) -> bool:
+        return (g.n_nodes <= self.graph_nodes
+                and g.n_edges <= 4 * self.graph_nodes
+                and self.spec.fits(1, g.n_nodes, g.n_edges))
+
+
+def serve_buckets(max_batch: int) -> tuple[ServeBucket, ...]:
+    """The default ladder: small CFGs (DeepDFA's regime, ~50 nodes) batch
+    ``max_batch``-wide; mid-size functions batch narrower; huge ones go
+    one-per-batch. Three compiled shapes total — bounded compile cost,
+    bounded padding waste."""
+    ladder = ((126, max_batch), (1022, max(1, max_batch // 4)), (4094, 1))
+    out = []
+    for per_graph, gcap in ladder:
+        nn = _round_up(gcap * per_graph + 2)
+        out.append(ServeBucket(
+            spec=BucketSpec(gcap + 1, nn, 4 * nn), graph_nodes=per_graph))
+    return tuple(out)
+
+
+class ScoringEngine:
+    """``score(graphs, bucket) -> fn_prob[len(graphs)]`` over a fixed
+    bucket ladder. ``score_fn`` maps a padded ``BatchedGraphs`` to
+    per-graph probabilities ``[max_graphs]`` (already sigmoid'd)."""
+
+    def __init__(self, score_fn, buckets, label_style: str = "graph",
+                 feat_keys=(), vocab_hash: str | None = None):
+        if not buckets:
+            raise ValueError("need at least one serving bucket")
+        self._score_fn = score_fn
+        self.buckets = tuple(sorted(
+            buckets, key=lambda b: (b.graph_nodes, b.spec.max_graphs)))
+        self.label_style = label_style
+        self.feat_keys = tuple(feat_keys)
+        self.vocab_hash = vocab_hash
+        self.n_dispatches = 0
+
+    # -- routing ------------------------------------------------------------
+
+    def assign_bucket(self, g: Graph) -> ServeBucket:
+        for b in self.buckets:
+            if b.admits(g):
+                return b
+        raise OversizeGraphError(
+            f"graph with {g.n_nodes} nodes / {g.n_edges} edges exceeds the "
+            f"largest serving bucket "
+            f"(graph_nodes={self.buckets[-1].graph_nodes})")
+
+    # -- scoring ------------------------------------------------------------
+
+    def score(self, graphs, bucket: ServeBucket) -> np.ndarray:
+        """Pad ``graphs`` (all pre-routed to ``bucket``) and dispatch one
+        compiled call; returns the real graphs' probabilities."""
+        faults.raise_if("serve.engine_raises")
+        graphs = list(graphs)
+        batch = batch_np(graphs, bucket.spec.max_graphs,
+                         bucket.spec.max_nodes, bucket.spec.max_edges)
+        probs = np.asarray(self._score_fn(batch), np.float32)
+        self.n_dispatches += 1
+        return probs[: len(graphs)]
+
+    def warmup(self) -> int:
+        """Compile every bucket's callable on a dummy graph so the first
+        real request never pays XLA compilation; returns buckets warmed.
+        Calls ``score_fn`` directly, NOT :meth:`score`: the
+        ``serve.engine_raises`` fault point poisons a *request's* batch —
+        an armed ``@1`` spec must hit the first client, not kill the
+        server during startup warmup."""
+        n = 2
+        feats = {k: np.zeros(n, np.int32) for k in self.feat_keys}
+        g = Graph(senders=np.arange(n - 1, dtype=np.int32),
+                  receivers=np.arange(1, n, dtype=np.int32),
+                  node_feats=feats).with_self_loops()
+        for b in self.buckets:
+            batch = batch_np([g], b.spec.max_graphs, b.spec.max_nodes,
+                             b.spec.max_edges)
+            np.asarray(self._score_fn(batch), np.float32)
+        return len(self.buckets)
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_model(cls, model, params, label_style: str, feat_keys,
+                   max_batch: int = 16, buckets=None,
+                   vocab_hash: str | None = None) -> "ScoringEngine":
+        """Live-model engine (the checkpoint path's core, split out so
+        tests can inject fresh params without checkpoint machinery)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepdfa_tpu.predict import make_scorer
+
+        scorer = make_scorer(model, label_style)
+        keys = tuple(feat_keys)
+
+        def score_fn(batch):
+            # conform to the warmed pytree structure: request graphs carry
+            # extra columns the model never reads (``_VULN`` labels) — keep
+            # exactly ``feat_keys`` so every batch hits ONE jit cache entry
+            # (same policy as serving._Servable for artifacts)
+            batch = batch._replace(
+                node_feats={k: batch.node_feats[k] for k in keys})
+            fn_p, _ = scorer(params, jax.tree.map(jnp.asarray, batch))
+            return fn_p
+
+        return cls(score_fn, buckets or serve_buckets(max_batch),
+                   label_style=label_style, feat_keys=feat_keys,
+                   vocab_hash=vocab_hash)
+
+    @classmethod
+    def from_checkpoint(cls, cfg, ckpt_dir: Path | str, vocabs,
+                        max_batch: int | None = None) -> "ScoringEngine":
+        """Restore best-else-latest params (same policy as predict/test)
+        and serve through the layout-portable segment forward."""
+        import jax
+        import jax.numpy as jnp
+
+        from deepdfa_tpu.models import make_model
+        from deepdfa_tpu.pipeline import vocab_content_hash
+        from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+        if cfg.model.layout != "segment":
+            cfg = dataclasses.replace(
+                cfg, model=dataclasses.replace(cfg.model, layout="segment"))
+        model = make_model(cfg.model, cfg.input_dim)
+        n = 4
+        feats = {k: np.zeros(n, np.int32) for k in vocabs}
+        feats["_VULN"] = np.zeros(n, np.int32)
+        dummy = Graph(senders=np.arange(n - 1, dtype=np.int32),
+                      receivers=np.arange(1, n, dtype=np.int32),
+                      node_feats=feats).with_self_loops()
+        example = jax.tree.map(jnp.asarray, batch_np([dummy], 2, 8, 128))
+        params = model.init(jax.random.key(0), example)["params"]
+        ckpts = CheckpointManager(Path(ckpt_dir), cfg.checkpoint)
+        if ckpts.latest_step() is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {ckpt_dir} — the engine serves a "
+                "TRAINED model; run fit first (or point at an --artifact)")
+        restored = (ckpts.restore_best(template={"params": params})
+                    if ckpts.best_step() is not None
+                    else ckpts.restore_latest(template={"params": params}))
+        return cls.from_model(
+            model, restored["params"], cfg.model.label_style,
+            feat_keys=tuple(vocabs),
+            max_batch=max_batch or cfg.serve.max_batch,
+            vocab_hash=vocab_content_hash(vocabs))
+
+    @classmethod
+    def from_artifact(cls, artifact_dir: Path | str,
+                      vocabs=None) -> "ScoringEngine":
+        """Engine over a pre-exported StableHLO artifact. The artifact is
+        compiled for ONE shape, so the ladder collapses to one bucket at
+        the manifest's budgets. When ``vocabs`` is given, its content hash
+        is checked against the manifest (``load_exported`` warns on
+        mismatch — the stale-artifact guard)."""
+        from deepdfa_tpu.serving import load_exported
+
+        vocab_hash = None
+        if vocabs is not None:
+            from deepdfa_tpu.pipeline import vocab_content_hash
+
+            vocab_hash = vocab_content_hash(vocabs)
+        servable = load_exported(artifact_dir, expect_vocab_hash=vocab_hash)
+        man = servable.manifest
+        leaves = man["input_leaves"]
+        # flatten order: node_feats (sorted keys), senders, receivers,
+        # node_gidx, node_mask, edge_mask, graph_mask
+        max_graphs = int(leaves[-1]["shape"][0])
+        max_edges = int(leaves[-2]["shape"][0])
+        max_nodes = int(leaves[-3]["shape"][0])
+        spec = BucketSpec(max_graphs, max_nodes, max_edges)
+        bucket = ServeBucket(spec=spec, graph_nodes=max_nodes - 1)
+        label_style = man.get("label_style", "graph")
+
+        if label_style == "node":
+            def score_fn(batch):
+                node_p = np.asarray(servable(batch), np.float32)
+                fn = np.zeros(batch.max_graphs, np.float32)
+                mask = np.asarray(batch.node_mask)
+                np.maximum.at(
+                    fn, np.asarray(batch.node_gidx)[mask], node_p[mask])
+                return fn
+        else:
+            score_fn = servable
+        return cls(score_fn, (bucket,), label_style=label_style,
+                   feat_keys=tuple(man["node_feat_keys"]),
+                   vocab_hash=man.get("vocab_hash"))

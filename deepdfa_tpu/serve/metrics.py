@@ -1,0 +1,165 @@
+"""Serving metrics: thread-safe counters/gauges + a latency reservoir,
+rendered in the Prometheus text exposition format at ``/metrics``.
+
+Stdlib-only on purpose (the container has no prometheus_client, and the
+serve path must not grow dependencies): counters are plain ints under one
+lock, latency quantiles come from a bounded ring buffer — O(window) per
+scrape, O(1) per request, and immune to unbounded growth on long-lived
+servers.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+__all__ = ["LatencyReservoir", "ServeMetrics"]
+
+
+class LatencyReservoir:
+    """Last-N latency samples (ms); p50/p99 over the window. A sliding
+    window — not a lifetime histogram — so quantiles track CURRENT service
+    health, which is what an operator paging on p99 wants."""
+
+    def __init__(self, window: int = 2048):
+        self._samples: deque[float] = deque(maxlen=max(1, int(window)))
+        self._lock = threading.Lock()
+
+    def observe(self, ms: float) -> None:
+        with self._lock:
+            self._samples.append(float(ms))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def quantile(self, q: float) -> float | None:
+        """Nearest-rank quantile over the window; None when empty."""
+        with self._lock:
+            data = sorted(self._samples)
+        if not data:
+            return None
+        idx = min(len(data) - 1, max(0, round(q * (len(data) - 1))))
+        return data[idx]
+
+
+class ServeMetrics:
+    """The server's one metrics registry. Counter semantics:
+
+    - ``requests_total`` — every ``/score`` request received;
+    - ``responses_total[code]`` — responses by HTTP status;
+    - ``dropped_total`` — requests rejected by admission control or the
+      ``serve.drop_request`` fault point;
+    - ``errors_total`` — 4xx/5xx responses (a subset view of responses);
+    - ``batches_total`` / ``batch_graphs_total`` / ``occupancy_sum`` —
+      dispatched micro-batches, real graphs in them, and the per-batch
+      occupancy sum (real graphs ÷ bucket graph capacity), so
+      ``occupancy_sum / batches_total`` is the mean batch occupancy;
+    - ``queue_depth`` — gauge, requests waiting in the micro-batch queue;
+    - ``inflight`` — gauge, ``/score`` requests currently being handled.
+
+    Cache hit/miss counters live on the cache itself (:mod:`.cache`) and
+    are merged into the rendering by the server.
+    """
+
+    def __init__(self, latency_window: int = 2048):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.responses_total: dict[int, int] = {}
+        self.errors_total = 0
+        self.dropped_total = 0
+        self.batches_total = 0
+        self.batch_graphs_total = 0
+        self.occupancy_sum = 0.0
+        self.queue_depth = 0
+        self.inflight = 0
+        self.latency = LatencyReservoir(latency_window)
+
+    def inc(self, name: str, by: float = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + by)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            setattr(self, name, value)
+
+    def observe_response(self, code: int, latency_ms: float) -> None:
+        with self._lock:
+            self.responses_total[code] = self.responses_total.get(code, 0) + 1
+            if code >= 400:
+                self.errors_total += 1
+        self.latency.observe(latency_ms)
+
+    def observe_batch(self, n_real: int, capacity: int) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.batch_graphs_total += n_real
+            self.occupancy_sum += n_real / max(capacity, 1)
+
+    def mean_batch_occupancy(self) -> float | None:
+        with self._lock:
+            if not self.batches_total:
+                return None
+            return self.occupancy_sum / self.batches_total
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy for JSON consumers (the bench, tests)."""
+        with self._lock:
+            snap = {
+                "requests_total": self.requests_total,
+                "responses_total": dict(self.responses_total),
+                "errors_total": self.errors_total,
+                "dropped_total": self.dropped_total,
+                "batches_total": self.batches_total,
+                "batch_graphs_total": self.batch_graphs_total,
+                "occupancy_sum": self.occupancy_sum,
+                "queue_depth": self.queue_depth,
+                "inflight": self.inflight,
+            }
+        snap["mean_batch_occupancy"] = (
+            snap["occupancy_sum"] / snap["batches_total"]
+            if snap["batches_total"] else None)
+        snap["latency_p50_ms"] = self.latency.quantile(0.50)
+        snap["latency_p99_ms"] = self.latency.quantile(0.99)
+        return snap
+
+    def render(self, cache_stats: dict | None = None) -> str:
+        """Prometheus text format (`# TYPE` lines + samples)."""
+        snap = self.snapshot()
+        lines = []
+
+        def emit(name, kind, value, labels=""):
+            if value is None:
+                return
+            lines.append(f"# TYPE deepdfa_serve_{name} {kind}")
+            lines.append(f"deepdfa_serve_{name}{labels} {value}")
+
+        emit("requests_total", "counter", snap["requests_total"])
+        for code in sorted(snap["responses_total"]):
+            lines.append("# TYPE deepdfa_serve_responses_total counter")
+            lines.append(
+                f'deepdfa_serve_responses_total{{code="{code}"}} '
+                f'{snap["responses_total"][code]}')
+        emit("errors_total", "counter", snap["errors_total"])
+        emit("dropped_total", "counter", snap["dropped_total"])
+        emit("batches_total", "counter", snap["batches_total"])
+        emit("batch_graphs_total", "counter", snap["batch_graphs_total"])
+        emit("batch_occupancy_mean", "gauge", snap["mean_batch_occupancy"])
+        emit("queue_depth", "gauge", snap["queue_depth"])
+        emit("inflight", "gauge", snap["inflight"])
+        for q in (0.50, 0.99):
+            v = self.latency.quantile(q)
+            if v is not None:
+                lines.append("# TYPE deepdfa_serve_latency_ms gauge")
+                lines.append(
+                    f'deepdfa_serve_latency_ms{{quantile="{q}"}} {v}')
+        if cache_stats:
+            emit("cache_hits_total", "counter", cache_stats.get("hits"))
+            emit("cache_encode_hits_total", "counter",
+                 cache_stats.get("encode_hits"))
+            emit("cache_misses_total", "counter", cache_stats.get("misses"))
+            emit("cache_evictions_total", "counter",
+                 cache_stats.get("evictions"))
+            emit("cache_entries", "gauge", cache_stats.get("entries"))
+            emit("cache_hit_rate", "gauge", cache_stats.get("hit_rate"))
+        return "\n".join(lines) + "\n"

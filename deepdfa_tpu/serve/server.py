@@ -1,0 +1,344 @@
+"""Stdlib HTTP JSON scoring service — the long-lived online surface.
+
+Endpoints:
+
+- ``POST /score``  ``{"source": "<C text>"}`` → per-function rows
+  ``{"function", "vulnerable_probability"}`` (or ``{"function","error"}``
+  for functions with no scoreable CFG). Repeat scans of the same
+  normalized source are served from the content-addressed cache
+  (``"cached": true``) without touching the frontend.
+- ``GET /healthz`` → liveness. Stays green through per-request failures
+  (frontend errors, injected engine faults) — only process death or
+  drain takes it away.
+- ``GET /metrics`` → Prometheus text: queue depth, batch occupancy,
+  cache hit rate, p50/p99 latency (see :mod:`.metrics`).
+
+Failure domains, smallest first: a bad request body is a 400; an
+unparseable source is a 422; an oversize function a 413; admission
+control (bounded queue) and the ``serve.drop_request`` fault are 503;
+an engine failure (``serve.engine_raises`` included) is a 500 for the
+requests in that batch. None of them touch the server's lifetime.
+
+Shutdown: SIGTERM/SIGINT set a flag; ``/score`` starts refusing with
+503, the micro-batcher drains what is queued, in-flight handler threads
+finish writing their responses (bounded by ``serve.drain_timeout_s``),
+then the listener closes. No request that got a 200-path admission is
+abandoned mid-flight.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import threading
+import time
+from concurrent.futures import TimeoutError as _FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+from deepdfa_tpu.config import ExperimentConfig, ServeConfig
+from deepdfa_tpu.pipeline import encode_source, load_vocabs, source_key
+from deepdfa_tpu.resilience import faults
+
+from .batcher import MicroBatcher, QueueFullError
+from .cache import ScanCache
+from .engine import OversizeGraphError, ScoringEngine
+from .metrics import ServeMetrics
+
+__all__ = ["ScoreServer", "build_server", "serve_command", "main"]
+
+logger = logging.getLogger(__name__)
+
+REQUEST_TIMEOUT_S = 60.0  # cap on one request's wait for its batch scores
+
+
+class ScoreServer:
+    """Engine + vocabs + cache + batcher behind a ThreadingHTTPServer."""
+
+    def __init__(self, engine: ScoringEngine, vocabs,
+                 cfg: ServeConfig | None = None, cache: ScanCache | None = None,
+                 metrics: ServeMetrics | None = None):
+        self.cfg = cfg or ServeConfig()
+        self.engine = engine
+        self.vocabs = vocabs
+        self.metrics = metrics or ServeMetrics(self.cfg.latency_window)
+        self.cache = cache if cache is not None else ScanCache(
+            self.cfg.cache_entries)
+        self.batcher = MicroBatcher(
+            engine, max_batch=self.cfg.max_batch,
+            max_wait_ms=self.cfg.max_wait_ms, max_queue=self.cfg.max_queue,
+            metrics=self.metrics).start()
+        self._draining = threading.Event()
+        self._stop_requested = threading.Event()
+        self._stopped = threading.Event()
+        self.httpd = ThreadingHTTPServer(
+            (self.cfg.host, self.cfg.port), _make_handler(self))
+        self.httpd.daemon_threads = True  # a hung socket must not block exit
+        self._serve_thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def start(self) -> "ScoreServer":
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="serve-http", daemon=True)
+        self._serve_thread.start()
+        logger.info("serving on %s:%s (%d buckets, max_batch=%d)",
+                    self.cfg.host, self.port, len(self.engine.buckets),
+                    self.cfg.max_batch)
+        return self
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → request a graceful drain. The handler only
+        sets a flag; the actual drain runs in :meth:`wait` (signal
+        handlers must not join threads)."""
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: self._stop_requested.set())
+
+    def wait(self) -> dict:
+        """Block until a shutdown is requested, then drain and stop.
+        Returns the final metrics snapshot (also what ``main`` prints)."""
+        while not self._stop_requested.wait(timeout=0.2):
+            pass
+        return self.shutdown(drain=True)
+
+    def request_stop(self) -> None:
+        self._stop_requested.set()
+
+    def shutdown(self, drain: bool = True) -> dict:
+        """Refuse new scores, drain queue + in-flight handlers, close."""
+        self._draining.set()
+        self._stop_requested.set()
+        self.batcher.stop(drain=drain, timeout=self.cfg.drain_timeout_s)
+        deadline = time.monotonic() + self.cfg.drain_timeout_s
+        while drain and self.metrics.inflight > 0:
+            if time.monotonic() >= deadline:
+                logger.warning("drain timeout with %d request(s) in flight",
+                               self.metrics.inflight)
+                break
+            time.sleep(0.01)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._stopped.set()
+        snap = self.metrics.snapshot()
+        snap["cache"] = self.cache.stats()
+        return snap
+
+    # -- request handling ---------------------------------------------------
+
+    def handle_score(self, payload: dict) -> tuple[int, dict]:
+        source = payload.get("source") if isinstance(payload, dict) else None
+        if not isinstance(source, str) or not source.strip():
+            return 400, {"error": "body must be JSON with a 'source' string"}
+        if self._draining.is_set():
+            return 503, {"error": "server is draining"}
+        if faults.fire("serve.drop_request"):
+            self.metrics.inc("dropped_total")
+            return 503, {"error": "request dropped (injected fault "
+                                  "serve.drop_request)"}
+
+        key = source_key(source)
+        entry = self.cache.lookup(key)
+        if entry is not None and entry.results is not None:
+            return 200, {"results": entry.results, "cached": True}
+
+        if entry is not None and entry.encoded is not None:
+            encoded = entry.encoded  # frontend skipped: encode-level hit
+        else:
+            try:
+                encoded = encode_source(source, self.vocabs, keep_cpg=False)
+            except Exception as exc:  # noqa: BLE001 — frontend failure = 422
+                return 422, {"error": f"{type(exc).__name__}: {exc}"}
+            self.cache.store(key, encoded=encoded)
+        if not encoded:
+            return 422, {"error": "no functions found in source"}
+
+        rows: list[dict] = []
+        futures: list = []
+        for enc in encoded:
+            if enc.graph is None:
+                rows.append({"function": enc.name, "error": enc.error})
+                futures.append(None)
+                continue
+            try:
+                futures.append(self.batcher.submit(enc.graph))
+            except QueueFullError as exc:
+                self.metrics.inc("dropped_total")
+                return 503, {"error": str(exc)}
+            except OversizeGraphError as exc:
+                return 413, {"error": str(exc)}
+            except RuntimeError as exc:  # draining race
+                return 503, {"error": str(exc)}
+            rows.append({"function": enc.name})
+
+        deadline = time.monotonic() + REQUEST_TIMEOUT_S
+        for row, fut in zip(rows, futures):
+            if fut is None:
+                continue
+            try:
+                prob = fut.result(timeout=max(0.0, deadline - time.monotonic()))
+            except (TimeoutError, _FutureTimeout):
+                return 504, {"error": "scoring timed out"}
+            except Exception as exc:  # noqa: BLE001 — engine fault = 500
+                return 500, {"error": f"{type(exc).__name__}: {exc}"}
+            row["vulnerable_probability"] = round(prob, 6)
+
+        self.cache.store(key, results=rows)
+        return 200, {"results": rows, "cached": False}
+
+
+def _make_handler(server: ScoreServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route BaseHTTPServer noise
+            logger.debug("http: " + fmt, *args)
+
+        def _send(self, code: int, body, content_type="application/json"):
+            data = (body.encode() if isinstance(body, str)
+                    else json.dumps(body).encode())
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok",
+                                 "draining": server.draining,
+                                 "label_style": server.engine.label_style})
+            elif self.path == "/metrics":
+                self._send(200, server.metrics.render(server.cache.stats()),
+                           content_type="text/plain; version=0.0.4")
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/score":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            t0 = time.perf_counter()
+            server.metrics.inc("requests_total")
+            server.metrics.inc("inflight")
+            try:
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    code, body = 400, {"error": "body is not valid JSON"}
+                else:
+                    code, body = server.handle_score(payload)
+            except Exception as exc:  # noqa: BLE001 — request dies, server not
+                code, body = 500, {"error": f"{type(exc).__name__}: {exc}"}
+            finally:
+                server.metrics.inc("inflight", -1)
+            self._send(code, body)
+            server.metrics.observe_response(
+                code, (time.perf_counter() - t0) * 1000.0)
+
+    return Handler
+
+
+# ---------------------------------------------------------------------------
+# construction + CLI entry
+
+
+def build_server(cfg: ExperimentConfig, run_dir: Path | None = None,
+                 ckpt_dir: Path | None = None,
+                 artifact: Path | str | None = None,
+                 shard_dir: Path | str | None = None) -> ScoreServer:
+    """Wire vocabs + engine + server from a config: either a checkpoint
+    run (``run_dir``/``ckpt_dir``) or a pre-exported ``artifact`` dir."""
+    from deepdfa_tpu import utils
+
+    if shard_dir is None:
+        sample = "_sample" if cfg.data.sample else ""
+        shard_dir = utils.processed_dir() / cfg.data.dsname / f"shards{sample}"
+    vocabs = load_vocabs(shard_dir)
+    if artifact is not None:
+        engine = ScoringEngine.from_artifact(artifact, vocabs=vocabs)
+    else:
+        if run_dir is None and ckpt_dir is None:
+            raise ValueError("need --run-dir/--ckpt-dir or --artifact")
+        engine = ScoringEngine.from_checkpoint(
+            cfg, ckpt_dir or Path(run_dir) / "checkpoints", vocabs,
+            max_batch=cfg.serve.max_batch)
+    return ScoreServer(engine, vocabs, cfg.serve)
+
+
+def serve_command(cfg: ExperimentConfig, run_dir: Path | None = None,
+                  ckpt_dir: Path | None = None,
+                  artifact: Path | str | None = None,
+                  shard_dir: Path | str | None = None) -> dict:
+    """Foreground service: build, warm, serve until SIGTERM, drain."""
+    server = build_server(cfg, run_dir=run_dir, ckpt_dir=ckpt_dir,
+                          artifact=artifact, shard_dir=shard_dir)
+    warmed = server.engine.warmup()
+    server.install_signal_handlers()
+    server.start()
+    print(json.dumps({
+        "status": "serving", "host": server.cfg.host, "port": server.port,
+        "buckets_warmed": warmed,
+        "label_style": server.engine.label_style,
+        "vocab_hash": server.engine.vocab_hash,
+    }), flush=True)
+    summary = server.wait()
+    print(json.dumps({"status": "drained", **{
+        k: summary[k] for k in ("requests_total", "batches_total",
+                                "mean_batch_occupancy") if k in summary}}),
+        flush=True)
+    return summary
+
+
+def main(argv=None) -> dict:
+    import argparse
+
+    from deepdfa_tpu.config import load_config
+
+    parser = argparse.ArgumentParser(prog="deepdfa-tpu-serve")
+    parser.add_argument("--config", action="append", default=[])
+    parser.add_argument("--set", action="append", default=[], dest="overrides",
+                        help="dotted overrides, e.g. --set serve.max_batch=32")
+    parser.add_argument("--run-dir", default=None)
+    parser.add_argument("--ckpt-dir", default=None)
+    parser.add_argument("--artifact", default=None,
+                        help="pre-exported StableHLO artifact dir "
+                             "(deepdfa-tpu export) instead of a checkpoint")
+    parser.add_argument("--shard-dir", default=None,
+                        help="shard dir holding vocab.json (default: the "
+                             "config's processed dataset dir)")
+    args = parser.parse_args(argv)
+
+    layers = list(args.config)
+    if args.run_dir and (Path(args.run_dir) / "config.json").exists():
+        layers.insert(0, Path(args.run_dir) / "config.json")
+
+    def _parse(pairs):
+        out = {}
+        for pair in pairs:
+            key, _, value = pair.partition("=")
+            try:
+                out[key] = json.loads(value)
+            except json.JSONDecodeError:
+                out[key] = value
+        return out
+
+    cfg = load_config(*layers, overrides=_parse(args.overrides))
+    logging.basicConfig(level=logging.INFO)
+    return serve_command(
+        cfg, run_dir=Path(args.run_dir) if args.run_dir else None,
+        ckpt_dir=Path(args.ckpt_dir) if args.ckpt_dir else None,
+        artifact=args.artifact, shard_dir=args.shard_dir)
+
+
+if __name__ == "__main__":
+    main()

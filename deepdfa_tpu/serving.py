@@ -77,7 +77,8 @@ def example_batch(cfg: ExperimentConfig, vocab_keys=None) -> BatchedGraphs:
 
 def export_ggnn(cfg: ExperimentConfig, params, out_dir: str | Path,
                 vocab_keys=None, model=None, example=None,
-                platforms=("cpu", "tpu"), provenance: dict | None = None) -> Path:
+                platforms=("cpu", "tpu"), provenance: dict | None = None,
+                vocab_hash: str | None = None) -> Path:
     """Serialize ``sigmoid(model(batch))`` with ``params`` baked in.
 
     ``platforms``: lowering targets baked into the artifact — export on a
@@ -85,7 +86,10 @@ def export_ggnn(cfg: ExperimentConfig, params, out_dir: str | Path,
     (jax.export platform-checks at call time, it does NOT re-lower).
     ``model``/``example``: pass the already-built pair when the caller
     constructed them for checkpoint restore (cli.export_model) so the two
-    can never diverge."""
+    can never diverge. ``vocab_hash``: content hash of the training
+    vocabularies (:func:`deepdfa_tpu.pipeline.vocab_content_hash`) —
+    recorded so a server can detect the stale-artifact case where the
+    artifact and the shard dir it encodes requests with disagree."""
     from jax import export as jexport
 
     from deepdfa_tpu.models import make_model
@@ -122,6 +126,8 @@ def export_ggnn(cfg: ExperimentConfig, params, out_dir: str | Path,
         "platforms": list(platforms),
         "config": json.loads(to_json(cfg)),
         "provenance": provenance or {},
+        "package_version": _package_version(),
+        "vocab_hash": vocab_hash,
     }
     (out_dir / "manifest.json").write_text(json.dumps(manifest, indent=2))
     return out_dir
@@ -151,7 +157,22 @@ class _Servable:
         return np.asarray(self.exported.call(dev))
 
 
-def load_exported(out_dir: str | Path) -> _Servable:
+def _package_version() -> str:
+    import deepdfa_tpu
+
+    return getattr(deepdfa_tpu, "__version__", "unknown")
+
+
+def load_exported(out_dir: str | Path,
+                  expect_vocab_hash: str | None = None) -> _Servable:
+    """Deserialize an artifact dir. ``expect_vocab_hash``: the content hash
+    of the vocabularies the CALLER will encode requests with — when both
+    it and the manifest's recorded hash are present and differ, the
+    artifact was exported against a different training vocabulary and
+    every score would be silently wrong, so a loud warning fires (a
+    warning, not an error: hashless legacy artifacts must keep loading)."""
+    import warnings
+
     from jax import export as jexport
 
     _register_pytrees()
@@ -159,4 +180,12 @@ def load_exported(out_dir: str | Path) -> _Servable:
     exported = jexport.deserialize(
         (out_dir / "model.stablehlo").read_bytes())
     manifest = json.loads((out_dir / "manifest.json").read_text())
+    recorded = manifest.get("vocab_hash")
+    if (expect_vocab_hash is not None and recorded is not None
+            and recorded != expect_vocab_hash):
+        warnings.warn(
+            f"vocab hash mismatch: artifact {out_dir} was exported against "
+            f"vocab {recorded}, but the serving vocabulary hashes to "
+            f"{expect_vocab_hash} — scores will be wrong; re-export against "
+            "the current shard dir", stacklevel=2)
     return _Servable(exported=exported, manifest=manifest)

@@ -906,8 +906,21 @@ def export_model(
         "restored": ("best" if best is not None else "latest"),
         "step": int(best if best is not None else ckpts.latest_step()),
     }
+    # stale-artifact guard: record the training vocab's content hash so a
+    # server loading this artifact against different shards gets warned
+    vocab_hash = None
+    try:
+        from deepdfa_tpu.pipeline import load_vocabs, vocab_content_hash
+
+        sample_text = "_sample" if cfg.data.sample else ""
+        vocab_hash = vocab_content_hash(load_vocabs(
+            utils.processed_dir() / cfg.data.dsname / f"shards{sample_text}"))
+    except (FileNotFoundError, ValueError):
+        logger.warning("no readable vocab.json under the config's shard dir "
+                       "— manifest carries vocab_hash=null")
     out = export_ggnn(cfg, params, run_dir / "export",
-                      model=model, example=example, provenance=provenance)
+                      model=model, example=example, provenance=provenance,
+                      vocab_hash=vocab_hash)
     size = (out / "model.stablehlo").stat().st_size
     result = {"export_dir": str(out), "stablehlo_bytes": size, **provenance}
     print(json.dumps(result))
@@ -980,7 +993,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
     parser = argparse.ArgumentParser(prog="deepdfa-tpu")
     parser.add_argument("command",
                         choices=["fit", "test", "analyze", "predict",
-                                 "export"])
+                                 "export", "serve"])
     parser.add_argument("--config", action="append", default=[],
                         help="layered config files (later files win)")
     parser.add_argument("--set", action="append", default=[], dest="overrides",
@@ -995,6 +1008,9 @@ def main(argv: Sequence[str] | None = None) -> dict:
                         help="predict: C file or directory (repeatable)")
     parser.add_argument("--top-k", type=int, default=5,
                         help="predict: statements ranked per function")
+    parser.add_argument("--artifact", default=None,
+                        help="serve: pre-exported StableHLO artifact dir "
+                        "(deepdfa-tpu export) instead of a checkpoint")
     parser.add_argument("--saliency", choices=("occlusion", "gate"),
                         default="occlusion",
                         help="predict statement ranking: occlusion = per-"
@@ -1006,7 +1022,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         parser.error("predict requires at least one --source")
 
     layers = list(args.config)
-    if args.command in ("predict", "export") and args.run_dir:
+    if args.command in ("predict", "export", "serve") and args.run_dir:
         # score with the RUN'S OWN recorded config as the base layer (CLI
         # configs/overrides still win): `predict --run-dir <fit dir>` must
         # restore a non-default-trained checkpoint without the caller
@@ -1032,7 +1048,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
     )
     from deepdfa_tpu.config import to_json
 
-    if (args.command not in ("predict", "export")
+    if (args.command not in ("predict", "export", "serve")
             or not (run_dir / "config.json").exists()):
         # no-clobber for predict: it is routinely pointed AT a fit run dir
         # (README usage) and must not overwrite the trained run's recorded
@@ -1053,6 +1069,13 @@ def main(argv: Sequence[str] | None = None) -> dict:
             return export_model(
                 cfg, run_dir,
                 Path(args.ckpt_dir) if args.ckpt_dir else None)
+        if args.command == "serve":
+            from deepdfa_tpu.serve.server import serve_command
+
+            return serve_command(
+                cfg, run_dir=run_dir,
+                ckpt_dir=Path(args.ckpt_dir) if args.ckpt_dir else None,
+                artifact=args.artifact)
         return analyze(cfg, run_dir)
     except Exception:
         # crash marker parity: rename log to .log.error (main_cli.py:324-336).
@@ -1060,7 +1083,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         # failed scan must not mark the completed TRAINING run as crashed.
         for h in handlers:
             h.close()
-        if args.command not in ("predict", "export"):
+        if args.command not in ("predict", "export", "serve"):
             log_file.rename(log_file.with_suffix(".log.error"))
         raise
 

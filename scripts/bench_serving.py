@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Closed-loop load test of the online scoring service.
+
+Stands up a REAL in-process :class:`deepdfa_tpu.serve.ScoreServer` — live
+GGNN engine (fresh params: the serving contract under test is the
+pipeline + batching + cache machinery, which is training-independent,
+same rationale as check_serving.py), hermetic demo-corpus vocabularies —
+and drives it over HTTP with a fixed number of concurrent closed-loop
+workers (each fires its next request only when the previous one
+answered; offered load adapts to service rate, so the numbers measure
+the server, not a queue explosion).
+
+Two phases:
+
+1. **cold** — every request body is unique (corpus function + a
+   per-request unique helper function), so each one pays the full
+   frontend + encode + batch + score path;
+2. **hot** — the exact cold bodies replayed, so every request must be a
+   content-addressed cache hit that skips the frontend entirely. The
+   artifact asserts this via the cache HIT COUNTER, never via timing.
+
+Prints ONE JSON line (``bench.assemble_serve_result``): requests/sec,
+p50/p99 latency, mean batch occupancy (gate: >= 0.5 — the micro-batcher
+must actually coalesce), cache hit rate + hits, ok.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _uniq_source(base: str, i: int) -> str:
+    """A distinct-content request body that still parses: the corpus
+    function plus a tiny unique helper (also exercises multi-function
+    requests — occupancy counts graphs, not HTTP calls)."""
+    return f"{base}\nint bench_uniq_{i}(int a) {{\n  int b = a + {i};\n  return b;\n}}\n"
+
+
+def _build_fixture(max_batch: int, max_wait_ms: float, corpus_n: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepdfa_tpu.config import ExperimentConfig, ServeConfig
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.data.graphs import Graph, batch_np
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+    from deepdfa_tpu.models import make_model
+    from deepdfa_tpu.pipeline import vocab_content_hash
+    from deepdfa_tpu.serve import ScoreServer, ScoringEngine
+
+    df = demo_corpus(corpus_n, seed=0)
+    rows = df.to_dict("records")
+    cpgs = {int(r["id"]): add_dependence_edges(parse_source(r["before"]))
+            for r in rows}
+    labels = {int(r["id"]): int(r["vul"]) for r in rows}
+    cfg = ExperimentConfig()
+    _, vocabs = CorpusBuilder(cfg.data.feature).build(
+        cpgs, list(cpgs), graph_labels=labels)
+
+    model = make_model(cfg.model, cfg.input_dim)
+    n = 4
+    feats = {k: np.zeros(n, np.int32) for k in vocabs}
+    dummy = Graph(senders=np.arange(n - 1, dtype=np.int32),
+                  receivers=np.arange(1, n, dtype=np.int32),
+                  node_feats=feats).with_self_loops()
+    example = jax.tree.map(jnp.asarray, batch_np([dummy], 2, 8, 128))
+    params = model.init(jax.random.key(0), example)["params"]
+    engine = ScoringEngine.from_model(
+        model, params, cfg.model.label_style, feat_keys=tuple(vocabs),
+        max_batch=max_batch, vocab_hash=vocab_content_hash(vocabs))
+    serve_cfg = ServeConfig(port=0, max_batch=max_batch,
+                            max_wait_ms=max_wait_ms)
+    server = ScoreServer(engine, vocabs, serve_cfg)
+    return server, [r["before"] for r in rows]
+
+
+def _run_phase(port: int, bodies: list[str], concurrency: int):
+    """Closed loop: ``concurrency`` workers share one request list; each
+    worker loops request → wait for response → next. Returns elapsed
+    seconds and the number of non-200 responses."""
+    import http.client
+
+    next_i = {"i": 0}
+    lock = threading.Lock()
+    errors = {"n": 0}
+
+    def worker():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=90)
+        while True:
+            with lock:
+                i = next_i["i"]
+                if i >= len(bodies):
+                    break
+                next_i["i"] = i + 1
+            try:
+                conn.request("POST", "/score", body=bodies[i],
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    with lock:
+                        errors["n"] += 1
+            except Exception:
+                with lock:
+                    errors["n"] += 1
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=90)
+        conn.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, errors["n"]
+
+
+def main(argv=None) -> dict:
+    import argparse
+
+    import jax
+
+    from bench import assemble_serve_result
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=64,
+                    help="unique requests in the cold phase (the hot phase "
+                    "replays all of them)")
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-wait-ms", type=float, default=25.0)
+    ap.add_argument("--corpus", type=int, default=12,
+                    help="distinct demo-corpus base functions")
+    args = ap.parse_args(argv)
+
+    backend = jax.default_backend()
+    server, base_sources = _build_fixture(
+        args.max_batch, args.max_wait_ms, args.corpus)
+    bodies = [
+        json.dumps({"source": _uniq_source(base_sources[i % len(base_sources)], i)})
+        for i in range(args.requests)
+    ]
+    try:
+        server.engine.warmup()
+        server.start()
+        cold_s, cold_err = _run_phase(server.port, bodies, args.concurrency)
+        hot_s, hot_err = _run_phase(server.port, bodies, args.concurrency)
+    finally:
+        snap = server.shutdown()
+
+    total = 2 * len(bodies)
+    elapsed = cold_s + hot_s
+    cache = snap["cache"]
+    result = assemble_serve_result(
+        backend=backend,
+        device_kind=jax.devices()[0].device_kind,
+        requests_per_sec=total / elapsed if elapsed > 0 else 0.0,
+        p50_ms=snap.get("latency_p50_ms"),
+        p99_ms=snap.get("latency_p99_ms"),
+        mean_batch_occupancy=snap.get("mean_batch_occupancy"),
+        cache_hit_rate=cache.get("hit_rate"),
+        cache_hits=cache.get("hits", 0),
+        requests_total=total,
+        errors_total=cold_err + hot_err,
+        concurrency=args.concurrency,
+        notes={
+            "cold_requests_per_sec": round(len(bodies) / cold_s, 2),
+            "hot_requests_per_sec": round(len(bodies) / hot_s, 2),
+            "batches_total": snap.get("batches_total"),
+            "batch_graphs_total": snap.get("batch_graphs_total"),
+            "max_batch": args.max_batch,
+            "max_wait_ms": args.max_wait_ms,
+        },
+    )
+    # rc stays 0 even when a gate fails: the artifact carries ok:false +
+    # the measured numbers — a nonzero rc would make the watchdog misread
+    # a serving regression as device trouble and overwrite this JSON with
+    # a CPU fallback (same policy as check_serving.py)
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    import os
+
+    if os.environ.get("_BENCH_CHILD") == "1":
+        main()
+    else:
+        from bench import run_with_device_watchdog
+
+        raise SystemExit(run_with_device_watchdog(__file__, sys.argv[1:]))

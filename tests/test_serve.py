@@ -1,0 +1,448 @@
+"""Online inference service: micro-batching, content-addressed caching,
+metrics, and the HTTP surface's failure domains. Everything here runs on
+a STUB engine (the live-model and artifact paths are covered by
+test_serving.py and scripts/bench_serving.py) — these tests pin the
+serving *machinery*: batch formation, backpressure, per-request failure
+isolation, and graceful drain."""
+
+import json
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+pytestmark = pytest.mark.serve
+
+
+def _chain(n, keys=("_ABS_DATAFLOW",)):
+    from deepdfa_tpu.data.graphs import Graph
+
+    feats = {k: np.zeros(n, np.int32) for k in keys}
+    return Graph(senders=np.arange(n - 1, dtype=np.int32),
+                 receivers=np.arange(1, n, dtype=np.int32),
+                 node_feats=feats).with_self_loops()
+
+
+class _StubEngine:
+    """Real ScoringEngine over a recording stub score_fn."""
+
+    def __new__(cls, vocabs=(), max_batch=4, prob=0.25, delay_s=0.0,
+                fail_first=False):
+        from deepdfa_tpu.serve import ScoringEngine, serve_buckets
+
+        record = []
+        state = {"fail": fail_first}
+
+        def score_fn(batch):
+            if state["fail"]:
+                state["fail"] = False
+                raise RuntimeError("stub engine failure")
+            if delay_s:
+                time.sleep(delay_s)
+            record.append(int(np.sum(np.asarray(batch.graph_mask))))
+            return np.full(batch.max_graphs, prob, np.float32)
+
+        eng = ScoringEngine(score_fn, serve_buckets(max_batch),
+                            feat_keys=tuple(vocabs))
+        eng.record = record
+        return eng
+
+
+@pytest.fixture(scope="module")
+def demo():
+    """(vocabs, sources) from a tiny hermetic corpus — real frontend +
+    real vocabularies, no training."""
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    rows = demo_corpus(6, seed=0).to_dict("records")
+    cpgs = {int(r["id"]): add_dependence_edges(parse_source(r["before"]))
+            for r in rows}
+    labels = {int(r["id"]): int(r["vul"]) for r in rows}
+    _, vocabs = CorpusBuilder(FeatureConfig()).build(
+        cpgs, list(cpgs), graph_labels=labels)
+    return vocabs, [r["before"] for r in rows]
+
+
+# ---------------------------------------------------------------------------
+# cache
+
+
+def test_cache_hit_counters_and_two_layers():
+    from deepdfa_tpu.serve import ScanCache
+
+    c = ScanCache(capacity=8)
+    assert c.lookup("k") is None  # miss
+    c.store("k", encoded=["enc"])
+    e = c.lookup("k")  # encode-level hit: frontend skipped, scoring re-runs
+    assert e.encoded == ["enc"] and e.results is None
+    c.store("k", results=[{"p": 1}])
+    e = c.lookup("k")  # full hit
+    assert e.results == [{"p": 1}] and e.encoded == ["enc"]
+    s = c.stats()
+    assert (s["hits"], s["encode_hits"], s["misses"]) == (1, 1, 1)
+    assert s["hit_rate"] == pytest.approx(1 / 3)
+
+
+def test_cache_lru_eviction_order():
+    from deepdfa_tpu.serve import ScanCache
+
+    c = ScanCache(capacity=2)
+    c.store("a", results=[1])
+    c.store("b", results=[2])
+    assert c.lookup("a") is not None  # touch a → b is now LRU
+    c.store("c", results=[3])
+    assert c.lookup("b") is None and c.lookup("a") is not None
+    assert c.stats()["evictions"] == 1
+
+
+def test_cache_capacity_zero_disables():
+    from deepdfa_tpu.serve import ScanCache
+
+    c = ScanCache(capacity=0)
+    c.store("k", results=[1])
+    assert c.lookup("k") is None and len(c) == 0
+
+
+def test_source_key_whitespace_invariant():
+    from deepdfa_tpu.pipeline import source_key
+
+    a = "int f(int x) {\n  return x;\n}\n"
+    b = "int f(int x) {   \r\n\n  return x;\n}"  # CRLF, trailing WS, blank
+    assert source_key(a) == source_key(b)
+    assert source_key(a) != source_key(a.replace("x", "y"))
+
+
+# ---------------------------------------------------------------------------
+# engine routing
+
+
+def test_bucket_ladder_routing_and_oversize():
+    from deepdfa_tpu.serve import OversizeGraphError
+
+    eng = _StubEngine(max_batch=8)
+    assert [b.graph_nodes for b in eng.buckets] == [126, 1022, 4094]
+    assert eng.assign_bucket(_chain(10)).graph_nodes == 126
+    assert eng.assign_bucket(_chain(500)).graph_nodes == 1022
+    assert eng.assign_bucket(_chain(2000)).graph_nodes == 4094
+    with pytest.raises(OversizeGraphError, match="exceeds the largest"):
+        eng.assign_bucket(_chain(5000))
+
+
+def test_engine_warmup_compiles_every_bucket():
+    eng = _StubEngine(max_batch=4)
+    assert eng.warmup() == 3
+    assert len(eng.record) == 3  # one compile call per bucket shape
+
+
+@pytest.mark.faults
+def test_engine_warmup_does_not_consume_armed_fault():
+    """serve.engine_raises@1 must poison the first CLIENT request, not
+    kill the server during startup warmup (found by driving the CLI with
+    the chaos spec armed)."""
+    from deepdfa_tpu.resilience import faults
+
+    eng = _StubEngine(max_batch=4)
+    with faults.installed("serve.engine_raises@1"):
+        assert eng.warmup() == 3  # no InjectedFault
+        with pytest.raises(faults.InjectedFault):
+            eng.score([_chain(5)], eng.buckets[0])
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+
+
+def test_batcher_coalesces_window_into_one_dispatch():
+    from deepdfa_tpu.serve import MicroBatcher
+
+    eng = _StubEngine(max_batch=4)
+    b = MicroBatcher(eng, max_batch=4, max_wait_ms=200.0).start()
+    futs = [b.submit(_chain(5)) for _ in range(4)]
+    assert [f.result(timeout=10) for f in futs] == [0.25] * 4
+    # size trigger fired before the 200ms deadline: ONE padded dispatch
+    assert eng.n_dispatches == 1 and eng.record == [4]
+    b.stop()
+
+
+def test_batcher_deadline_flushes_partial_window():
+    from deepdfa_tpu.serve import MicroBatcher
+
+    eng = _StubEngine(max_batch=16)
+    b = MicroBatcher(eng, max_batch=16, max_wait_ms=20.0).start()
+    fut = b.submit(_chain(5))
+    assert fut.result(timeout=10) == 0.25  # dispatched alone at deadline
+    assert eng.record == [1]
+    b.stop()
+
+
+def test_batcher_backpressure_bounded_queue():
+    from deepdfa_tpu.serve import MicroBatcher, QueueFullError
+
+    eng = _StubEngine()
+    b = MicroBatcher(eng, max_queue=2)  # never started: queue can't drain
+    b.submit(_chain(5))
+    b.submit(_chain(5))
+    with pytest.raises(QueueFullError, match="at capacity"):
+        b.submit(_chain(5))
+
+
+def test_batcher_engine_failure_is_per_batch_not_fatal():
+    from deepdfa_tpu.serve import MicroBatcher
+
+    eng = _StubEngine(fail_first=True)
+    b = MicroBatcher(eng, max_batch=1, max_wait_ms=1.0).start()
+    with pytest.raises(RuntimeError, match="stub engine failure"):
+        b.submit(_chain(5)).result(timeout=10)
+    # the dispatcher survived the poisoned batch and keeps serving
+    assert b.submit(_chain(5)).result(timeout=10) == 0.25
+    b.stop()
+
+
+def test_batcher_stop_without_drain_fails_pending():
+    from deepdfa_tpu.serve import MicroBatcher
+
+    eng = _StubEngine()
+    b = MicroBatcher(eng, max_queue=8)  # not started: items stay pending
+    fut = b.submit(_chain(5))
+    b.stop(drain=False)
+    with pytest.raises(RuntimeError, match="shutting down"):
+        fut.result(timeout=1)
+    with pytest.raises(RuntimeError, match="draining"):
+        b.submit(_chain(5))
+
+
+def test_batcher_packs_within_bucket_budgets():
+    """More requests than one batch admits → several dispatches, none over
+    the bucket's graph capacity."""
+    from deepdfa_tpu.serve import MicroBatcher
+
+    eng = _StubEngine(max_batch=2)
+    b = MicroBatcher(eng, max_batch=8, max_wait_ms=100.0)
+    futs = [b.submit(_chain(5)) for _ in range(5)]
+    b.start()
+    assert [f.result(timeout=10) for f in futs] == [0.25] * 5
+    assert max(eng.record) <= 2 and sum(eng.record) == 5
+
+
+# ---------------------------------------------------------------------------
+# config surface
+
+
+def test_serve_config_overrides_and_validation():
+    from deepdfa_tpu.config import ServeConfig, load_config
+
+    cfg = load_config(overrides={"serve.max_batch": 4,
+                                 "serve.max_wait_ms": 2.5,
+                                 "serve.cache_entries": 0})
+    assert (cfg.serve.max_batch, cfg.serve.max_wait_ms,
+            cfg.serve.cache_entries) == (4, 2.5, 0)
+    with pytest.raises(ValueError, match="max_batch"):
+        ServeConfig(max_batch=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        ServeConfig(max_queue=0)
+
+
+# ---------------------------------------------------------------------------
+# HTTP server
+
+
+def _req(port, method, path, body=None, timeout=30):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _post_score(port, source, timeout=30):
+    status, data = _req(port, "POST", "/score",
+                        json.dumps({"source": source}), timeout)
+    return status, json.loads(data)
+
+
+@pytest.fixture()
+def server(demo):
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, sources = demo
+    srv = ScoreServer(_StubEngine(vocabs, max_batch=4), vocabs,
+                      ServeConfig(port=0, max_wait_ms=2.0)).start()
+    try:
+        yield srv, sources
+    finally:
+        srv.shutdown()
+
+
+def test_server_scores_then_serves_from_cache(server):
+    srv, sources = server
+    status, body = _post_score(srv.port, sources[0])
+    assert status == 200 and body["cached"] is False
+    assert body["results"][0]["vulnerable_probability"] == 0.25
+    dispatches_before = srv.engine.n_dispatches
+    status, body = _post_score(srv.port, sources[0] + "   \n")  # WS-only edit
+    assert status == 200 and body["cached"] is True
+    assert srv.engine.n_dispatches == dispatches_before  # nothing re-scored
+    assert srv.cache.stats()["hits"] == 1
+
+
+def test_server_rejects_bad_requests_and_stays_up(server):
+    srv, sources = server
+    assert _req(srv.port, "POST", "/score", b"{nope")[0] == 400
+    assert _post_score(srv.port, "")[0] == 400
+    assert _post_score(srv.port, "this is not C {{{")[0] == 422
+    assert _req(srv.port, "GET", "/nope")[0] == 404
+    status, body = _req(srv.port, "GET", "/healthz")
+    assert status == 200 and json.loads(body)["status"] == "ok"
+    assert _post_score(srv.port, sources[0])[0] == 200
+
+
+def test_server_metrics_endpoint_renders_counters(server):
+    srv, sources = server
+    _post_score(srv.port, sources[0])
+    _post_score(srv.port, sources[0])
+    status, data = _req(srv.port, "GET", "/metrics")
+    text = data.decode()
+    assert status == 200
+    for field in ("deepdfa_serve_requests_total", "deepdfa_serve_queue_depth",
+                  "deepdfa_serve_batch_occupancy_mean",
+                  'deepdfa_serve_latency_ms{quantile="0.99"}',
+                  "deepdfa_serve_cache_hits_total",
+                  "deepdfa_serve_cache_hit_rate"):
+        assert field in text, field
+    assert "deepdfa_serve_cache_hits_total 1" in text
+
+
+@pytest.mark.faults
+def test_drop_request_fault_is_503_and_healthz_stays_green(server):
+    from deepdfa_tpu.resilience import faults
+
+    srv, sources = server
+    with faults.installed("serve.drop_request@1"):
+        status, body = _post_score(srv.port, sources[0])
+        assert status == 503 and "drop" in body["error"]
+        assert json.loads(_req(srv.port, "GET", "/healthz")[1])["status"] == "ok"
+        assert _post_score(srv.port, sources[0])[0] == 200
+    assert srv.metrics.snapshot()["dropped_total"] == 1
+
+
+@pytest.mark.faults
+def test_engine_fault_poisons_request_not_server(server):
+    """DEEPDFA_FAULTS=serve.engine_raises@1 semantics: the poisoned
+    request gets a 500, the server keeps serving, and the retry skips the
+    frontend via the encode-layer cache entry the failed request left."""
+    from deepdfa_tpu.resilience import faults
+
+    srv, sources = server
+    with faults.installed("serve.engine_raises@1"):
+        status, body = _post_score(srv.port, sources[1])
+        assert status == 500 and "serve.engine_raises" in body["error"]
+        assert json.loads(_req(srv.port, "GET", "/healthz")[1])["status"] == "ok"
+        status, body = _post_score(srv.port, sources[1])  # retry scores fine
+        assert status == 200 and body["cached"] is False
+    assert srv.cache.stats()["encode_hits"] == 1  # frontend ran ONCE
+
+
+def test_sigterm_drains_inflight_requests_before_exit(demo):
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, sources = demo
+    srv = ScoreServer(_StubEngine(vocabs, delay_s=0.3), vocabs,
+                      ServeConfig(port=0, max_wait_ms=1.0,
+                                  drain_timeout_s=10.0)).start()
+    prev = {s: signal.getsignal(s) for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        srv.install_signal_handlers()
+        got = {}
+
+        def client():
+            got["resp"] = _post_score(srv.port, sources[0])
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        time.sleep(0.1)  # request admitted, batch in flight
+        signal.raise_signal(signal.SIGTERM)
+        snap = srv.wait()  # the drain path the foreground service runs
+        t.join(timeout=10)
+        status, body = got["resp"]
+        assert status == 200  # in-flight request answered, not abandoned
+        assert body["results"][0]["vulnerable_probability"] == 0.25
+        assert snap["responses_total"].get("200") or snap["responses_total"].get(200)
+        # listener is closed: new connections are refused
+        with pytest.raises(OSError):
+            _req(srv.port, "GET", "/healthz", timeout=2)
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+
+def test_draining_server_refuses_new_scores(demo):
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, sources = demo
+    srv = ScoreServer(_StubEngine(vocabs), vocabs,
+                      ServeConfig(port=0, max_wait_ms=1.0)).start()
+    try:
+        srv._draining.set()  # the instant SIGTERM flips before drain ends
+        status, body = _post_score(srv.port, sources[0])
+        assert status == 503 and "draining" in body["error"]
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bench contract
+
+
+def test_serve_bench_schema_and_gates():
+    from bench import assemble_serve_result
+
+    good = dict(backend="cpu", device_kind="cpu", requests_per_sec=50.0,
+                p50_ms=10.0, p99_ms=90.0, mean_batch_occupancy=0.7,
+                cache_hit_rate=0.5, cache_hits=32, requests_total=64,
+                errors_total=0)
+    r = assemble_serve_result(**good)
+    for key in ("metric", "value", "unit", "vs_baseline", "backend",
+                "p50_ms", "p99_ms", "mean_batch_occupancy", "cache_hit_rate",
+                "cache_hits", "requests_total", "errors_total", "ok"):
+        assert key in r, key
+    assert r["metric"] == "serve_requests_per_sec" and r["unit"] == "req/s"
+    assert r["ok"] is True
+    json.dumps(r)  # artifact must be JSON-serializable as-is
+
+    # every acceptance gate flips ok independently
+    assert assemble_serve_result(**{**good, "mean_batch_occupancy": 0.4})["ok"] is False
+    assert assemble_serve_result(**{**good, "cache_hits": 0})["ok"] is False
+    assert assemble_serve_result(**{**good, "errors_total": 1})["ok"] is False
+
+
+def test_bench_serving_uniq_sources_have_distinct_keys():
+    """The cold phase's uniqueness trick must actually produce distinct
+    content addresses AND parseable C."""
+    import bench_serving
+
+    from deepdfa_tpu.cpg.frontend import parse_functions
+    from deepdfa_tpu.pipeline import source_key
+
+    base = "int f(int x) {\n  return x;\n}\n"
+    srcs = [bench_serving._uniq_source(base, i) for i in range(3)]
+    assert len({source_key(s) for s in srcs}) == 3
+    names = [fn for fn, _ in parse_functions(srcs[0])]
+    assert names == ["f", "bench_uniq_0"]

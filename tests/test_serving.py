@@ -113,6 +113,129 @@ def test_servable_rejects_missing_feature_keys(tmp_path):
         servable(crippled)
 
 
+def test_export_roundtrip_node_label_style(tmp_path):
+    """Node-style checkpoints export per-NODE probabilities [max_nodes] —
+    the other deployment shape. The artifact must reproduce the live
+    model and the serve engine must reduce it to per-function scores."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import load_config
+    from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+    from deepdfa_tpu.data.synthetic import random_dataset
+    from deepdfa_tpu.models import make_model
+    from deepdfa_tpu.serving import example_batch, export_ggnn, load_exported
+
+    cfg = load_config(overrides={
+        "model.label_style": "node", "model.hidden_dim": 8,
+        "model.n_steps": 2, "data.batch.batch_graphs": 8,
+        "data.batch.max_nodes": 512, "data.batch.max_edges": 1024})
+    model = make_model(cfg.model, cfg.input_dim)
+    ex = jax.tree.map(jnp.asarray, example_batch(cfg))
+    params = model.init(jax.random.key(1), ex)["params"]
+
+    out = export_ggnn(cfg, params, tmp_path / "node-export")
+    servable = load_exported(out)
+    assert servable.manifest["label_style"] == "node"
+
+    b = cfg.data.batch
+    batcher = GraphBatcher(
+        [BucketSpec(b.batch_graphs + 1, b.max_nodes, b.max_edges)])
+    batch = next(iter(batcher.batches(
+        random_dataset(16, seed=7, input_dim=cfg.input_dim,
+                       mean_nodes=10))))
+    got = servable(batch)
+    want = np.asarray(jax.nn.sigmoid(
+        model.apply({"params": params}, jax.tree.map(jnp.asarray, batch))))
+    assert got.shape == (b.max_nodes,)  # per-node, not per-graph
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # the serve engine's host-side reduction: function score = max over
+    # that function's real nodes (same rule as predict.make_scorer)
+    from deepdfa_tpu.serve import ScoringEngine
+
+    engine = ScoringEngine.from_artifact(out)
+    assert engine.label_style == "node"
+    assert [bk.spec for bk in engine.buckets] == [
+        BucketSpec(b.batch_graphs + 1, b.max_nodes, b.max_edges)]
+    mask = np.asarray(batch.node_mask)
+    gidx = np.asarray(batch.node_gidx)
+    fn_probs = engine._score_fn(batch)
+    for gi in np.unique(gidx[mask]):
+        sel = mask & (gidx == gi)
+        np.testing.assert_allclose(fn_probs[gi], want[sel].max(), rtol=1e-6)
+
+
+def test_occlusion_saliency_spans_two_buckets():
+    """One scan over two very different function sizes: occlusion pads
+    per-function ([chunk] copies at the function's OWN size), so the two
+    functions compile two distinct shapes through ONE jitted scorer and
+    both come back with the exact masking-math saliency."""
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.data.graphs import Graph
+    from deepdfa_tpu.ops.segment import segment_sum
+    from deepdfa_tpu.predict import occlusion_saliency
+
+    def scorer(params, batch):
+        vals = batch.node_feats["_ABS_DATAFLOW"].astype(jnp.float32)
+        vals = jnp.where(batch.node_mask, vals, 0.0)
+        return segment_sum(vals, batch.node_gidx, batch.max_graphs), vals
+
+    def make(n):
+        return Graph(
+            senders=np.arange(n - 1, dtype=np.int32),
+            receivers=np.arange(1, n, dtype=np.int32),
+            node_feats={"_VULN": np.zeros(n, np.int32),
+                        "_ABS_DATAFLOW": np.arange(1, n + 1, dtype=np.int32)},
+        ).with_self_loops()
+
+    small, large = make(6), make(40)  # 6*16 vs 40*16 nodes: distinct shapes
+    for g, n in ((small, 6), (large, 40)):
+        sal = occlusion_saliency(scorer, None, g, n, chunk=16)
+        np.testing.assert_allclose(sal, np.arange(1, n + 1, dtype=np.float32))
+
+
+def test_load_exported_warns_on_vocab_hash_mismatch(tmp_path):
+    """The stale-artifact guard: an artifact exported against one training
+    vocabulary, loaded by a server encoding with another, warns loudly;
+    matching or hashless artifacts load silently."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import load_config
+    from deepdfa_tpu.models import make_model
+    from deepdfa_tpu.serving import example_batch, export_ggnn, load_exported
+
+    cfg = load_config(overrides={
+        "model.hidden_dim": 8, "model.n_steps": 2,
+        "data.batch.batch_graphs": 4, "data.batch.max_nodes": 256,
+        "data.batch.max_edges": 512})
+    model = make_model(cfg.model, cfg.input_dim)
+    ex = jax.tree.map(jnp.asarray, example_batch(cfg))
+    params = model.init(jax.random.key(0), ex)["params"]
+
+    out = export_ggnn(cfg, params, tmp_path / "hashed",
+                      vocab_hash="aaaa000011112222")
+    manifest = json.loads((out / "manifest.json").read_text())
+    assert manifest["vocab_hash"] == "aaaa000011112222"
+    assert manifest["package_version"]
+
+    with pytest.warns(UserWarning, match="vocab hash mismatch"):
+        load_exported(out, expect_vocab_hash="bbbb444455556666")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # matching hash: silence
+        load_exported(out, expect_vocab_hash="aaaa000011112222")
+        load_exported(out)  # caller without a hash: silence
+
+    legacy = export_ggnn(cfg, params, tmp_path / "hashless")  # no hash recorded
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        load_exported(legacy, expect_vocab_hash="bbbb444455556666")
+
+
 def test_export_cli_requires_checkpoint(tmp_path):
     """export serializes a TRAINED model — no checkpoint is a clear error,
     not a silently-exported fresh init."""
