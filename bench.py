@@ -590,6 +590,55 @@ def bench_sentinel_overhead(batches, steps: int = 20, dtype: str = "bfloat16",
     }
 
 
+def bench_emergency_ckpt(batches, repeats: int = 3):
+    """Emergency-checkpoint commit latency: a real model state saved through
+    ``CheckpointManager.save_emergency`` (the SIGTERM path) must land inside
+    the ``ResilienceConfig.preempt_deadline_s`` budget — the whole point of
+    the preemption contract is that the grace window is long enough for the
+    atomic tmp-dir + os.replace commit. Min of ``repeats`` (best case on a
+    loaded host; a cold filesystem outlier must not fail the guard)."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import ExperimentConfig, ResilienceConfig
+    from deepdfa_tpu.models import make_model
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+    from deepdfa_tpu.train.loop import Trainer
+
+    deadline_s = ResilienceConfig().preempt_deadline_s
+    cfg = ExperimentConfig()
+    model = make_model(cfg.model, input_dim=cfg.input_dim)
+    trainer = Trainer(model=model, cfg=cfg, pos_weight=15.0)
+    state = trainer.init_state(jax.tree.map(jnp.asarray, batches[0]))
+    aux = {"opt_state": state.opt_state,
+           "rng": jax.random.key_data(state.rng),
+           "step": state.step}
+    work = tempfile.mkdtemp(prefix="bench_emergency_")
+    try:
+        commits = []
+        for i in range(repeats):
+            ckpts = CheckpointManager(Path(work) / f"r{i}", cfg.checkpoint)
+            commits.append(ckpts.save_emergency(
+                i, {"params": state.params}, epoch=0, aux=aux,
+                mesh={"devices": jax.device_count(),
+                      "platform": jax.default_backend(), "axes": None},
+                steps_done=1,
+            ))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    best = min(commits)
+    return {
+        "commit_s": round(best, 3),
+        "commits_s": [round(c, 3) for c in commits],
+        "deadline_s": deadline_s,
+        "ok": best <= deadline_s,
+    }
+
+
 def bench_torch_cpu(batches, steps: int):
     """Same-semantics torch-CPU inference baseline (real graphs/sec)."""
     import torch
@@ -682,11 +731,27 @@ def _init_backend_with_retry(attempts: int = 5, backoff_s: float = 60.0):
     failures like a plugin/version mismatch fail fast) and only under a
     single-platform pin: with several platforms listed, jax caches whichever
     initialized before the failure and a retry would silently 'recover' onto
-    the fallback. A *hang* here is the other failure mode; the stage marker
-    above each attempt leaves a diagnosable tail for it."""
+    the fallback. A *hang* is the other failure mode: the first device touch
+    runs under a ``HangWatchdog`` deadline (``BENCH_DEVICE_INIT_TIMEOUT_S``,
+    default 1800s — comfortably past a slow-but-live tunnel grant), so a
+    wedged grant surfaces as a diagnosable ``WatchdogTimeout`` instead of an
+    unbounded stall. Timeouts are NOT retried — a wedged grant does not
+    unwedge, and the parked attempt still owns the backend lock."""
     import os
 
     import jax
+
+    from deepdfa_tpu.resilience import HangWatchdog
+
+    deadline_s = float(os.environ.get("BENCH_DEVICE_INIT_TIMEOUT_S", "1800"))
+    watchdog = HangWatchdog(
+        deadline_s,
+        on_timeout=lambda point, d: _progress(
+            f"device backend init exceeded {d:.0f}s — wedged tunnel grant"),
+    )
+
+    def _touch():
+        return jax.default_backend(), jax.devices()[0].device_kind
 
     multi_platform = "," in os.environ.get("JAX_PLATFORMS", "")
     for attempt in range(attempts):
@@ -695,7 +760,7 @@ def _init_backend_with_retry(attempts: int = 5, backoff_s: float = 60.0):
             "a wedged tunnel grant hangs HERE)"
         )
         try:
-            return jax.default_backend(), jax.devices()[0].device_kind
+            return watchdog.call("device_init", _touch)
         except RuntimeError as e:
             retryable = "UNAVAILABLE" in str(e) and not multi_platform
             if attempt == attempts - 1 or not retryable:
@@ -1400,7 +1465,7 @@ def main():
     dense = dense_occ = dense_real = None
     dense_error = dense_dropped = dense_by_shape = None
     fused = fused_real = fused_error = None
-    chained_train = strict = sentinel_stats = None
+    chained_train = strict = sentinel_stats = emergency_stats = None
     peak_runs: dict[str, tuple] = {}
     peak_errors: dict[str, str] = {}
     base_gps = None
@@ -1424,6 +1489,8 @@ def main():
         r["partial_through_stage"] = stage
         if sentinel_stats is not None:
             r["sentinel"] = sentinel_stats
+        if emergency_stats is not None:
+            r["emergency_ckpt"] = emergency_stats
         tmp = partial_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(r, f)
@@ -1463,6 +1530,20 @@ def main():
         except Exception as e:  # recorded verbatim, never swallowed
             sentinel_stats = {"error": f"{type(e).__name__}: {e}"}
         bank("sentinel")
+        # Resilience invariant guard #2: the SIGTERM emergency checkpoint
+        # must commit within the preempt_deadline_s grace budget — a real
+        # model state through the atomic save path, timed end-to-end.
+        _progress("emergency-checkpoint commit latency")
+        try:
+            emergency_stats = bench_emergency_ckpt(batches)
+            if not emergency_stats["ok"]:
+                _progress(
+                    f"WARNING: emergency checkpoint commit "
+                    f"{emergency_stats['commit_s']:.1f}s exceeds the "
+                    f"{emergency_stats['deadline_s']:.0f}s preemption budget")
+        except Exception as e:  # recorded verbatim, never swallowed
+            emergency_stats = {"error": f"{type(e).__name__}: {e}"}
+        bank("emergency_ckpt")
 
     # Peak throughput at superbatches: same model, larger static batches -
     # bigger kernels per dispatch, higher arithmetic intensity. Failures are
@@ -1549,6 +1630,8 @@ def main():
         dense_by_shape, fused, fused_real, fused_error, FUSED_BATCH_GRAPHS)
     if sentinel_stats is not None:
         result["sentinel"] = sentinel_stats
+    if emergency_stats is not None:
+        result["emergency_ckpt"] = emergency_stats
     print(json.dumps(result))
 
 

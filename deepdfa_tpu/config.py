@@ -240,6 +240,10 @@ class ResilienceConfig:
     sentinel_lag: int = 2  # host checks the loss N steps behind (no sync stall)
     lr_backoff: float = 0.5  # LR scale applied per rollback
     max_rollbacks: int = 3  # rollbacks before the run gives up
+    # preemption & elasticity (resilience/preemption.py, resilience/watchdog.py)
+    emergency_ckpt: bool = True  # SIGTERM/SIGUSR1 → step-boundary emergency save
+    preempt_deadline_s: float = 30.0  # emergency-commit latency budget
+    step_deadline_s: float = 0.0  # hung-collective watchdog per-step deadline; 0 = off
 
     def __post_init__(self):
         if self.sentinel_patience < 1:
@@ -250,6 +254,10 @@ class ResilienceConfig:
             raise ValueError("lr_backoff must be in (0, 1]")
         if self.max_rollbacks < 0:
             raise ValueError("max_rollbacks must be >= 0")
+        if self.preempt_deadline_s <= 0:
+            raise ValueError("preempt_deadline_s must be > 0")
+        if self.step_deadline_s < 0:
+            raise ValueError("step_deadline_s must be >= 0 (0 disables)")
 
 
 @dataclass(frozen=True)

@@ -39,6 +39,7 @@ __all__ = [
     "padding_efficiency",
     "save_shards",
     "load_shards",
+    "ShardIntegrityError",
 ]
 
 
@@ -333,13 +334,33 @@ def padding_efficiency(batches: Sequence[BatchedGraphs]) -> dict[str, float]:
     }
 
 
+class ShardIntegrityError(RuntimeError):
+    """A materialised shard failed its sha256 manifest check — names the
+    corrupt shard so the operator can re-materialise it, instead of a
+    downstream npz/pickle decode crash pointing nowhere."""
+
+
+def _sha256_file(path) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
 def save_shards(graphs: Sequence[Graph], out_dir, shard_size: int = 4096) -> int:
-    """Write graphs to ``shard_{i:05d}.npz`` files (replaces ``graphs.bin``)."""
+    """Write graphs to ``shard_{i:05d}.npz`` files (replaces ``graphs.bin``)
+    plus a ``manifest.json`` recording each shard's sha256 + graph count —
+    :func:`load_shards` verifies the hashes before decoding anything."""
+    import json
     from pathlib import Path
 
     out = Path(out_dir)
     out.mkdir(parents=True, exist_ok=True)
     n_shards = 0
+    manifest: dict[str, dict] = {}
     for si in range(0, len(graphs), shard_size):
         chunk = graphs[si : si + shard_size]
         payload: dict[str, np.ndarray] = {
@@ -350,16 +371,63 @@ def save_shards(graphs: Sequence[Graph], out_dir, shard_size: int = 4096) -> int
             payload[f"r{i}"] = g.receivers.astype(np.int32)
             for key, val in g.node_feats.items():
                 payload[f"f{i}:{key}"] = val
-        np.savez_compressed(out / f"shard_{n_shards:05d}.npz", **payload)
+        name = f"shard_{n_shards:05d}.npz"
+        np.savez_compressed(out / name, **payload)
+        manifest[name] = {"sha256": _sha256_file(out / name), "graphs": len(chunk)}
         n_shards += 1
+    # atomic sidecar (journal protocol): a crash mid-write must not leave a
+    # torn manifest that poisons every future load
+    from deepdfa_tpu.resilience.journal import atomic_write_text
+
+    atomic_write_text(
+        out / "manifest.json",
+        json.dumps({"schema": 1, "shards": manifest}, indent=2),
+    )
     return n_shards
 
 
 def load_shards(in_dir) -> list[Graph]:
+    """Load materialised shards; when a ``manifest.json`` is present (every
+    corpus written since the manifest landed) each shard's sha256 is
+    verified FIRST — a flipped bit or truncated file raises
+    :class:`ShardIntegrityError` naming the corrupt shard. Legacy dirs
+    without a manifest load unverified."""
+    import json
+    import logging
     from pathlib import Path
 
+    shard_files = sorted(Path(in_dir).glob("shard_*.npz"))
+    manifest_file = Path(in_dir) / "manifest.json"
+    if manifest_file.exists():
+        entries = json.loads(manifest_file.read_text()).get("shards", {})
+        on_disk = {p.name for p in shard_files}
+        missing = sorted(set(entries) - on_disk)
+        if missing:
+            raise ShardIntegrityError(
+                f"shard(s) listed in {manifest_file} but missing on disk: "
+                f"{', '.join(missing)}"
+            )
+        for shard in shard_files:
+            entry = entries.get(shard.name)
+            if entry is None:
+                raise ShardIntegrityError(
+                    f"shard {shard.name} present on disk but not in "
+                    f"{manifest_file} — stale or foreign file in the shard dir"
+                )
+            digest = _sha256_file(shard)
+            if digest != entry["sha256"]:
+                logging.getLogger(__name__).error(
+                    "shard integrity failure: %s sha256 %s != recorded %s",
+                    shard, digest, entry["sha256"],
+                )
+                raise ShardIntegrityError(
+                    f"shard {shard.name} is corrupt: sha256 {digest[:12]}… does "
+                    f"not match the manifest ({entry['sha256'][:12]}…) — "
+                    "re-materialise the corpus"
+                )
+
     graphs: list[Graph] = []
-    for shard in sorted(Path(in_dir).glob("shard_*.npz")):
+    for shard in shard_files:
         with np.load(shard) as z:
             gids = z["gids"]
             for i, gid in enumerate(gids):

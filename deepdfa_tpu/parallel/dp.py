@@ -80,6 +80,7 @@ def make_dp_train_step(
     pos_weight: float | None = None,
     undersample_node_on_loss_factor: float | None = None,
     donate: bool = True,
+    accum: int = 1,
 ) -> Callable:
     """Compile the SPMD train step.
 
@@ -87,7 +88,18 @@ def make_dp_train_step(
     (state, metrics, loss)`` where ``stacked_batch`` has a leading ``dp``
     axis. Params/opt-state/metrics are replicated; the gradient all-reduce is
     a single fused psum over ICI.
+
+    ``accum > 1`` enables gradient accumulation for mesh-elastic resume:
+    each shard processes ``accum`` microbatches (stacked as ``[dp, accum,
+    ...]`` by :func:`deepdfa_tpu.parallel.elastic.stack_elastic`), summing
+    loss/weight/gradient contributions before the psum — a ``dp=N/k,
+    accum=k`` step consumes the same global batch (and folds the same
+    per-batch rng streams: microbatch ``i`` on shard ``j`` uses fold-in
+    index ``j*accum + i``) as the original ``dp=N`` step, so metrics match
+    up to float reassociation in the reductions.
     """
+    if accum < 1:
+        raise ValueError("accum must be >= 1")
     from deepdfa_tpu.train.loop import _node_loss_undersample_weights
 
     def local_loss(params, batch, rng):
@@ -103,24 +115,41 @@ def make_dp_train_step(
         return lsum, (logits, labels, weights)
 
     def spmd_step(state: TrainState, batch: BatchedGraphs, metrics: ConfusionState):
-        # Per-shard batch arrives with the dp axis split off by shard_map.
+        # Per-shard batch arrives with the dp axis split off by shard_map:
+        # [1, ...] for accum == 1, [1, accum, ...] for the accumulating step.
         batch = jax.tree.map(lambda x: x[0], batch)
         axis_idx = jax.lax.axis_index("dp")
         rng, sub = jax.random.split(state.rng)
-        sub = jax.random.fold_in(sub, axis_idx)
-        (lsum, (logits, labels, weights)), grads = jax.value_and_grad(
-            local_loss, has_aux=True
-        )(state.params, batch, sub)
+        micros = (
+            [batch]
+            if accum == 1
+            else [jax.tree.map(lambda x: x[i], batch) for i in range(accum)]
+        )
+        lsum = jnp.zeros(())
+        local_w = jnp.zeros(())
+        grads = None
+        local = ConfusionState.zeros()
+        for i, mb in enumerate(micros):
+            # fold-in index = the flat batch index this (shard, micro) slot
+            # consumes under stack_elastic's layout — identical rng streams
+            # whether the batch ran as dp=N or dp=N/k with accum=k
+            sub_i = jax.random.fold_in(sub, axis_idx * accum + i)
+            (ls, (logits, labels, weights)), g = jax.value_and_grad(
+                local_loss, has_aux=True
+            )(state.params, mb, sub_i)
+            lsum = lsum + ls
+            local_w = local_w + jnp.sum(weights)
+            grads = g if grads is None else jax.tree.map(jnp.add, grads, g)
+            probs = jax.nn.sigmoid(logits)
+            local = update_confusion(local, probs, labels, weights > 0)
         grads = jax.lax.psum(grads, "dp")
         lsum = jax.lax.psum(lsum, "dp")
-        wsum = jax.lax.psum(jnp.sum(weights), "dp")
+        wsum = jax.lax.psum(local_w, "dp")
         loss = lsum / jnp.maximum(wsum, 1.0)
         # Grads are sums over examples; normalise to the global weighted mean.
         grads = jax.tree.map(lambda g: g / jnp.maximum(wsum, 1.0), grads)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        probs = jax.nn.sigmoid(logits)
-        local = update_confusion(ConfusionState.zeros(), probs, labels, weights > 0)
         delta = jax.lax.psum(local, "dp")
         metrics = ConfusionState(*(m + d for m, d in zip(metrics, delta)))
         return TrainState(params, opt_state, rng, state.step + 1), metrics, loss, wsum

@@ -1,0 +1,142 @@
+"""Mesh-elastic checkpoint restore: resume a ``dp=N`` run on a ``dp=M`` mesh.
+
+A checkpoint saved on one topology must not strand the run when the fleet
+hands back a different slice (half the hosts, a single-device debug box).
+Three pieces make the move safe:
+
+- every ``meta.json`` records a :func:`mesh_block` (device count + named
+  axis sizes) at save time;
+- on restore, :func:`mesh_changed` compares the recorded block against the
+  current topology; on mismatch :func:`reshard_tree` rehydrates the arrays
+  host-side (``device_get`` → fully-addressable numpy) and re-places them
+  under the new mesh's replicated sharding — params/opt-state are
+  replicated over ``dp``, so replication is the correct target sharding
+  and the values are **bit-identical** by construction;
+- :func:`stack_elastic` regroups the *same* flat batch sequence for the
+  new mesh: ``dp=N`` consumed batches ``[j]`` per global step, ``dp=N/k``
+  with ``accum=k`` microbatching consumes ``[j*k + i]`` at shard ``j``
+  micro-step ``i`` — together with the rng fold-in layout in
+  :func:`deepdfa_tpu.parallel.dp.make_dp_train_step` this preserves the
+  global batch order (and the per-batch rng streams) across the mesh
+  change, up to float reassociation in the gradient reduction.
+
+The single-device trainer records ``axes=None``; a device-count change
+alone (e.g. an 8-way CPU test harness resuming on 1 device) still routes
+through the reshard path, which is then a plain host round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "mesh_block",
+    "mesh_changed",
+    "host_gather",
+    "reshard_tree",
+    "elastic_restore",
+    "stack_elastic",
+]
+
+
+def mesh_block(mesh: Mesh | None = None) -> dict:
+    """JSON-serialisable topology record for ``meta.json``. Without a mesh
+    (the single-device trainer) the block still pins the device count, so
+    an elastic resume on a different-size harness is detected."""
+    if mesh is None:
+        return {
+            "devices": int(jax.device_count()),
+            "platform": str(jax.default_backend()),
+            "axes": None,
+        }
+    return {
+        "devices": int(mesh.devices.size),
+        "platform": str(jax.default_backend()),
+        "axes": {name: int(s) for name, s in zip(mesh.axis_names, mesh.devices.shape)},
+    }
+
+
+def mesh_changed(recorded: dict | None, current: dict) -> bool:
+    """Does the recorded topology differ from the current one? Missing
+    record (pre-elastic checkpoints) → no reshard, restore as-is."""
+    if not recorded:
+        return False
+    return (
+        recorded.get("devices") != current.get("devices")
+        or recorded.get("axes") != current.get("axes")
+    )
+
+
+def host_gather(tree: Any) -> Any:
+    """Pull every leaf to fully-addressable host numpy — the first half of
+    the reshard (works for replicated and sharded arrays alike)."""
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def reshard_tree(tree: Any, mesh: Mesh | None = None) -> Any:
+    """Host-side gather → re-place under ``mesh``'s replicated sharding
+    (or default single-device placement when ``mesh`` is ``None``). Values
+    are untouched: the move is topological, bit-identical."""
+    gathered = host_gather(tree)
+    if mesh is None:
+        return jax.tree.map(jnp.asarray, gathered)
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), gathered)
+
+
+def elastic_restore(
+    ckpts,
+    template: Any | None = None,
+    aux_template: Any | None = None,
+    mesh: Mesh | None = None,
+) -> tuple[int, dict, Any, Any, bool]:
+    """``restore_resume`` + the reshard path: ``(step, meta, state, aux,
+    resharded)``. When the checkpoint's recorded mesh block differs from
+    the current topology, both payloads are rehydrated host-side and
+    re-placed; otherwise they come back exactly as ``restore_resume``
+    produced them."""
+    step, meta, state, aux = ckpts.restore_resume(template, aux_template)
+    current = mesh_block(mesh)
+    resharded = False
+    if mesh_changed(meta.get("mesh"), current):
+        state = reshard_tree(state, mesh)
+        if aux is not None:
+            aux = reshard_tree(aux, mesh)
+        resharded = True
+    return step, meta, state, aux, resharded
+
+
+def stack_elastic(flat_batches: list, dp: int, accum: int = 1) -> list:
+    """Regroup a flat same-bucket batch sequence for a ``dp``-way mesh with
+    ``accum`` gradient-accumulation microbatches per shard.
+
+    One global step consumes ``dp * accum`` consecutive flat batches;
+    shard ``j`` takes slots ``[j*accum, (j+1)*accum)`` so that flat batch
+    ``k`` lands on the shard/micro position whose rng fold-in index is
+    ``k`` — the same assignment ``dp = dp*accum, accum = 1`` would use.
+    ``accum == 1`` returns the classic ``[dp, ...]`` stacks; ``accum > 1``
+    returns ``[dp, accum, ...]`` stacks for the accumulating step."""
+    from deepdfa_tpu.parallel.dp import stack_batches
+
+    if dp < 1 or accum < 1:
+        raise ValueError("dp and accum must be >= 1")
+    per = dp * accum
+    if len(flat_batches) % per:
+        raise ValueError(
+            f"{len(flat_batches)} batches do not divide into global steps of "
+            f"dp*accum = {per}"
+        )
+    out = []
+    for g0 in range(0, len(flat_batches), per):
+        group = flat_batches[g0 : g0 + per]
+        if accum == 1:
+            out.append(stack_batches(group))
+            continue
+        inner = [stack_batches(group[j * accum : (j + 1) * accum]) for j in range(dp)]
+        out.append(jax.tree.map(lambda *xs: np.stack(xs, axis=0), *inner))
+    return out

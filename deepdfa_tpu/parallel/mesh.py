@@ -15,15 +15,20 @@ XLA inserts the collectives.
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 import jax
 from jax.sharding import Mesh
 
 from deepdfa_tpu.config import MeshConfig
+from deepdfa_tpu.resilience import faults
 
 AXES = ("dp", "fsdp", "tp", "sp")
 
-__all__ = ["AXES", "build_mesh", "local_mesh", "initialize_multihost"]
+__all__ = ["AXES", "build_mesh", "local_mesh", "initialize_multihost", "probed_devices"]
+
+logger = logging.getLogger(__name__)
 
 
 def build_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
@@ -33,8 +38,20 @@ def build_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
     ICI-contiguous, so the fastest-varying axes (tp, sp) land on neighbouring
     chips and dp spans the slower links — collectives ride ICI, DCN only
     crosses hosts on the leading axis.
+
+    The ``mesh.device_lost`` fault point halves the visible device list —
+    the lost-host scenario: the surviving slice builds a smaller mesh (a
+    ``dp=-1`` config absorbs the shrink) and the elastic resume path
+    (:mod:`deepdfa_tpu.parallel.elastic`) carries the run across.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
+    if faults.fire("mesh.device_lost"):
+        survivors = max(1, len(devices) // 2)
+        logger.warning(
+            "injected mesh.device_lost: %d of %d devices survive",
+            survivors, len(devices),
+        )
+        devices = devices[:survivors]
     sizes = cfg.axis_sizes(len(devices))
     shape = tuple(sizes[a] for a in AXES)
     dev_array = np.array(devices).reshape(shape)
@@ -53,6 +70,20 @@ def local_mesh(n_devices: int | None = None, **axis_sizes: int) -> Mesh:
     if "dp" not in axis_sizes:
         sizes["dp"] = -1
     return build_mesh(MeshConfig(**sizes), devices)
+
+
+def probed_devices(deadline_s: float, on_timeout=None) -> list:
+    """Device init behind the hung-collective watchdog: the first
+    ``jax.devices()`` touch initialises the backend, which on a wedged
+    device grant blocks forever (BENCH_r05: >2000 s with zero signal).
+    Raises :class:`~deepdfa_tpu.resilience.watchdog.WatchdogTimeout` after
+    ``deadline_s`` instead — callers journal and abort/fall back cleanly.
+    The bench device probe routes through the same wrapper."""
+    from deepdfa_tpu.resilience.watchdog import HangWatchdog
+
+    return HangWatchdog(deadline_s, on_timeout=on_timeout).call(
+        "device_init", jax.devices
+    )
 
 
 def initialize_multihost(coordinator: str | None = None, num_processes: int | None = None,

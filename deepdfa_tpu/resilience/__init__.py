@@ -11,7 +11,11 @@ Four pieces, wired through ``train``, ``data`` and ``cpg``:
   turns non-finite train steps into checkpoint rollback + LR backoff
   instead of a dead run;
 - :mod:`~deepdfa_tpu.resilience.retry` / ``supervisor`` — capped-backoff
-  retry and the Joern session supervisor with poison-function quarantine.
+  retry and the Joern session supervisor with poison-function quarantine;
+- :mod:`~deepdfa_tpu.resilience.preemption` — SIGTERM/SIGUSR1 → flag →
+  step-boundary emergency checkpoint → resumable rc 75;
+- :mod:`~deepdfa_tpu.resilience.watchdog` — deadline wrapper turning a
+  wedged device call or hung collective into a journaled timeout abort.
 
 Invariants this package guarantees (recorded in ROADMAP "Open items"):
 a checkpoint step dir either has a committed ``meta.json`` or is garbage;
@@ -22,8 +26,15 @@ costs one report row, never the corpus.
 
 from deepdfa_tpu.resilience import faults
 from deepdfa_tpu.resilience.journal import RunJournal, atomic_write_text, fsync_dir
+from deepdfa_tpu.resilience.preemption import (
+    PREEMPTED_RC,
+    Preempted,
+    PreemptedExit,
+    PreemptionHandler,
+)
 from deepdfa_tpu.resilience.retry import RetryExhausted, RetryPolicy, retry_call
 from deepdfa_tpu.resilience.sentinel import DivergenceError, DivergenceSentinel
+from deepdfa_tpu.resilience.watchdog import HangWatchdog, WatchdogTimeout
 from deepdfa_tpu.resilience.supervisor import (
     ExtractionSupervisor,
     QuarantinedError,
@@ -35,6 +46,12 @@ __all__ = [
     "RunJournal",
     "atomic_write_text",
     "fsync_dir",
+    "PREEMPTED_RC",
+    "Preempted",
+    "PreemptedExit",
+    "PreemptionHandler",
+    "HangWatchdog",
+    "WatchdogTimeout",
     "RetryExhausted",
     "RetryPolicy",
     "retry_call",

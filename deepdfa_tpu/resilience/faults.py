@@ -36,6 +36,18 @@ Known points (grep for ``faults.fire(`` / ``crash_if`` / ``raise_if``):
 ``serve.engine_raises``                  raise inside the scoring engine —
                                          that batch's requests get 500s,
                                          the dispatcher survives (serve)
+``preempt.sigterm``                      flag a preemption notice at a train
+                                         step boundary, as if SIGTERM had
+                                         arrived — drives the emergency-
+                                         checkpoint path (train/loop)
+``mesh.device_lost``                     halve the device list handed to
+                                         ``build_mesh`` — a lost host; the
+                                         surviving slice builds a smaller
+                                         mesh (parallel/mesh)
+``step.hang``                            wedge one train step: a cancel-
+                                         aware sleep the HangWatchdog must
+                                         convert into a bounded, journaled
+                                         timeout abort (train/loop)
 =======================================  ====================================
 """
 
@@ -74,6 +86,9 @@ KNOWN_POINTS = (
     "joern.die",
     "serve.drop_request",
     "serve.engine_raises",
+    "preempt.sigterm",
+    "mesh.device_lost",
+    "step.hang",
 )
 
 

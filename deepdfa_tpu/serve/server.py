@@ -85,7 +85,10 @@ class ScoreServer:
 
     @property
     def draining(self) -> bool:
-        return self._draining.is_set()
+        # a requested-but-not-yet-started drain counts: from the instant
+        # SIGTERM lands, /healthz must stop advertising this replica so the
+        # LB routes elsewhere while in-flight work finishes
+        return self._draining.is_set() or self._stop_requested.is_set()
 
     def start(self) -> "ScoreServer":
         self._serve_thread = threading.Thread(
@@ -138,7 +141,7 @@ class ScoreServer:
         source = payload.get("source") if isinstance(payload, dict) else None
         if not isinstance(source, str) or not source.strip():
             return 400, {"error": "body must be JSON with a 'source' string"}
-        if self._draining.is_set():
+        if self.draining:
             return 503, {"error": "server is draining"}
         if faults.fire("serve.drop_request"):
             self.metrics.inc("dropped_total")
@@ -213,9 +216,14 @@ def _make_handler(server: ScoreServer):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, {"status": "ok",
-                                 "draining": server.draining,
-                                 "label_style": server.engine.label_style})
+                # distinct draining state + 503 once SIGTERM is received:
+                # LB health checks key on the status code, so the replica
+                # drops out of rotation before the drain completes
+                draining = server.draining
+                self._send(503 if draining else 200,
+                           {"status": "draining" if draining else "ok",
+                            "draining": draining,
+                            "label_style": server.engine.label_style})
             elif self.path == "/metrics":
                 self._send(200, server.metrics.render(server.cache.stats()),
                            content_type="text/plain; version=0.0.4")

@@ -113,14 +113,25 @@ class CheckpointManager:
         metrics: dict[str, float] | None = None,
         epoch: int | None = None,
         aux: Any | None = None,
+        mesh: dict | None = None,
+        preempted: dict | None = None,
+        force: bool = False,
     ) -> bool:
         """Save if any policy wants this step; apply retention. Returns
         whether a checkpoint was written. ``aux`` is a second pytree saved
         alongside ``state`` (the trainer's opt-state/rng for ``--resume``)
         — restored via :meth:`restore_aux`, invisible to plain
-        :meth:`restore` callers."""
+        :meth:`restore` callers.
+
+        ``mesh`` (a :func:`deepdfa_tpu.parallel.elastic.mesh_block`) and
+        ``preempted`` (``{"steps_done": n, "reason": ...}``) land in
+        ``meta.json`` for the elastic/preemption resume paths. ``force``
+        bypasses the policies — the emergency-checkpoint path must commit
+        regardless of what save_last/periodic/best would decide."""
         metrics = {k: float(v) for k, v in (metrics or {}).items()}
         reasons = []
+        if force:
+            reasons.append("emergency")
         if self.cfg.save_last:
             reasons.append("last")
         if epoch is not None and self.cfg.periodic_every and (
@@ -147,6 +158,10 @@ class CheckpointManager:
             self._ckptr.save(tmp / "aux", aux)
         faults.crash_if("ckpt.crash_between_state_and_meta")
         meta = dict(step=int(step), epoch=epoch, metrics=metrics, reasons=reasons)
+        if mesh is not None:
+            meta["mesh"] = dict(mesh)
+        if preempted is not None:
+            meta["preempted"] = dict(preempted)
         (tmp / "meta.json").write_text(json.dumps(meta))
         if path.exists():
             shutil.rmtree(path)
@@ -159,6 +174,38 @@ class CheckpointManager:
         self._saved.sort(key=lambda m: m["step"])
         self._retain()
         return True
+
+    def save_emergency(
+        self,
+        step: int,
+        state: Any,
+        *,
+        epoch: int | None,
+        aux: Any | None = None,
+        mesh: dict | None = None,
+        steps_done: int = 0,
+        reason: str = "preempted",
+    ) -> float:
+        """Preemption-path save: force-commit through the ordinary atomic
+        protocol with a ``preempted`` meta block recording how far into the
+        epoch the run got (the resume path replays the deterministic epoch
+        stream and skips exactly ``steps_done`` batches). Returns the
+        wall-clock commit latency in seconds — the caller checks it against
+        ``resilience.preempt_deadline_s`` and journals the result."""
+        import time
+
+        t0 = time.monotonic()
+        self.save(
+            step,
+            state,
+            metrics={},
+            epoch=epoch,
+            aux=aux,
+            mesh=mesh,
+            preempted={"steps_done": int(steps_done), "reason": reason},
+            force=True,
+        )
+        return time.monotonic() - t0
 
     def _is_best(self, value: float) -> bool:
         best = self.best_metric()

@@ -338,7 +338,17 @@ def _tb_writer(run_dir: Path):
 
 
 def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str, float]:
-    from deepdfa_tpu.resilience import DivergenceError, DivergenceSentinel, RunJournal
+    from deepdfa_tpu.parallel.elastic import mesh_block
+    from deepdfa_tpu.resilience import (
+        DivergenceError,
+        DivergenceSentinel,
+        HangWatchdog,
+        Preempted,
+        PreemptedExit,
+        PreemptionHandler,
+        RunJournal,
+        WatchdogTimeout,
+    )
     from deepdfa_tpu.train.loop import TrainState
 
     corpus = load_corpus(cfg)
@@ -368,6 +378,11 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
     )
     tuning_file = run_dir / "tuning.jsonl"
     tb = _tb_writer(run_dir)
+    topology = mesh_block()  # recorded in every meta.json for elastic resume
+    preemption = PreemptionHandler().install() if res.emergency_ckpt else None
+    watchdog = (
+        HangWatchdog(res.step_deadline_s) if res.step_deadline_s > 0 else None
+    )
 
     def _aux(s: TrainState) -> dict:
         # the trainer state beyond params — what bit-identical resume needs
@@ -380,13 +395,24 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
 
     aux_template = _aux(state)
 
-    def _restore_full(reason: str) -> tuple[TrainState, int]:
-        """(restored TrainState, checkpointed epoch); walks past corrupt
+    def _restore_full(reason: str) -> tuple[TrainState, dict]:
+        """(restored TrainState, checkpoint meta); walks past corrupt
         steps (restore_resume), so a damaged newest checkpoint falls back
-        to the previous good one."""
-        step, meta, payload, aux = ckpts.restore_resume(
-            template={"params": state.params}, aux_template=aux_template
+        to the previous good one. A checkpoint recorded under a different
+        mesh/topology (elastic resume: dp=N run coming back on a smaller
+        harness) is rehydrated host-side and re-placed — values are
+        bit-identical, only the placement changes."""
+        from deepdfa_tpu.parallel.elastic import elastic_restore
+
+        step, meta, payload, aux, resharded = elastic_restore(
+            ckpts, template={"params": state.params}, aux_template=aux_template
         )
+        if resharded:
+            logger.warning(
+                "%s: mesh changed since checkpoint (%s -> %s) — "
+                "host-gathered and re-placed params/opt-state", reason,
+                meta.get("mesh"), topology,
+            )
         restored = TrainState(
             payload["params"],
             aux["opt_state"],
@@ -395,10 +421,14 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
         )
         logger.info("%s: restored checkpoint step=%d (epoch %s)",
                     reason, step, meta.get("epoch"))
-        return restored, int(meta.get("epoch", -1))
+        meta = dict(meta)
+        meta["_resharded"] = resharded
+        return restored, meta
 
     start_epoch = 0
     n_rollbacks = 0
+    pre_skip = 0  # mid-epoch resume: batches of start_epoch already consumed
+    resharded = False
     if resume:
         rec = journal.read()
         if rec is None or ckpts.latest_step() is None:
@@ -409,8 +439,22 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
             # the checkpoint's recorded epoch (its commit is atomic) decides
             # where training restarts; the journal carries the advisory
             # run-level extras (rollback count, LR escalation)
-            state, ckpt_epoch = _restore_full("resume")
-            start_epoch = ckpt_epoch + 1
+            state, meta = _restore_full("resume")
+            ckpt_epoch = int(meta.get("epoch", -1))
+            resharded = bool(meta.get("_resharded"))
+            pre = meta.get("preempted")
+            if pre:
+                # emergency checkpoint: re-enter the SAME epoch and skip the
+                # batches it already executed — the deterministic epoch
+                # stream + restored rng make the continuation bit-identical
+                start_epoch = ckpt_epoch
+                pre_skip = int(pre.get("steps_done", 0))
+                logger.info(
+                    "resume after preemption (%s): re-entering epoch %d at "
+                    "step offset %d", pre.get("reason"), start_epoch, pre_skip,
+                )
+            else:
+                start_epoch = ckpt_epoch + 1
             n_rollbacks = int(rec.get("rollbacks", 0))
             lr_scale = float(rec.get("lr_scale", 1.0))
             if lr_scale != trainer.lr_scale:
@@ -423,73 +467,154 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
     last_val: dict[str, float] = {}
     route: dict[str, int] = {}
     epoch = start_epoch
-    while epoch < cfg.optim.max_epochs:
-        epoch_gs = _epoch_graphs(train, train_labels, cfg, epoch)
-        try:
-            state, train_m, train_loss = trainer.train_epoch(
-                state,
-                _batch_stream(batcher, epoch_gs, shuffle_seed=cfg.seed + epoch),
-                sentinel=sentinel,
-            )
-        except DivergenceError as err:
-            n_rollbacks += 1
-            sentinel.reset()
-            if n_rollbacks > res.max_rollbacks:
-                logger.error(
-                    "divergence persisted past %d rollbacks — aborting",
-                    res.max_rollbacks,
+    try:
+        while epoch < cfg.optim.max_epochs:
+            epoch_gs = _epoch_graphs(train, train_labels, cfg, epoch)
+            # mid-epoch resume: skip the batches the preempted run already
+            # executed — only on the first (re-entered) epoch; a rollback
+            # retry of that epoch restores the same emergency checkpoint,
+            # so the offset stays valid
+            skip = pre_skip if epoch == start_epoch else 0
+            try:
+                state, train_m, train_loss = trainer.train_epoch(
+                    state,
+                    _batch_stream(batcher, epoch_gs, shuffle_seed=cfg.seed + epoch),
+                    sentinel=sentinel,
+                    preemption=preemption,
+                    skip_steps=skip,
+                    watchdog=watchdog,
                 )
+            except Preempted as p:
+                # deadline-bounded emergency checkpoint through the ordinary
+                # atomic commit protocol, then exit with the resumable rc
+                state = p.state
+                elapsed = ckpts.save_emergency(
+                    int(state.step), {"params": state.params},
+                    epoch=epoch, aux=_aux(state), mesh=topology,
+                    steps_done=p.steps_done, reason=p.reason,
+                )
+                within = elapsed <= res.preempt_deadline_s
+                logger.log(
+                    logging.INFO if within else logging.ERROR,
+                    "emergency checkpoint step=%d committed in %.2fs "
+                    "(deadline %.0fs%s) — epoch %d, %d step(s) done, rc=%d",
+                    int(state.step), elapsed, res.preempt_deadline_s,
+                    "" if within else " EXCEEDED", epoch, p.steps_done,
+                    PreemptedExit().code,
+                )
+                journal.write(
+                    epoch=epoch,
+                    global_step=int(state.step),
+                    seed=cfg.seed,
+                    preempted=p.reason,
+                    preempted_steps_done=p.steps_done,
+                    emergency_commit_s=round(elapsed, 3),
+                    emergency_deadline_s=res.preempt_deadline_s,
+                    mesh=topology,
+                    lr_scale=trainer.lr_scale,
+                    rollbacks=n_rollbacks,
+                )
+                raise PreemptedExit(p.reason)
+            except WatchdogTimeout as wt:
+                # a wedged device call: journal the timeout and abort —
+                # bounded and diagnosable instead of an eternal hang
+                journal.write(
+                    epoch=epoch,
+                    global_step=int(state.step),
+                    seed=cfg.seed,
+                    watchdog_timeout={"point": wt.point,
+                                      "deadline_s": wt.deadline_s},
+                    lr_scale=trainer.lr_scale,
+                    rollbacks=n_rollbacks,
+                )
+                logger.error("%s — aborting (journaled)", wt)
                 raise
-            trainer.rescale_lr(res.lr_backoff)
-            if ckpts.latest_step() is not None:
-                state, _ = _restore_full(f"rollback ({err})")
-            else:
-                logger.warning("diverged before the first checkpoint — re-initialising")
-                state = trainer.init_state(example)
-            logger.warning(
-                "rollback %d/%d: lr_scale=%.3g, retrying epoch %d",
-                n_rollbacks, res.max_rollbacks, trainer.lr_scale, epoch,
+            except DivergenceError as err:
+                n_rollbacks += 1
+                sentinel.reset()
+                if n_rollbacks > res.max_rollbacks:
+                    logger.error(
+                        "divergence persisted past %d rollbacks — aborting",
+                        res.max_rollbacks,
+                    )
+                    raise
+                trainer.rescale_lr(res.lr_backoff)
+                if ckpts.latest_step() is not None:
+                    state, _meta = _restore_full(f"rollback ({err})")
+                else:
+                    logger.warning("diverged before the first checkpoint — re-initialising")
+                    state = trainer.init_state(example)
+                logger.warning(
+                    "rollback %d/%d: lr_scale=%.3g, retrying epoch %d",
+                    n_rollbacks, res.max_rollbacks, trainer.lr_scale, epoch,
+                )
+                continue
+            route = _oversize_stats(batcher, "_train")
+            val_m, val_loss = trainer.evaluate(state.params, _batch_stream(batcher, val))
+            route |= _oversize_stats(batcher, "_val")
+            last_val = val_m
+            logger.info(
+                "epoch %d: train_loss=%.4f train_F1=%.4f val_loss=%.4f val_F1=%.4f"
+                " oversize_fallback=%d/%d dropped=%d/%d (train/val)",
+                epoch, train_loss, train_m["train_F1Score"], val_loss, val_m["val_F1Score"],
+                route["n_oversize_fallback_train"], route["n_oversize_fallback_val"],
+                route["n_dropped_train"], route["n_dropped_val"],
             )
-            continue
-        route = _oversize_stats(batcher, "_train")
-        val_m, val_loss = trainer.evaluate(state.params, _batch_stream(batcher, val))
-        route |= _oversize_stats(batcher, "_val")
-        last_val = val_m
-        logger.info(
-            "epoch %d: train_loss=%.4f train_F1=%.4f val_loss=%.4f val_F1=%.4f"
-            " oversize_fallback=%d/%d dropped=%d/%d (train/val)",
-            epoch, train_loss, train_m["train_F1Score"], val_loss, val_m["val_F1Score"],
-            route["n_oversize_fallback_train"], route["n_oversize_fallback_val"],
-            route["n_dropped_train"], route["n_dropped_val"],
-        )
-        if tb is not None:
-            for k, v in {"train_loss": train_loss, "val_loss": val_loss,
-                         **train_m, **val_m}.items():
-                tb.add_scalar(k, v, epoch)
-        ckpts.save(
-            int(state.step), {"params": state.params},
-            metrics={"val_loss": val_loss, "val_F1Score": val_m["val_F1Score"]},
-            epoch=epoch,
-            aux=_aux(state),
-        )
-        journal.write(
-            epoch=epoch,
-            global_step=int(state.step),
-            seed=cfg.seed,
-            sampler={
-                "seed": cfg.data.seed,
-                "undersample": cfg.data.undersample,
-                "oversample": cfg.data.oversample,
-                "epoch": epoch,
-            },
-            best_metric=ckpts.best_metric(),
-            lr_scale=trainer.lr_scale,
-            rollbacks=n_rollbacks,
-            **(sentinel.stats() if sentinel is not None else {}),
-        )
-        with open(tuning_file, "a") as f:
-            f.write(json.dumps({"epoch": epoch, "val_F1Score": val_m["val_F1Score"]}) + "\n")
-        epoch += 1
+            if tb is not None:
+                for k, v in {"train_loss": train_loss, "val_loss": val_loss,
+                             **train_m, **val_m}.items():
+                    tb.add_scalar(k, v, epoch)
+            ckpts.save(
+                int(state.step), {"params": state.params},
+                metrics={"val_loss": val_loss, "val_F1Score": val_m["val_F1Score"]},
+                epoch=epoch,
+                aux=_aux(state),
+                mesh=topology,
+            )
+            journal.write(
+                epoch=epoch,
+                global_step=int(state.step),
+                seed=cfg.seed,
+                sampler={
+                    "seed": cfg.data.seed,
+                    "undersample": cfg.data.undersample,
+                    "oversample": cfg.data.oversample,
+                    "epoch": epoch,
+                },
+                best_metric=ckpts.best_metric(),
+                lr_scale=trainer.lr_scale,
+                rollbacks=n_rollbacks,
+                mesh=topology,
+                resharded=resharded,
+                **(sentinel.stats() if sentinel is not None else {}),
+            )
+            with open(tuning_file, "a") as f:
+                f.write(json.dumps({"epoch": epoch, "val_F1Score": val_m["val_F1Score"]}) + "\n")
+            if preemption is not None and preemption.triggered:
+                # the notice landed during val/checkpointing: this epoch's
+                # NORMAL checkpoint is already committed — exit resumable
+                # without an extra emergency save
+                journal.write(
+                    epoch=epoch,
+                    global_step=int(state.step),
+                    seed=cfg.seed,
+                    preempted=preemption.reason,
+                    preempted_steps_done=0,
+                    emergency_commit_s=0.0,
+                    emergency_deadline_s=res.preempt_deadline_s,
+                    mesh=topology,
+                    lr_scale=trainer.lr_scale,
+                    rollbacks=n_rollbacks,
+                )
+                logger.info(
+                    "preemption (%s) at epoch boundary — epoch %d checkpoint "
+                    "already committed", preemption.reason, epoch,
+                )
+                raise PreemptedExit(preemption.reason)
+            epoch += 1
+    finally:
+        if preemption is not None:
+            preemption.uninstall()
 
     # post-fit: restore best checkpoint and re-validate (main_cli.py:175-184)
     best_step = ckpts.best_step()
@@ -509,6 +634,7 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
     last_val = dict(last_val) | route
     last_val["n_rollbacks"] = n_rollbacks
     last_val["lr_scale"] = trainer.lr_scale
+    last_val["resharded"] = int(resharded)
     if sentinel is not None:
         last_val |= sentinel.stats()
     journal.write(
@@ -518,6 +644,8 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
         best_metric=ckpts.best_metric(),
         lr_scale=trainer.lr_scale,
         rollbacks=n_rollbacks,
+        mesh=topology,
+        resharded=resharded,
         completed=True,
     )
     (run_dir / "final_metrics.json").write_text(json.dumps(last_val, indent=2))

@@ -380,6 +380,9 @@ class Trainer:
         state: TrainState,
         batches: Iterable[BatchedGraphs],
         sentinel=None,
+        preemption=None,
+        skip_steps: int = 0,
+        watchdog=None,
     ) -> tuple[TrainState, dict[str, float], float]:
         """One pass. ``sentinel``: an optional
         :class:`~deepdfa_tpu.resilience.sentinel.DivergenceSentinel`
@@ -387,21 +390,69 @@ class Trainer:
         ``patience`` consecutive skipped (non-finite) steps so the caller
         can roll back to the last good checkpoint. The ``step.nan_grads``
         fault point poisons selected steps' gradients via the step's
-        ``loss_scale`` argument (chaos battery)."""
+        ``loss_scale`` argument (chaos battery).
+
+        ``preemption``: an optional
+        :class:`~deepdfa_tpu.resilience.preemption.PreemptionHandler`
+        whose flag is observed at every step boundary — once set (a real
+        SIGTERM/SIGUSR1, or the ``preempt.sigterm`` fault firing) the loop
+        raises :class:`~deepdfa_tpu.resilience.preemption.Preempted`
+        carrying the current state and the number of batches consumed this
+        epoch, so the caller can emergency-checkpoint and exit resumable.
+
+        ``skip_steps``: fast-forward past the first N batches of the
+        (deterministic) stream without executing them — the mid-epoch
+        resume path after a preemption; the carried rng/params make the
+        continuation bit-identical to the uninterrupted epoch.
+
+        ``watchdog``: an optional
+        :class:`~deepdfa_tpu.resilience.watchdog.HangWatchdog`; every step
+        dispatch runs under its deadline, and the ``step.hang`` fault
+        injects a cancel-aware wedge the watchdog must convert into a
+        bounded :class:`WatchdogTimeout` (armed ``step.hang`` without a
+        watchdog is a no-op — a test must never actually hang)."""
         metrics = ConfusionState.zeros()
         losses, wsums = [], []
         nan_armed = faults.active("step.nan_grads")
+        pre_armed = preemption is not None and faults.active("preempt.sigterm")
+        hang_armed = watchdog is not None and faults.active("step.hang")
+        consumed = 0
         stream = self._stream(batches)
         try:
             for batch in stream:
+                if consumed < skip_steps:
+                    consumed += 1
+                    continue
+                if pre_armed and faults.fire("preempt.sigterm"):
+                    preemption.trigger("injected fault preempt.sigterm")
+                if preemption is not None and preemption.triggered:
+                    from deepdfa_tpu.resilience.preemption import Preempted
+
+                    raise Preempted(
+                        state, consumed, preemption.reason or "preempted"
+                    )
                 batch = jax.tree.map(jnp.asarray, batch)
                 step, _ = self.steps_for(batch)
-                if nan_armed and faults.fire("step.nan_grads"):
-                    state, metrics, loss, wsum = step(
-                        state, batch, metrics, float("nan")
+                if hang_armed and faults.fire("step.hang"):
+                    # simulated wedged dispatch: parks until the watchdog's
+                    # deadline cancels it → WatchdogTimeout, thread unwinds
+                    watchdog.call(
+                        "train_step",
+                        lambda cancel: cancel.wait(),
+                        cancel_aware=True,
+                    )
+                args = (
+                    (state, batch, metrics, float("nan"))
+                    if nan_armed and faults.fire("step.nan_grads")
+                    else (state, batch, metrics)
+                )
+                if watchdog is not None:
+                    state, metrics, loss, wsum = watchdog.call(
+                        "train_step", step, *args
                     )
                 else:
-                    state, metrics, loss, wsum = step(state, batch, metrics)
+                    state, metrics, loss, wsum = step(*args)
+                consumed += 1
                 if sentinel is not None:
                     sentinel.observe(loss)
                 losses.append(loss)

@@ -18,8 +18,20 @@ and proves the resilience invariants end-to-end:
    ``sentinel_patience=2`` the run must detect divergence, roll back to the
    last good checkpoint (or re-init), halve the LR, and still COMPLETE with
    ``n_rollbacks >= 1`` in its final metrics.
+5. **preempt** — ``preempt.sigterm@2`` simulates a SIGTERM mid-epoch on a
+   2-device host mesh: the run must commit an emergency checkpoint within
+   the ``preempt_deadline_s`` budget, journal the preemption, and exit with
+   the distinct resumable rc 75 (EX_TEMPFAIL).
+6. **elastic_resume** — ``fit --resume`` on the preempted run dir with HALF
+   the devices (1 vs 2): the mesh-elastic restore path reshards params, the
+   seed-deterministic sampler replays the same global batch sequence, and
+   the final val metrics must MATCH the clean oracle within 1e-6.
+7. **hang** — ``step.hang@2`` wedges a train step forever; with
+   ``step_deadline_s=5`` the watchdog must convert the infinite hang into a
+   journaled ``watchdog_timeout`` abort in bounded time (never rc 0, never
+   a battery-level subprocess timeout).
 
-Prints one JSON verdict line; exit 0 iff every scenario held. Slow (four
+Prints one JSON verdict line; exit 0 iff every scenario held. Slow (seven
 small subprocess fits): the pytest wrapper is marked ``slow``; tier-1 runs
 the same invariants in-process instead.
 
@@ -56,6 +68,7 @@ TOLERANCE = 1e-6
 
 def run_fit(run_dir: Path, storage: Path, epochs: int, *, faults: str = "",
             resume: bool = False, extra: list[str] | None = None,
+            env_extra: dict[str, str] | None = None,
             timeout: float = 900.0) -> subprocess.CompletedProcess:
     cmd = [
         sys.executable, "-m", "deepdfa_tpu.train.cli", "fit",
@@ -72,6 +85,7 @@ def run_fit(run_dir: Path, storage: Path, epochs: int, *, faults: str = "",
         "DEEPDFA_FAULTS": faults,
         "PYTHONPATH": str(REPO),
     }
+    env |= env_extra or {}
     return subprocess.run(
         cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout
     )
@@ -166,6 +180,118 @@ def scenario_sentinel(work: Path, epochs: int) -> dict:
     return detail
 
 
+def _journal(run_dir: Path) -> dict:
+    path = run_dir / "journal.json"
+    if not path.exists():
+        return {}
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def scenario_preempt(work: Path, epochs: int) -> dict:
+    """SIGTERM mid-epoch on a 2-device mesh: emergency ckpt within deadline,
+    journaled preemption, distinct resumable rc 75."""
+    run_dir = work / "preempted"
+    proc = run_fit(
+        run_dir, work / "storage_preempt", epochs,
+        faults="preempt.sigterm@2",
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+    )
+    detail: dict = {"ok": False, "returncode": proc.returncode}
+    committed = sorted(
+        (run_dir / "checkpoints").glob("*/meta.json"),
+        key=lambda p: int(p.parent.name),
+    )
+    if proc.returncode != 75 or not committed:
+        detail["stderr_tail"] = proc.stderr[-2000:]
+        return detail
+    meta = json.loads(committed[-1].read_text())
+    journal = _journal(run_dir)
+    commit_s = journal.get("emergency_commit_s")
+    deadline_s = journal.get("emergency_deadline_s")
+    detail |= {
+        "ok": (
+            "preempted" in meta
+            and "emergency" in meta.get("reasons", [])
+            and journal.get("preempted") is not None
+            and commit_s is not None
+            and deadline_s is not None
+            and float(commit_s) <= float(deadline_s)
+            and journal.get("mesh", {}).get("devices") == 2
+        ),
+        "meta_preempted": meta.get("preempted"),
+        "meta_reasons": meta.get("reasons"),
+        "emergency_commit_s": commit_s,
+        "emergency_deadline_s": deadline_s,
+        "mesh": journal.get("mesh"),
+    }
+    if not detail["ok"]:
+        detail["stderr_tail"] = proc.stderr[-2000:]
+    return detail
+
+
+def scenario_elastic_resume(work: Path, epochs: int, oracle: dict) -> dict:
+    """--resume the preempted run on HALF the devices (1 vs 2): the restore
+    reshards, replays the same global batch order, and matches the oracle."""
+    run_dir = work / "preempted"
+    proc = run_fit(run_dir, work / "storage_preempt", epochs, resume=True)
+    detail: dict = {"ok": False, "returncode": proc.returncode}
+    if proc.returncode != 0 or not (run_dir / "final_metrics.json").exists():
+        detail["stderr_tail"] = proc.stderr[-2000:]
+        return detail
+    resumed = final_metrics(run_dir)
+    diffs = {
+        k: abs(float(resumed[k]) - float(oracle[k]))
+        for k in COMPARE_KEYS
+        if k in resumed and k in oracle
+    }
+    journal = _journal(run_dir)
+    detail |= {
+        "ok": (
+            bool(diffs)
+            and all(d <= TOLERANCE for d in diffs.values())
+            and int(resumed.get("resharded", 0)) == 1
+            and journal.get("mesh", {}).get("devices") == 1
+        ),
+        "metric_diffs": diffs,
+        "resharded": resumed.get("resharded"),
+        "mesh": journal.get("mesh"),
+    }
+    if not detail["ok"]:
+        detail["stderr_tail"] = proc.stderr[-2000:]
+    return detail
+
+
+def scenario_hang(work: Path, epochs: int) -> dict:
+    """step.hang wedges a step forever; the watchdog must journal a timeout
+    and abort in bounded time (subprocess timeout here is the upper proof)."""
+    run_dir = work / "hung"
+    try:
+        proc = run_fit(
+            run_dir, work / "storage_hang", epochs,
+            faults="step.hang@2",
+            extra=["--set", "resilience.step_deadline_s=5"],
+            timeout=300.0,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": "battery timeout — watchdog never fired"}
+    journal = _journal(run_dir)
+    wt = journal.get("watchdog_timeout") or {}
+    detail = {
+        "ok": (
+            proc.returncode not in (0, 75, 137)
+            and wt.get("point") == "train_step"
+        ),
+        "returncode": proc.returncode,
+        "watchdog_timeout": wt,
+    }
+    if not detail["ok"]:
+        detail["stderr_tail"] = proc.stderr[-2000:]
+    return detail
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--workdir", default=None,
@@ -193,6 +319,13 @@ def main(argv=None) -> int:
             )
             if not args.skip_sentinel:
                 verdict["sentinel"] = scenario_sentinel(work, args.epochs)
+            verdict["preempt"] = scenario_preempt(work, args.epochs)
+            verdict["elastic_resume"] = (
+                scenario_elastic_resume(work, args.epochs, oracle)
+                if verdict["preempt"]["ok"]
+                else {"ok": False, "skipped": "preempt scenario failed"}
+            )
+            verdict["hang"] = scenario_hang(work, args.epochs)
         ok = all(
             v.get("ok", False)
             for k, v in verdict.items()

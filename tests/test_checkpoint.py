@@ -217,6 +217,65 @@ def test_restore_resume_walks_past_corrupt_newest(tmp_path):
 
 
 @pytest.mark.faults
+def test_restore_resume_walks_past_truncated_meta(tmp_path):
+    """A torn meta.json (half-written commit marker from a pre-atomic
+    writer, or disk corruption) must not poison the scan: the dir drops out
+    of the index and resume lands on the previous good step."""
+    mgr = CheckpointManager(tmp_path, CheckpointConfig(keep=3))
+    for step in (1, 2):
+        mgr.save(step, _state(float(step)), {"val_loss": 1.0 / step},
+                 epoch=step, aux={"step": jnp.asarray(step)})
+    (tmp_path / "00000002" / "meta.json").write_text('{"step": 2, "epo')
+    # a FRESH manager (process restart) rescans the directory
+    mgr2 = CheckpointManager(tmp_path, CheckpointConfig(keep=3))
+    step, meta, payload, raux = mgr2.restore_resume(
+        template=_state(0.0), aux_template={"step": jnp.asarray(0)}
+    )
+    assert step == 1 and meta["epoch"] == 1
+    assert int(np.asarray(raux["step"])) == 1
+
+
+@pytest.mark.faults
+def test_restore_resume_walks_past_missing_aux_payload(tmp_path):
+    """Newest step's aux dir deleted (partial GC, manual cleanup): with an
+    aux_template the walk-back skips it — resume without the opt-state
+    would silently break bit-identity."""
+    import shutil
+
+    mgr = CheckpointManager(tmp_path, CheckpointConfig(keep=3))
+    for step in (1, 2, 3):
+        mgr.save(step, _state(float(step)), {"val_loss": 1.0 / step},
+                 epoch=step, aux={"step": jnp.asarray(step)})
+    shutil.rmtree(tmp_path / "00000003" / "aux")
+    step, meta, payload, raux = mgr.restore_resume(
+        template=_state(0.0), aux_template={"step": jnp.asarray(0)}
+    )
+    assert step == 2 and int(np.asarray(raux["step"])) == 2
+
+
+@pytest.mark.faults
+def test_restore_resume_walks_past_zeroed_arrays(tmp_path):
+    """Every array file under the newest state/ truncated to zero bytes
+    (the classic post-crash filesystem state): restore of that step fails
+    and the walk-back recovers the previous one."""
+    mgr = CheckpointManager(tmp_path, CheckpointConfig(keep=3))
+    for step in (1, 2):
+        mgr.save(step, _state(float(step)), {"val_loss": 1.0 / step},
+                 epoch=step, aux={"step": jnp.asarray(step)})
+    zeroed = 0
+    for f in (tmp_path / "00000002" / "state").rglob("*"):
+        if f.is_file():
+            f.write_bytes(b"")
+            zeroed += 1
+    assert zeroed > 0
+    step, meta, payload, raux = mgr.restore_resume(
+        template=_state(0.0), aux_template={"step": jnp.asarray(0)}
+    )
+    assert step == 1
+    assert float(np.asarray(payload["params"]["dense"]["kernel"])[0, 0]) == 1.0
+
+
+@pytest.mark.faults
 def test_restore_resume_requires_aux_when_asked(tmp_path):
     """Resume needs the full trainer state: a checkpoint without aux is
     skipped when an aux_template is given, used when it is not."""

@@ -1,0 +1,398 @@
+"""Preemption-aware elastic training: the in-process invariants.
+
+- SIGTERM/SIGUSR1 (or the ``preempt.sigterm`` fault) sets a flag the train
+  loop observes at the NEXT STEP BOUNDARY: ``Preempted`` carries the exact
+  post-update state and the number of batches consumed, the emergency
+  checkpoint commits through the ordinary atomic protocol with a
+  ``preempted`` meta block, and ``skip_steps`` resume is bit-identical to
+  the uninterrupted epoch.
+- ``meta.json`` records a mesh/topology block; ``mesh_changed`` detects a
+  different harness and ``reshard_tree`` moves values bit-identically.
+- ``HangWatchdog`` converts an infinite hang (the ``step.hang`` fault)
+  into a bounded, journalable ``WatchdogTimeout`` — no test ever blocks.
+- ``stack_elastic`` + ``accum`` in the dp step preserve the global batch
+  order (and rng streams) across a dp=N → dp=N/k mesh change.
+"""
+
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepdfa_tpu.config import CheckpointConfig, ExperimentConfig, GGNNConfig
+from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+from deepdfa_tpu.data.synthetic import random_dataset
+from deepdfa_tpu.models.ggnn import GGNN
+from deepdfa_tpu.parallel.elastic import (
+    elastic_restore,
+    mesh_block,
+    mesh_changed,
+    reshard_tree,
+    stack_elastic,
+)
+from deepdfa_tpu.resilience import (
+    HangWatchdog,
+    PREEMPTED_RC,
+    Preempted,
+    PreemptedExit,
+    PreemptionHandler,
+    WatchdogTimeout,
+    faults,
+)
+from deepdfa_tpu.train.checkpoint import CheckpointManager
+from deepdfa_tpu.train.loop import Trainer, TrainState
+
+pytestmark = [pytest.mark.faults, pytest.mark.elastic]
+
+SMALL = dict(hidden_dim=8, n_steps=1, num_output_layers=2)
+
+
+def _setup(n_graphs=24, bucket_graphs=12, seed=3):
+    cfg = ExperimentConfig(model=GGNNConfig(**SMALL))
+    graphs = random_dataset(n_graphs, seed=seed, input_dim=cfg.input_dim,
+                            vul_rate=0.25)
+    model = GGNN(cfg=cfg.model, input_dim=cfg.input_dim)
+    trainer = Trainer(model=model, cfg=cfg, pos_weight=3.0)
+    batches = list(
+        GraphBatcher([BucketSpec(bucket_graphs, 2048, 4096)]).batches(graphs)
+    )
+    state = trainer.init_state(jax.tree.map(jnp.asarray, batches[0]))
+    return trainer, state, batches
+
+
+def _leaves(params):
+    return [np.asarray(x) for x in jax.tree.leaves(params)]
+
+
+def _aux(state):
+    return {
+        "opt_state": state.opt_state,
+        "rng": jax.random.key_data(state.rng),
+        "step": state.step,
+    }
+
+
+# ---------------------------------------------------------------------------
+# preemption: flag → step-boundary Preempted → emergency ckpt → skip-resume
+
+
+def test_preempt_fault_raises_at_step_boundary():
+    """preempt.sigterm@2 fires at the second step boundary: exactly one
+    batch executed, the carried state is that post-update state."""
+    trainer, state, batches = _setup()
+    assert len(batches) >= 2
+    handler = PreemptionHandler()  # not installed: fault-triggered only
+    with faults.installed("preempt.sigterm@2"):
+        with pytest.raises(Preempted) as ei:
+            trainer.train_epoch(state, batches, preemption=handler)
+    p = ei.value
+    assert p.steps_done == 1
+    assert "preempt.sigterm" in p.reason
+    assert int(p.state.step) == int(state.step) + 1
+    assert handler.triggered
+
+
+def test_preempt_skip_resume_is_bit_identical(tmp_path):
+    """Preempt after 1 of 2 batches, emergency-save, restore, re-enter the
+    SAME epoch with skip_steps=1: final params/rng must equal the
+    uninterrupted epoch exactly."""
+    trainer, state0, batches = _setup()
+    s_full, _, _ = trainer.train_epoch(state0, batches)
+
+    trainer_b, state_b, _ = _setup()
+    handler = PreemptionHandler()
+    with faults.installed("preempt.sigterm@2"):
+        with pytest.raises(Preempted) as ei:
+            trainer_b.train_epoch(state_b, batches, preemption=handler)
+    p = ei.value
+
+    ckpts = CheckpointManager(tmp_path / "ck", CheckpointConfig())
+    elapsed = ckpts.save_emergency(
+        int(p.state.step), {"params": p.state.params}, epoch=0,
+        aux=_aux(p.state), mesh=mesh_block(), steps_done=p.steps_done,
+    )
+    assert elapsed >= 0.0
+
+    trainer_c, state_c, _ = _setup()  # fresh-process stand-in
+    step, meta, payload, raux, resharded = elastic_restore(
+        ckpts, template={"params": state_c.params}, aux_template=_aux(state_c)
+    )
+    assert meta["preempted"]["steps_done"] == 1
+    assert not resharded  # same harness, no mesh change
+    resumed = TrainState(
+        payload["params"], raux["opt_state"],
+        jax.random.wrap_key_data(raux["rng"]), raux["step"],
+    )
+    s_res, _, _ = trainer_c.train_epoch(
+        resumed, batches, skip_steps=meta["preempted"]["steps_done"]
+    )
+
+    for a, b in zip(_leaves(s_full.params), _leaves(s_res.params)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        jax.random.key_data(s_full.rng), jax.random.key_data(s_res.rng)
+    )
+
+
+def test_emergency_meta_records_mesh_and_reason(tmp_path):
+    trainer, state, _ = _setup()
+    ckpts = CheckpointManager(tmp_path / "ck", CheckpointConfig())
+    ckpts.save_emergency(
+        7, {"params": state.params}, epoch=2, aux=_aux(state),
+        mesh=mesh_block(), steps_done=3, reason="signal SIGTERM",
+    )
+    import json
+
+    meta = json.loads((tmp_path / "ck" / f"{7:08d}" / "meta.json").read_text())
+    assert "emergency" in meta["reasons"]
+    assert meta["preempted"] == {"steps_done": 3, "reason": "signal SIGTERM"}
+    assert meta["mesh"]["devices"] == jax.device_count()
+    assert meta["epoch"] == 2
+
+
+def test_signal_sets_flag_and_uninstall_restores():
+    """A real SIGUSR1 sets the flag (no exception, no exit); uninstall puts
+    the previous disposition back."""
+    prev = signal.getsignal(signal.SIGUSR1)
+    handler = PreemptionHandler().install()
+    try:
+        assert not handler.triggered
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5.0
+        while not handler.triggered and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert handler.triggered
+        assert handler.reason == "signal SIGUSR1"
+    finally:
+        handler.uninstall()
+    assert signal.getsignal(signal.SIGUSR1) is prev
+
+
+def test_preempted_exit_is_resumable_rc():
+    assert PREEMPTED_RC == 75
+    exc = PreemptedExit("signal SIGTERM")
+    assert isinstance(exc, SystemExit)  # bypasses `except Exception` paths
+    assert exc.code == PREEMPTED_RC
+    assert exc.reason == "signal SIGTERM"
+
+
+# ---------------------------------------------------------------------------
+# hung-collective watchdog
+
+
+def test_watchdog_times_out_in_bounded_time():
+    events = []
+    dog = HangWatchdog(0.3, on_timeout=lambda p, d: events.append((p, d)))
+    t0 = time.monotonic()
+    with pytest.raises(WatchdogTimeout) as ei:
+        dog.call("probe", lambda cancel: cancel.wait(), cancel_aware=True)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0  # bounded: deadline + join slack, never a hang
+    assert ei.value.point == "probe"
+    assert ei.value.deadline_s == pytest.approx(0.3)
+    assert events == [("probe", pytest.approx(0.3))]
+    assert dog.n_timeouts == 1
+
+
+def test_watchdog_passes_through_value_and_error():
+    dog = HangWatchdog(5.0)
+    assert dog.call("ok", lambda a, b: a + b, 40, b=2) == 42
+
+    class Boom(RuntimeError):
+        pass
+
+    def explode():
+        raise Boom("inner")
+
+    with pytest.raises(Boom, match="inner"):
+        dog.call("err", explode)
+    assert dog.n_timeouts == 0
+
+
+def test_step_hang_fault_converts_to_watchdog_timeout():
+    """Armed step.hang + a watchdog: the injected wedge must surface as
+    WatchdogTimeout within the deadline — and the cancel-aware worker
+    unwinds (no leaked watchdog thread)."""
+    import threading
+
+    trainer, state, batches = _setup()
+    dog = HangWatchdog(0.5)
+    t0 = time.monotonic()
+    with faults.installed("step.hang@1"):
+        with pytest.raises(WatchdogTimeout) as ei:
+            trainer.train_epoch(state, batches, watchdog=dog)
+    assert time.monotonic() - t0 < 10.0
+    assert ei.value.point == "train_step"
+    time.sleep(0.1)  # worker unwind slack
+    leaked = [
+        t for t in threading.enumerate()
+        if t.name.startswith("watchdog:") and t.is_alive()
+    ]
+    assert leaked == []
+
+
+def test_step_hang_without_watchdog_is_noop():
+    """Armed step.hang but no watchdog passed: documented no-op — the epoch
+    completes normally (a test must never actually hang)."""
+    trainer, state, batches = _setup()
+    with faults.installed("step.hang@1"):
+        _, _, loss = trainer.train_epoch(state, batches)
+    assert np.isfinite(loss)
+
+
+def test_probed_devices_uses_watchdog():
+    from deepdfa_tpu.parallel.mesh import probed_devices
+
+    devs = probed_devices(deadline_s=30.0)
+    assert len(devs) == jax.device_count()
+
+
+# ---------------------------------------------------------------------------
+# mesh-elastic: topology blocks, reshard, batch regrouping
+
+
+def test_mesh_block_and_changed():
+    cur = mesh_block()
+    assert cur["devices"] == jax.device_count()
+    assert cur["axes"] is None
+    assert not mesh_changed(None, cur)  # pre-elastic checkpoint: as-is
+    assert not mesh_changed({}, cur)
+    assert not mesh_changed(dict(cur), cur)
+    assert mesh_changed({**cur, "devices": cur["devices"] + 1}, cur)
+    assert mesh_changed({**cur, "axes": {"dp": 8}}, cur)
+
+
+def test_mesh_block_records_named_axes():
+    from deepdfa_tpu.parallel.mesh import local_mesh
+
+    mesh = local_mesh(2)
+    block = mesh_block(mesh)
+    assert block["devices"] == 2
+    assert block["axes"]["dp"] == 2
+    assert all(s == 1 for ax, s in block["axes"].items() if ax != "dp")
+
+
+def test_reshard_tree_is_bit_identical():
+    tree = {
+        "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+        "b": jnp.float32(0.25),
+    }
+    moved = reshard_tree(tree)
+    for a, b in zip(_leaves(tree), _leaves(moved)):
+        np.testing.assert_array_equal(a, b)
+
+    from deepdfa_tpu.parallel.mesh import local_mesh
+
+    mesh = local_mesh(2)
+    placed = reshard_tree(tree, mesh)
+    for a, b in zip(_leaves(tree), _leaves(placed)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_elastic_restore_reshards_on_mesh_change(tmp_path):
+    """A checkpoint stamped with a DIFFERENT topology routes through the
+    reshard path; values stay bit-identical and the flag reports it."""
+    trainer, state, _ = _setup()
+    ckpts = CheckpointManager(tmp_path / "ck", CheckpointConfig())
+    other = {"devices": jax.device_count() + 7, "platform": "tpu", "axes": {"dp": 16}}
+    ckpts.save(3, {"params": state.params}, metrics={"val_loss": 1.0},
+               epoch=0, aux=_aux(state), mesh=other)
+
+    step, meta, payload, raux, resharded = elastic_restore(
+        ckpts, template={"params": state.params}, aux_template=_aux(state)
+    )
+    assert resharded
+    assert meta["mesh"] == other
+    for a, b in zip(_leaves(state.params), _leaves(payload["params"])):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        jax.random.key_data(state.rng), np.asarray(raux["rng"])
+    )
+
+
+def _flat_batches(n_dp, n_batches=1, seed=0):
+    from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+
+    bucket = BucketSpec(9, 512, 1024)
+    graphs = random_dataset(n_dp * n_batches * 8, seed=seed, input_dim=40,
+                            mean_nodes=10)
+    flat = list(GraphBatcher([bucket]).batches(graphs))
+    assert len(flat) == n_dp * n_batches, len(flat)
+    return flat
+
+
+def test_stack_elastic_preserves_flat_order():
+    """dp=4/accum=1 puts flat batch j at slot [j]; dp=2/accum=2 puts flat
+    batch j*accum+i at [j][i] — the layout the dp step's rng fold-in
+    assumes."""
+    flat = _flat_batches(4)
+    nodes = [np.asarray(b.node_feats["_ABS_DATAFLOW"]) for b in flat]
+
+    plain = stack_elastic(flat, dp=4)
+    assert len(plain) == 1
+    arr = np.asarray(plain[0].node_feats["_ABS_DATAFLOW"])
+    assert arr.shape[0] == 4
+    for j in range(4):
+        np.testing.assert_array_equal(arr[j], nodes[j])
+
+    acc = stack_elastic(flat, dp=2, accum=2)
+    assert len(acc) == 1
+    arr2 = np.asarray(acc[0].node_feats["_ABS_DATAFLOW"])
+    assert arr2.shape[:2] == (2, 2)
+    for j in range(2):
+        for i in range(2):
+            np.testing.assert_array_equal(arr2[j, i], nodes[j * 2 + i])
+
+
+def test_stack_elastic_rejects_indivisible():
+    flat = _flat_batches(4)
+    with pytest.raises(ValueError):
+        stack_elastic(flat[:3], dp=2)
+    with pytest.raises(ValueError):
+        stack_elastic(flat, dp=0)
+
+
+@pytest.mark.slow
+def test_dp_elastic_accum_matches_full_mesh():
+    """The headline elastic invariant: a dp=4 global step and a dp=2/accum=2
+    step over the SAME flat batches produce the same loss/params up to
+    float reassociation in the gradient reduction."""
+    import optax
+
+    from deepdfa_tpu.parallel.dp import dp_init_state, make_dp_train_step
+    from deepdfa_tpu.parallel.mesh import local_mesh
+    from deepdfa_tpu.train.metrics import ConfusionState
+
+    cfg = GGNNConfig(**SMALL)
+    model = GGNN(cfg=cfg, input_dim=40)
+    flat = _flat_batches(4, n_batches=2, seed=11)
+
+    def run(dp, accum):
+        mesh = local_mesh(dp)
+        tx = optax.sgd(0.1)
+        step = make_dp_train_step(model, tx, mesh, pos_weight=3.0,
+                                  donate=False, accum=accum)
+        state = dp_init_state(model, tx, jax.tree.map(jnp.asarray, flat[0]),
+                              seed=0)
+        metrics = ConfusionState.zeros()
+        losses = []
+        for s in stack_elastic(flat, dp=dp, accum=accum):
+            state, metrics, loss, wsum = step(
+                state, jax.tree.map(jnp.asarray, s), metrics
+            )
+            losses.append(float(loss))
+        return state, metrics, losses, float(wsum)
+
+    s4, m4, l4, w4 = run(dp=4, accum=1)
+    s2, m2, l2, w2 = run(dp=2, accum=2)
+
+    assert w4 == w2  # same global weight: same batches consumed
+    np.testing.assert_allclose(l4, l2, atol=1e-5)
+    for a, b in zip(_leaves(s4.params), _leaves(s2.params)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(m4), jax.tree.leaves(m2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

@@ -96,6 +96,60 @@ def test_shard_roundtrip(tmp_path):
             np.testing.assert_array_equal(a.node_feats[k], b.node_feats[k])
 
 
+def test_shard_manifest_written(tmp_path):
+    save_shards(random_dataset(7, seed=1), tmp_path, shard_size=3)
+    import json
+
+    manifest = json.loads((tmp_path / "manifest.json").read_text())
+    assert manifest["schema"] == 1
+    assert set(manifest["shards"]) == {
+        "shard_00000.npz", "shard_00001.npz", "shard_00002.npz"
+    }
+    assert sum(e["graphs"] for e in manifest["shards"].values()) == 7
+    assert all(len(e["sha256"]) == 64 for e in manifest["shards"].values())
+
+
+def test_shard_corruption_detected_and_named(tmp_path):
+    from deepdfa_tpu.data.graphs import ShardIntegrityError
+
+    save_shards(random_dataset(7, seed=1), tmp_path, shard_size=3)
+    victim = tmp_path / "shard_00001.npz"
+    blob = bytearray(victim.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF  # single flipped byte
+    victim.write_bytes(bytes(blob))
+    with pytest.raises(ShardIntegrityError, match="shard_00001.npz"):
+        load_shards(tmp_path)
+
+
+def test_shard_missing_listed_file_detected(tmp_path):
+    from deepdfa_tpu.data.graphs import ShardIntegrityError
+
+    save_shards(random_dataset(7, seed=1), tmp_path, shard_size=3)
+    (tmp_path / "shard_00002.npz").unlink()
+    with pytest.raises(ShardIntegrityError, match="shard_00002.npz"):
+        load_shards(tmp_path)
+
+
+def test_shard_unlisted_file_detected(tmp_path):
+    from deepdfa_tpu.data.graphs import ShardIntegrityError
+
+    graphs = random_dataset(4, seed=1)
+    save_shards(graphs, tmp_path, shard_size=4)
+    # a foreign/stale shard dropped into the dir after materialisation
+    save_shards(graphs, tmp_path / "other", shard_size=2)
+    (tmp_path / "other" / "shard_00001.npz").rename(tmp_path / "shard_00001.npz")
+    with pytest.raises(ShardIntegrityError, match="shard_00001.npz"):
+        load_shards(tmp_path)
+
+
+def test_shard_legacy_dir_without_manifest_loads(tmp_path):
+    graphs = random_dataset(5, seed=2)
+    save_shards(graphs, tmp_path, shard_size=5)
+    (tmp_path / "manifest.json").unlink()  # pre-manifest corpus
+    back = load_shards(tmp_path)
+    assert len(back) == 5
+
+
 def test_derive_buckets_occupancy():
     from deepdfa_tpu.data.graphs import derive_buckets, padding_efficiency
 

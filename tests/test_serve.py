@@ -400,9 +400,22 @@ def test_draining_server_refuses_new_scores(demo):
     srv = ScoreServer(_StubEngine(vocabs), vocabs,
                       ServeConfig(port=0, max_wait_ms=1.0)).start()
     try:
-        srv._draining.set()  # the instant SIGTERM flips before drain ends
+        # pre-drain baseline: healthz green
+        status, body = _req(srv.port, "GET", "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        # the instant SIGTERM lands (flag set, drain not yet started) the
+        # replica must advertise "draining" with a 503 so LBs stop routing
+        srv._stop_requested.set()
+        status, body = _req(srv.port, "GET", "/healthz")
+        health = json.loads(body)
+        assert status == 503
+        assert health["status"] == "draining" and health["draining"] is True
         status, body = _post_score(srv.port, sources[0])
         assert status == 503 and "draining" in body["error"]
+        srv._draining.set()  # mid-drain: same answer
+        status, body = _post_score(srv.port, sources[0])
+        assert status == 503 and "draining" in body["error"]
+        assert json.loads(_req(srv.port, "GET", "/healthz")[1])["status"] == "draining"
     finally:
         srv.shutdown()
 
