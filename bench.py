@@ -532,6 +532,274 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
         "concurrency": concurrency,
         "notes": notes or {},
         "ok": ok,
+        **_provenance_fields(),
+    }
+
+
+# -- dispatch-gap stages (fused train / int8 serving / strict latency) -------
+
+# VMEM-sized TRAIN batches: the fused training kernel banks n_steps node-state
+# blocks plus gate temps on top of the forward working set (~2x), so the
+# fused-train stage halves the forward stage's 128-graph bucket again —
+# bench_fused_train walks further down if the corpus-derived shape still
+# exceeds fits_vmem_train.
+FUSED_TRAIN_BATCH_GRAPHS = 64
+FUSED_TRAIN_MAX_RATIO = 0.8      # gate: fused train step_ms <= 0.8x segment
+STRICT_LATENCY_MAX_RATIO = 0.25  # gate: latency-mode step_ms <= 0.25x strict
+R05_STRICT_STEP_MS = 71.0        # the r05 strict-dispatch anchor (TPU)
+LATENCY_WINDOW_DEPTH = 8         # in-flight submits in the latency-mode loop
+
+
+def assemble_fused_train_result(backend, device_kind, fused, segment,
+                                batch_graphs, error=None):
+    """ONE-line block for the ``ggnn_fused_train`` stage: fused-layout train
+    step (Pallas fwd + fused recompute-backward inside one jitted dispatch)
+    vs the segment twin on the SAME batches. ``ok`` encodes the acceptance
+    gate: fused ``step_ms`` at or under ``FUSED_TRAIN_MAX_RATIO`` of the
+    segment step."""
+    ratio = None
+    if fused and segment and segment.get("step_ms"):
+        ratio = fused["step_ms"] / segment["step_ms"]
+    ok = (error is None and ratio is not None
+          and ratio <= FUSED_TRAIN_MAX_RATIO)
+    return {
+        "metric": "ggnn_fused_train_step_ms",
+        "value": round(fused["step_ms"], 3) if fused else None,
+        "unit": "ms/step",
+        "backend": backend,
+        "device_kind": device_kind,
+        "segment_step_ms": round(segment["step_ms"], 3) if segment else None,
+        "fused_graphs_per_sec": (
+            round(fused["graphs_per_sec"], 1) if fused else None),
+        "segment_graphs_per_sec": (
+            round(segment["graphs_per_sec"], 1) if segment else None),
+        "ratio_vs_segment": None if ratio is None else round(ratio, 4),
+        "max_ratio": FUSED_TRAIN_MAX_RATIO,
+        "batch_graphs": batch_graphs,
+        "config": GOLDEN_CONFIG,
+        "error": error,
+        "ok": ok,
+        **_provenance_fields(),
+    }
+
+
+def assemble_strict_latency_result(backend, device_kind, strict_step_ms,
+                                   latency_step_ms, window, requests,
+                                   error=None):
+    """ONE-line block for the ``strict_latency`` stage: per-request latency
+    of the warm donated-buffer engine loop (``ScoringEngine.submit`` with
+    ``window`` results in flight) vs the strict score-and-sync path,
+    measured in the SAME run. ``ok`` gates the ratio at
+    ``STRICT_LATENCY_MAX_RATIO``; on TPU the r05 71 ms strict anchor is
+    ALSO enforced (that is the dispatch gap this stage exists to close —
+    off-TPU the anchor is recorded but not comparable)."""
+    ratio = None
+    if strict_step_ms and latency_step_ms is not None:
+        ratio = latency_step_ms / strict_step_ms
+    anchor_ok = None
+    if backend == "tpu" and latency_step_ms is not None:
+        anchor_ok = (latency_step_ms
+                     <= STRICT_LATENCY_MAX_RATIO * R05_STRICT_STEP_MS)
+    ok = (error is None and ratio is not None
+          and ratio <= STRICT_LATENCY_MAX_RATIO
+          and anchor_ok is not False)
+    return {
+        "metric": "strict_latency_step_ms",
+        "value": None if latency_step_ms is None else round(latency_step_ms, 3),
+        "unit": "ms/request",
+        "backend": backend,
+        "device_kind": device_kind,
+        "strict_step_ms": (
+            None if strict_step_ms is None else round(strict_step_ms, 3)),
+        "ratio_vs_strict": None if ratio is None else round(ratio, 4),
+        "max_ratio": STRICT_LATENCY_MAX_RATIO,
+        "anchor_strict_step_ms": R05_STRICT_STEP_MS,
+        "anchor_ok": anchor_ok,
+        "window": window,
+        "requests": requests,
+        "error": error,
+        "ok": ok,
+        **_provenance_fields(),
+    }
+
+
+def assemble_int8_serving_result(backend, device_kind, precision_served,
+                                 int8_score_delta, max_score_delta, tiers,
+                                 refused_reason=None, error=None):
+    """ONE-line block for the ``int8_serving`` stage: tier-level p50/p99
+    for both precisions plus the calibration gate verdict. ``ok`` means the
+    gate was RESPECTED — either int8 was served with its measured score
+    delta within ``max_score_delta``, or it was refused and the engine fell
+    back to f32 with a recorded reason (the refusal path working is a pass,
+    not a failure)."""
+    gate_respected = (
+        (precision_served == "int8" and int8_score_delta is not None
+         and int8_score_delta <= max_score_delta)
+        or (precision_served == "f32" and refused_reason is not None))
+    ok = error is None and gate_respected
+    return {
+        "metric": "int8_serving_precision",
+        "value": precision_served,
+        "unit": "precision",
+        "backend": backend,
+        "device_kind": device_kind,
+        "int8_score_delta": (
+            None if int8_score_delta is None
+            else round(float(int8_score_delta), 6)),
+        "max_score_delta": max_score_delta,
+        "refused_reason": refused_reason,
+        # {graph_nodes: {"f32": {p50_ms, p99_ms}, "int8": {...}|None}}
+        "tiers": tiers,
+        "error": error,
+        "ok": ok,
+        **_provenance_fields(),
+    }
+
+
+def bench_fused_train(corpus, n_batches: int, k: int,
+                      dtype: str = "bfloat16", trials: int = 3):
+    """The ``ggnn_fused_train`` stage: chained TRAIN steps (fwd + backward +
+    optimizer update per step inside one jitted scan body) through the fused
+    layout — whose backward auto-selects the Pallas training kernel on
+    fits_vmem_train buckets — vs the segment twin on identical batches.
+    Returns ``(fused_run, segment_run, batch_graphs)``."""
+    from deepdfa_tpu.config import GGNNConfig
+    from deepdfa_tpu.ops.fused_ggnn import fits_vmem_train
+
+    cfg = GGNNConfig()
+    width = cfg.out_dim // 2
+    bg = FUSED_TRAIN_BATCH_GRAPHS
+    while bg >= 8:
+        batches, _occ = build_batches(corpus, n_batches, batch_graphs=bg)
+        fb = batches[0]
+        if fits_vmem_train(fb.max_nodes, fb.senders.shape[0], width,
+                           cfg.n_steps):
+            break
+        bg //= 2
+    else:
+        raise RuntimeError(
+            "no fused-train bucket fits the VMEM training plan — even "
+            "8-graph batches exceed fits_vmem_train")
+    fused = bench_chained(batches, k, train=True, dtype=dtype, trials=trials,
+                          layout="fused")
+    segment = bench_chained(batches, k, train=True, dtype=dtype,
+                            trials=trials, layout="segment")
+    return fused, segment, bg
+
+
+def _serve_engine_fixture(corpus, precision: str = "f32",
+                          latency_mode: bool = False,
+                          max_score_delta: float = 0.01):
+    """Fresh-params live-model engine over the default bucket ladder (the
+    serving stages measure DISPATCH, not model accuracy), calibrated/gated
+    on corpus graphs when int8 is requested."""
+    import warnings as _warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import GGNNConfig
+    from deepdfa_tpu.data.graphs import batch_np
+    from deepdfa_tpu.models import make_model
+    from deepdfa_tpu.serve.engine import ScoringEngine
+
+    cfg = GGNNConfig()
+    feat_keys = tuple(sorted(
+        k for k in corpus[0].node_feats if not k.startswith("_VULN")))
+    from deepdfa_tpu.config import FeatureConfig
+
+    model = make_model(cfg, input_dim=FeatureConfig().input_dim)
+    example = jax.tree.map(jnp.asarray, batch_np(corpus[:2], 3, 256, 1024))
+    params = model.init(jax.random.key(0), example)["params"]
+    refusal = None
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        engine = ScoringEngine.from_model(
+            model, params, cfg.label_style, feat_keys,
+            precision=precision, int8_max_score_delta=max_score_delta,
+            latency_mode=latency_mode, calibration_graphs=corpus[:32])
+        engine.warmup()
+    for w in caught:
+        if "int8 serving path refused" in str(w.message):
+            refusal = str(w.message)
+    return engine, refusal
+
+
+def bench_strict_latency(corpus, requests: int = 64,
+                         window: int = LATENCY_WINDOW_DEPTH):
+    """The ``strict_latency`` stage: per-request wall time of (a) the strict
+    path — ``score()`` with a host sync every request — vs (b) the warm
+    latency-mode loop — ``submit()`` keeping ``window`` donated dispatches
+    in flight, syncing only the oldest. Single-graph requests on the small
+    bucket: per-dispatch overhead IS the quantity under test. Returns
+    ``(strict_step_ms, latency_step_ms)``."""
+    engine, _ = _serve_engine_fixture(corpus, latency_mode=True)
+    gs = [g for g in corpus if engine.buckets[0].admits(g)][:requests]
+    if not gs:
+        raise RuntimeError("no corpus graph fits the smallest serving bucket")
+    bucket = engine.buckets[0]
+    reqs = [gs[i % len(gs)] for i in range(requests)]
+
+    # strict: score + host sync per request (what a one-at-a-time caller sees)
+    engine.latency_mode = False
+    engine.score([reqs[0]], bucket)  # warm (already compiled by warmup)
+    t0 = time.perf_counter()
+    for g in reqs:
+        engine.score([g], bucket)
+    strict_ms = (time.perf_counter() - t0) / len(reqs) * 1e3
+
+    # latency mode: window-deep in-flight donated dispatches, one blocking
+    # read per request ONCE the pipe is full
+    engine.latency_mode = True
+    pending = []
+    for g in reqs[:window]:
+        pending.append(engine.submit([g], bucket))  # fill (untimed)
+    t0 = time.perf_counter()
+    for g in reqs:
+        pending.append(engine.submit([g], bucket))
+        pending.pop(0).result()
+    latency_ms = (time.perf_counter() - t0) / len(reqs) * 1e3
+    for p in pending:
+        p.result()
+    return strict_ms, latency_ms
+
+
+def bench_int8_serving(corpus, requests_per_tier: int = 24,
+                       max_score_delta: float = 0.01):
+    """The ``int8_serving`` stage: per-tier p50/p99 of single-graph
+    ``score()`` dispatches at f32 and (gate permitting) int8. Returns the
+    kwargs for :func:`assemble_int8_serving_result` minus backend fields."""
+    eng_f32, _ = _serve_engine_fixture(corpus)
+    eng_int8, refusal = _serve_engine_fixture(
+        corpus, precision="int8", max_score_delta=max_score_delta)
+
+    def _tier_lat(engine, bucket):
+        gs = [g for g in corpus if bucket.admits(g)][:requests_per_tier]
+        if not gs:
+            return None
+        engine.score([gs[0]], bucket)  # warm
+        lat = []
+        for i in range(requests_per_tier):
+            g = gs[i % len(gs)]
+            t0 = time.perf_counter()
+            engine.score([g], bucket)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return {"p50_ms": round(float(np.percentile(lat, 50)), 3),
+                "p99_ms": round(float(np.percentile(lat, 99)), 3)}
+
+    tiers = {}
+    for b32, b8 in zip(eng_f32.buckets, eng_int8.buckets):
+        tiers[str(b32.graph_nodes)] = {
+            "f32": _tier_lat(eng_f32, b32),
+            "int8": (_tier_lat(eng_int8, b8)
+                     if eng_int8.precision == "int8" else None),
+        }
+    return {
+        "precision_served": eng_int8.precision,
+        "int8_score_delta": eng_int8.int8_score_delta,
+        "max_score_delta": max_score_delta,
+        "tiers": tiers,
+        "refused_reason": refusal,
     }
 
 
@@ -697,20 +965,86 @@ import functools
 
 
 @functools.lru_cache(maxsize=1)
-def _git_rev() -> str | None:
-    """Code provenance for the artifact: which commit produced this number."""
+def _git_provenance() -> tuple:
+    """Code provenance for every artifact: ``(full_commit_hash, dirty)``.
+
+    The old ``git describe`` path silently emitted ``git_rev: null`` on the
+    bench hosts (no ``git`` on PATH / ownership-untrusted clones), which
+    made whole artifact trajectories unattributable. Three tiers, all
+    failure-tolerant:
+
+    1. ``git rev-parse HEAD`` + ``git status --porcelain`` (with
+       ``safe.directory=*`` so root-owned CI clones don't trip the
+       dubious-ownership refusal); dirty = any non-empty status line.
+    2. No usable git binary: parse ``.git/HEAD`` (+ the ref file /
+       ``packed-refs``) by hand — hash-only, ``dirty=None`` (unknown).
+    3. Nothing readable: ``(None, None)`` — still never raises.
+    """
     import os
     import subprocess
 
-    try:
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    def _run(*args):
         out = subprocess.run(
-            ["git", "describe", "--always", "--dirty"],
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            capture_output=True, text=True, timeout=10,
-        )
-        return out.stdout.strip() or None
+            ["git", "-C", repo, "-c", "safe.directory=*", *args],
+            capture_output=True, text=True, timeout=10)
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr.strip())
+        return out.stdout
+
+    try:
+        rev = _run("rev-parse", "HEAD").strip() or None
+        if rev is None:
+            raise RuntimeError("empty rev-parse output")
+        try:
+            dirty = bool(_run("status", "--porcelain").strip())
+        except Exception:
+            dirty = None
+        return rev, dirty
     except Exception:
+        pass
+    try:
+        head = open(os.path.join(repo, ".git", "HEAD")).read().strip()
+        if head.startswith("ref:"):
+            ref = head.split(None, 1)[1]
+            ref_path = os.path.join(repo, ".git", *ref.split("/"))
+            if os.path.exists(ref_path):
+                return open(ref_path).read().strip() or None, None
+            packed = os.path.join(repo, ".git", "packed-refs")
+            if os.path.exists(packed):
+                for line in open(packed):
+                    if line.strip().endswith(" " + ref) or line.strip().endswith(ref):
+                        parts = line.split()
+                        if len(parts) == 2 and parts[1] == ref:
+                            return parts[0], None
+            return None, None
+        return head or None, None
+    except Exception:
+        return None, None
+
+
+def _git_rev() -> str | None:
+    """Back-compat shim (scripts/bench_int8_llm.py): hash with a ``-dirty``
+    suffix when the worktree had uncommitted changes."""
+    rev, dirty = _git_provenance()
+    if rev is None:
         return None
+    return f"{rev}-dirty" if dirty else rev
+
+
+def _provenance_fields() -> dict:
+    """The attribution block EVERY artifact assembler must spread into its
+    result: full commit hash + dirty flag (``git_dirty`` None = unknown,
+    e.g. hash recovered from ``.git/HEAD`` without a git binary) and the
+    emission wall clock (file mtimes reset on checkout/clone, so the replay
+    freshness window reads this embedded stamp instead)."""
+    rev, dirty = _git_provenance()
+    return {
+        "git_rev": rev,
+        "git_dirty": dirty,
+        "emitted_at_unix": int(time.time()),
+    }
 
 
 def _nominal_peak_tflops() -> float | None:
@@ -1382,10 +1716,7 @@ def _assemble_result(backend, device_kind, roofline, occupancy, real_graphs,
             "with typical MFU well under 5% — the ratio is a lower bound"
         ),
         "config": GOLDEN_CONFIG,
-        "git_rev": _git_rev(),
-        # wall-clock provenance: file mtimes reset on checkout/clone, so
-        # the replay freshness window reads this embedded stamp instead
-        "emitted_at_unix": int(time.time()),
+        **_provenance_fields(),
     }
     return result
 
@@ -1466,6 +1797,7 @@ def main():
     dense_error = dense_dropped = dense_by_shape = None
     fused = fused_real = fused_error = None
     chained_train = strict = sentinel_stats = emergency_stats = None
+    fused_train_stats = int8_serving_stats = strict_latency_stats = None
     peak_runs: dict[str, tuple] = {}
     peak_errors: dict[str, str] = {}
     base_gps = None
@@ -1491,6 +1823,12 @@ def main():
             r["sentinel"] = sentinel_stats
         if emergency_stats is not None:
             r["emergency_ckpt"] = emergency_stats
+        if fused_train_stats is not None:
+            r["fused_train"] = fused_train_stats
+        if int8_serving_stats is not None:
+            r["int8_serving"] = int8_serving_stats
+        if strict_latency_stats is not None:
+            r["strict_latency"] = strict_latency_stats
         tmp = partial_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(r, f)
@@ -1595,6 +1933,63 @@ def main():
             _progress(f"fused path failed: {fused_error}")
         bank("fused")
 
+        # Fused TRAIN step (the dispatch-gap tentpole): one jitted dispatch
+        # per batch covering forward + Pallas recompute-backward + optimizer
+        # update, gated at <= 0.8x the segment train step on the same data.
+        _progress("fused train step (ggnn_fused_train)")
+        try:
+            ft_k = max(args.chain // 4, 8) if backend == "tpu" else 4
+            ft_fused, ft_seg, ft_bg = bench_fused_train(
+                corpus, min(args.batches, 2), ft_k)
+            fused_train_stats = assemble_fused_train_result(
+                backend, device_kind, ft_fused, ft_seg, ft_bg)
+            _progress(
+                f"fused train: {ft_fused['step_ms']:.2f} ms vs segment "
+                f"{ft_seg['step_ms']:.2f} ms "
+                f"(ratio {fused_train_stats['ratio_vs_segment']})")
+        except Exception as e:  # recorded verbatim, never swallowed
+            fused_train_stats = assemble_fused_train_result(
+                backend, device_kind, None, None, None,
+                error=f"{type(e).__name__}: {e}")
+            _progress(f"fused train failed: {fused_train_stats['error']}")
+        bank("ggnn_fused_train")
+
+    if args.layout == "both":
+        # Serving-precision gate: int8 conv matmuls vs f32, tier p50/p99
+        # both ways; refusal-with-fallback counts as the gate WORKING.
+        _progress("int8 serving path (int8_serving)")
+        try:
+            int8_serving_stats = assemble_int8_serving_result(
+                backend, device_kind, **bench_int8_serving(corpus))
+            _progress(
+                f"int8 serving: precision={int8_serving_stats['value']} "
+                f"delta={int8_serving_stats['int8_score_delta']}")
+        except Exception as e:  # recorded verbatim, never swallowed
+            int8_serving_stats = assemble_int8_serving_result(
+                backend, device_kind, None, None, None, None,
+                error=f"{type(e).__name__}: {e}")
+            _progress(f"int8 serving failed: {int8_serving_stats['error']}")
+        bank("int8_serving")
+
+        # Warm device-resident engine loop: donated-buffer submits with
+        # LATENCY_WINDOW_DEPTH in flight vs per-request strict sync.
+        _progress("latency-mode engine loop (strict_latency)")
+        try:
+            sl_strict, sl_latency = bench_strict_latency(corpus)
+            strict_latency_stats = assemble_strict_latency_result(
+                backend, device_kind, sl_strict, sl_latency,
+                LATENCY_WINDOW_DEPTH, 64)
+            _progress(
+                f"strict {sl_strict:.2f} ms vs latency-mode "
+                f"{sl_latency:.2f} ms per request "
+                f"(ratio {strict_latency_stats['ratio_vs_strict']})")
+        except Exception as e:  # recorded verbatim, never swallowed
+            strict_latency_stats = assemble_strict_latency_result(
+                backend, device_kind, None, None, LATENCY_WINDOW_DEPTH, 64,
+                error=f"{type(e).__name__}: {e}")
+            _progress(f"strict latency failed: {strict_latency_stats['error']}")
+        bank("strict_latency")
+
     # Dense-adjacency LAST: it is the wedge-prone stage (per-shape compiles
     # of the n^2 forward through the tunnel) - everything above is already
     # banked if it takes the tunnel down.
@@ -1632,6 +2027,12 @@ def main():
         result["sentinel"] = sentinel_stats
     if emergency_stats is not None:
         result["emergency_ckpt"] = emergency_stats
+    if fused_train_stats is not None:
+        result["fused_train"] = fused_train_stats
+    if int8_serving_stats is not None:
+        result["int8_serving"] = int8_serving_stats
+    if strict_latency_stats is not None:
+        result["strict_latency"] = strict_latency_stats
     print(json.dumps(result))
 
 

@@ -128,6 +128,9 @@ class GGNNConfig:
     # hidden_dim-sized embedding table per family, concatenated after the
     # subkey embeddings — usually set via FeatureConfig.dataflow_families
     dataflow_families: bool = False
+    # fused-layout backward tier: auto (Pallas training kernel when
+    # fits_vmem_train admits the bucket, else XLA recompute) | pallas | xla
+    bwd_kernel: str = "auto"
 
     @property
     def out_dim(self) -> int:
@@ -274,6 +277,18 @@ class ServeConfig:
     cache_entries: int = 4096  # scan-cache capacity (content-addressed LRU)
     drain_timeout_s: float = 10.0  # graceful-shutdown budget for in-flight work
     latency_window: int = 2048  # ring buffer behind the p50/p99 latency gauges
+    # scoring precision: "f32" (default) or "int8" (int8-resident conv
+    # matmuls, calibrated at engine build and gated against f32 scores —
+    # the engine falls back to f32 with a journaled warning if the gate
+    # fails, see ScoringEngine.from_model)
+    precision: str = "f32"
+    # int8 accuracy gate: max |sigmoid(f32) - sigmoid(int8)| over the
+    # calibration batch before int8 is refused
+    int8_max_score_delta: float = 0.01
+    # keep one warm device-resident dispatch loop per bucket: inputs are
+    # donated to the jitted callable and scores come back as futures (no
+    # host sync inside submit) — strict-mode p99 approaches the chained rate
+    latency_mode: bool = False
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -286,6 +301,10 @@ class ServeConfig:
             raise ValueError("cache_entries must be >= 0")
         if self.latency_window < 1:
             raise ValueError("latency_window must be >= 1")
+        if self.precision not in ("f32", "int8"):
+            raise ValueError("precision must be 'f32' or 'int8'")
+        if self.int8_max_score_delta <= 0:
+            raise ValueError("int8_max_score_delta must be > 0")
 
 
 @dataclass(frozen=True)

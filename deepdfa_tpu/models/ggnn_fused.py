@@ -83,6 +83,9 @@ class GatedGraphConvFused(nn.Module):
     edges_sorted: bool = True
     dtype: Any = jnp.float32
     interpret: bool | None = None
+    # backward tier: "auto" picks the fused Pallas training kernel when
+    # fits_vmem_train admits the bucket, else the XLA recompute backward
+    bwd_kernel: str = "auto"
 
     def setup(self):
         if self.aggregation != "sum":
@@ -136,6 +139,7 @@ class GatedGraphConvFused(nn.Module):
             n_steps=self.n_steps,
             interpret=interpret,
             edges_sorted=self.edges_sorted,
+            bwd_kernel=self.bwd_kernel,
         )
         return out.astype(self.dtype)
 
@@ -151,4 +155,5 @@ class GGNNFused(GGNN):
             n_steps=self.cfg.n_steps,
             aggregation=self.cfg.aggregation,
             dtype=self.compute_dtype,
+            bwd_kernel=getattr(self.cfg, "bwd_kernel", "auto"),
         )

@@ -1,0 +1,210 @@
+"""GGNN with int8-resident message-passing matmuls — the serving precision
+path (``serve.precision=int8``).
+
+Same model family as :class:`deepdfa_tpu.models.ggnn.GGNN` (subclass, same
+``BatchedGraphs`` segment input, same embeddings/pooling/head), but every
+conv matmul — ``edge_linear`` and the two fused 3-gate GRU projections —
+runs through :func:`deepdfa_tpu.ops.int8_matmul.int8_matmul` against int8
+weights with per-output-channel f32 scales. At the serving bucket ladder
+the hidden-32 conv matmuls are memory-bound, so halving weight bytes is a
+straight bandwidth win (ROADMAP direction 2b).
+
+The int8 conv is inference-only: ``int8_matmul`` is differentiable w.r.t.
+activations only (frozen-base convention), and the serving engine is the
+only caller. Embeddings, pooling, and the classifier head stay f32 —
+they are gathers and tiny [out_in, 1]-ish matmuls where quantisation buys
+nothing and costs accuracy.
+
+Weights are NOT trained in int8: :func:`quantize_conv_params` calibrates
+an existing f32 checkpoint tree at engine build time (symmetric absmax via
+:func:`~deepdfa_tpu.ops.int8_matmul.calibrate_int8`), producing the
+``{q, scale, bias}`` leaves this model consumes. The engine gates the
+result against f32 scores before serving it (``serve.int8_max_score_delta``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepdfa_tpu.models.ggnn import GGNN
+from deepdfa_tpu.ops.int8_matmul import calibrate_int8, int8_matmul
+from deepdfa_tpu.ops.segment import gather, segment_sum
+
+__all__ = ["GGNNInt8", "GatedGraphConvInt8", "quantize_conv_params"]
+
+# conv param leaves replaced by quantize_conv_params, relative to the model's
+# "ggnn" scope — everything else in the tree passes through untouched
+_CONV_DENSE_PATHS = (
+    ("edge_linear",),
+    ("gru", "x_proj"),
+    ("gru", "h_proj"),
+)
+
+
+class _Int8Dense(nn.Module):
+    """Parameter container for one quantized Dense: ``q`` int8 ``[K, N]``,
+    ``scale`` f32 ``[N]``, ``bias`` f32 ``[N]`` (the ``QuantizedLeaf``
+    layout plus the bias, which stays f32 — it adds post-scale). Inits are
+    placeholders (zeros/ones): real values always come from
+    :func:`quantize_conv_params` on a trained f32 tree."""
+
+    in_features: int
+    features: int
+
+    def setup(self):
+        self.q = self.param(
+            "q", nn.initializers.zeros_init(),
+            (self.in_features, self.features), jnp.int8,
+        )
+        self.scale = self.param(
+            "scale", nn.initializers.ones_init(), (self.features,), jnp.float32
+        )
+        self.bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,), jnp.float32
+        )
+
+    def __call__(self, x: jnp.ndarray, *, interpret: bool) -> jnp.ndarray:
+        # hidden widths here are 128-ish: 128-cubed blocks avoid the LLM
+        # default block_k=512 padding 4x along K
+        return int8_matmul(
+            x, self.q, self.scale,
+            block_m=128, block_n=128, block_k=128,
+            out_dtype=jnp.float32, interpret=interpret,
+        ) + self.bias
+
+
+class _Int8GRU(nn.Module):
+    """GRUCell's tree with both fused 3-gate projections int8-resident."""
+
+    features: int
+
+    def setup(self):
+        self.x_proj = _Int8Dense(self.features, 3 * self.features)
+        self.h_proj = _Int8Dense(self.features, 3 * self.features)
+
+    def __call__(self, x, h, *, interpret: bool) -> jnp.ndarray:
+        xp = self.x_proj(x, interpret=interpret)
+        hp = self.h_proj(h, interpret=interpret)
+        xr, xz, xn = jnp.split(xp, 3, axis=-1)
+        hr, hz, hn = jnp.split(hp, 3, axis=-1)
+        r = nn.sigmoid(xr + hr)
+        z = nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        return (1.0 - z) * n + z * h
+
+
+class GatedGraphConvInt8(nn.Module):
+    """Segment-layout :class:`GatedGraphConv` (sum aggregation) with the
+    three conv matmuls int8-resident. Scope names (``edge_linear``,
+    ``gru/{x_proj,h_proj}``) mirror the f32 layouts so
+    :func:`quantize_conv_params` maps leaves 1:1.
+
+    ``interpret``: None auto-selects the Pallas interpreter off-TPU,
+    exactly like the fused layout.
+    """
+
+    out_feats: int
+    n_steps: int
+    aggregation: str = "sum"
+    edges_sorted: bool = True
+    dtype: Any = jnp.float32
+    interpret: bool | None = None
+
+    def setup(self):
+        if self.aggregation != "sum":
+            raise ValueError(
+                f"precision=int8 supports aggregation='sum' only; got "
+                f"{self.aggregation!r} — serve the union-lattice aggregators "
+                f"at f32"
+            )
+        self.edge_linear = _Int8Dense(self.out_feats, self.out_feats)
+        self.gru = _Int8GRU(self.out_feats)
+
+    def __call__(
+        self, h: jnp.ndarray, senders: jnp.ndarray, receivers: jnp.ndarray,
+        taps: tuple | None = None,
+    ) -> jnp.ndarray:
+        if taps is not None:
+            raise ValueError(
+                "per-step taps are a training diagnostic — the int8 conv is "
+                "a serving path (use layout=segment at f32)"
+            )
+        n_nodes = h.shape[0]
+        if self.edges_sorted and not isinstance(receivers, jax.core.Tracer):
+            r = np.asarray(receivers)
+            if r.size and np.any(np.diff(r) < 0):
+                raise ValueError(
+                    "edges_sorted=True but receivers are not sorted by "
+                    "receiver — pass edges_sorted=False for hand-built edge "
+                    "lists, or sort them (batch_np does this on the host)"
+                )
+        if h.shape[-1] > self.out_feats:
+            raise ValueError("in_feats must be <= out_feats (DGL contract)")
+        if h.shape[-1] < self.out_feats:
+            pad = jnp.zeros((n_nodes, self.out_feats - h.shape[-1]), h.dtype)
+            h = jnp.concatenate([h, pad], axis=-1)
+        interpret = self.interpret
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        h = h.astype(jnp.float32)
+        for _step in range(self.n_steps):
+            msg_src = self.edge_linear(h, interpret=interpret)
+            agg = segment_sum(gather(msg_src, senders), receivers, n_nodes,
+                              indices_are_sorted=self.edges_sorted)
+            h = self.gru(agg, h, interpret=interpret)
+        return h.astype(self.dtype)
+
+
+class GGNNInt8(GGNN):
+    """:class:`GGNN` with the conv swapped for the int8-resident matmul
+    path. Consumed only by the serving engine (``serve.precision=int8``)."""
+
+    def _conv(self, hidden_dim: int) -> nn.Module:
+        return GatedGraphConvInt8(
+            out_feats=hidden_dim,
+            n_steps=self.cfg.n_steps,
+            aggregation=self.cfg.aggregation,
+            dtype=self.compute_dtype,
+        )
+
+
+def quantize_conv_params(variables: dict) -> dict:
+    """Calibrate a trained f32 variables tree into the :class:`GGNNInt8`
+    tree: for each conv Dense (``ggnn/edge_linear``, ``ggnn/gru/x_proj``,
+    ``ggnn/gru/h_proj``) the ``kernel`` leaf becomes ``{q, scale}`` via
+    :func:`calibrate_int8`; biases and every other leaf (embeddings, pooling
+    gate, head) pass through unchanged.
+
+    Raises ``ValueError`` (propagated from ``calibrate_int8``) on non-finite
+    kernels — a poisoned checkpoint must not be silently clamped into a
+    serving artifact. Host-side, once per engine build.
+    """
+    params = dict(variables.get("params", variables))
+    if "ggnn" not in params:
+        raise ValueError(
+            "quantize_conv_params: no 'ggnn' scope in params — expected a "
+            "GGNN/GGNNFused variables tree"
+        )
+
+    def _q(dense: dict) -> dict:
+        q, scale = calibrate_int8(dense["kernel"])
+        return {"q": q, "scale": scale, "bias": jnp.asarray(dense["bias"], jnp.float32)}
+
+    ggnn = dict(params["ggnn"])
+    for path in _CONV_DENSE_PATHS:
+        node = ggnn
+        for key in path[:-1]:
+            node[key] = dict(node[key])
+            node = node[key]
+        node[path[-1]] = _q(node[path[-1]])
+    params["ggnn"] = ggnn
+    if "params" in variables:
+        out = dict(variables)
+        out["params"] = params
+        return out
+    return params

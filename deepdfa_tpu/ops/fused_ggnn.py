@@ -26,11 +26,24 @@ loop over the receiver-sorted edge list. ``interpret=True`` (any non-TPU
 backend) runs the same kernel under the Pallas interpreter so the CPU
 suite exercises it without hardware.
 
-Differentiable via ``custom_vjp``: the backward re-runs the unrolled
-forward from the banked inputs in plain XLA ops (the working set is tiny,
-recompute is cheaper than banking five rounds of states) and reverse-
-differentiates it — gradient parity with the segment path is exact because
-the math is identical (``tests/test_fused_ggnn.py``).
+Differentiable via ``custom_vjp`` with a TWO-TIER backward:
+
+- **Pallas training kernel** (``bwd_kernel="pallas"``, auto-selected when
+  :func:`fits_vmem_train` admits the bucket): one kernel launch with grid
+  ``(2·n_steps,)`` — the first ``n_steps`` grid steps recompute the forward
+  banking each round's pre-update node state into a VMEM history scratch,
+  the second ``n_steps`` run the reverse rounds off the banked states with
+  every gradient accumulator (dh, dW for all five weight matrices) resident
+  in VMEM. Forward + backward is then exactly TWO launches per batch, and
+  the train step (loss, grads, optimizer update, sentinel guard) lowers to
+  ONE jitted dispatch around them.
+- **XLA recompute fallback** (``bwd_kernel="xla"``): re-runs the unrolled
+  forward from the banked inputs in plain XLA ops and reverse-
+  differentiates it — always available, used when the training working set
+  (history bank + gradient accumulators) exceeds the VMEM plan.
+
+Gradient parity with the segment path holds on both tiers because the math
+is identical (``tests/test_fused_ggnn.py`` / ``tests/test_fused_train.py``).
 """
 
 from __future__ import annotations
@@ -43,7 +56,14 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["fused_ggnn", "working_set_bytes", "fits_vmem", "VMEM_CAP_BYTES"]
+__all__ = [
+    "fused_ggnn",
+    "working_set_bytes",
+    "fits_vmem",
+    "train_working_set_bytes",
+    "fits_vmem_train",
+    "VMEM_CAP_BYTES",
+]
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -91,6 +111,45 @@ def fits_vmem(n_nodes: int, n_edges: int, width: int) -> bool:
     over the cap (e.g. the worst-case overflow rescue bucket) take the
     segment-layout fallback — correctness is never gated on VMEM."""
     return working_set_bytes(n_nodes, n_edges, width) <= VMEM_CAP_BYTES
+
+
+def train_working_set_bytes(
+    n_nodes: int, n_edges: int, width: int, n_steps: int
+) -> int:
+    """Conservative VMEM working set of the fused TRAINING (backward)
+    kernel. On top of the forward's blocks it must hold the per-round
+    state history bank (``n_steps`` node blocks — the recompute forward
+    banks each pre-update state so the reverse rounds read them at VMEM
+    latency) and the resident gradient accumulators: dh carry, per-round
+    dagg/dmsg temps, the 3-gate cotangent blocks, and one gradient block
+    per weight/bias. Shapes padded exactly as the wrapper pads them."""
+    np_ = _round_up(max(n_nodes, 8), 8)
+    dp = _round_up(max(width, 1), 128)
+    ep = _round_up(max(n_edges, 1), 128)
+    node_block = np_ * dp * 4
+    # h0 in, g in, dh0 out, hcur/msg/agg/dagg/dmsg scratch
+    node_blocks = 8 * node_block
+    hist = n_steps * node_block
+    # xp/hp recompute + dxp/dhp cotangents (3-gate width) + r/z/n-style
+    # vector temporaries Mosaic materialises in VMEM
+    gate_blocks = (4 * 3 + 6) * node_block
+    # weights AND their resident gradient accumulators
+    weights = 2 * (dp * dp + 2 * dp * 3 * dp + 7 * dp) * 4
+    edges = 2 * 8 * ep * 4
+    return node_blocks + hist + gate_blocks + weights + edges
+
+
+def fits_vmem_train(
+    n_nodes: int, n_edges: int, width: int, n_steps: int
+) -> bool:
+    """Whether a bucket is safe for the fused TRAINING kernel (history bank
+    + gradient accumulators resident). Over-plan buckets keep the fused
+    forward but take the XLA recompute backward; buckets over the forward
+    plan (:func:`fits_vmem`) drop to the segment twin entirely."""
+    return (
+        train_working_set_bytes(n_nodes, n_edges, width, n_steps)
+        <= VMEM_CAP_BYTES
+    )
 
 
 def _pack_gates(w: jnp.ndarray, d: int, dp: int) -> jnp.ndarray:
@@ -149,6 +208,222 @@ def _kernel(h0_ref, snd_ref, rcv_ref, ew_ref, eb_ref, xw_ref, xb_ref,
     out_ref[:] = (1.0 - z) * n + z * h
 
 
+def _unpack_gates(wp: jnp.ndarray, d: int, dp: int) -> jnp.ndarray:
+    """Inverse of :func:`_pack_gates`: slice a ``[dp, 3dp]`` per-gate padded
+    block back to the ``[d, 3d]`` fused layout."""
+    return wp.reshape(dp, 3, dp)[:d, :, :d].reshape(d, 3 * d)
+
+
+def _unpack_gate_bias(bp: jnp.ndarray, d: int, dp: int) -> jnp.ndarray:
+    return bp.reshape(3, dp)[:, :d].reshape(3 * d)
+
+
+def _train_kernel(h0_ref, snd_ref, rcv_ref, ew_ref, eb_ref, xw_ref, xb_ref,
+                  hw_ref, hb_ref, g_ref,
+                  dh0_ref, dew_ref, deb_ref, dxw_ref, dxb_ref, dhw_ref,
+                  dhb_ref, hist_ref, hcur_ref, msg_ref, agg_ref, dagg_ref,
+                  dmsg_ref, *, n_edges: int, width: int, n_steps: int):
+    """Fused training backward: grid ``(2·n_steps,)``, executed sequentially
+    on TPU so every output/scratch block stays VMEM-resident across the
+    whole recompute-forward + reverse sweep.
+
+    Steps ``0..n_steps-1`` recompute the forward, banking each round's
+    PRE-update node state into ``hist``; steps ``n_steps..2·n_steps-1`` run
+    round ``t = 2·n_steps-1-step`` of reverse-mode accumulation: gates are
+    recomputed from the banked state (cheaper than banking them — one
+    extra pair of matmuls vs six more resident 3-gate blocks) and the
+    cotangent chain mirrors the forward exactly:
+
+        h' = (1-z)·n + z·h  ⇒  dz = g·(h-n); dn = g·(1-z); dh += g·z
+        n = tanh(xn + r·hn) ⇒  dpre_n = dn·(1-n²); dr = dpre_n·hn
+        r, z = σ(·)         ⇒  dpre_r = dr·r·(1-r); dpre_z = dz·z·(1-z)
+        agg[r] += msg[s]    ⇒  dmsg[s] += dagg[r]  (transpose edge loop)
+
+    ``dh0_ref`` doubles as the running dh carry — after the last reverse
+    round it IS dL/dh0."""
+    step = pl.program_id(0)
+    d = width
+    f32 = jnp.float32
+
+    @pl.when(step == 0)
+    def _load():
+        hcur_ref[:] = h0_ref[:]
+
+    @pl.when(step < n_steps)
+    def _forward_bank():
+        t = step
+        h = hcur_ref[:]
+        hist_ref[pl.ds(t, 1)] = h[None]
+        msg_ref[:] = jnp.dot(h, ew_ref[:], preferred_element_type=f32) + eb_ref[:]
+        agg_ref[:] = jnp.zeros_like(agg_ref)
+
+        def edge_body(e, carry):
+            s = snd_ref[0, e]
+            r = rcv_ref[0, e]
+            agg_ref[pl.ds(r, 1), :] += msg_ref[pl.ds(s, 1), :]
+            return carry
+
+        jax.lax.fori_loop(0, n_edges, edge_body, 0)
+        xp = jnp.dot(agg_ref[:], xw_ref[:], preferred_element_type=f32) + xb_ref[:]
+        hp = jnp.dot(h, hw_ref[:], preferred_element_type=f32) + hb_ref[:]
+        r = jax.nn.sigmoid(xp[:, :d] + hp[:, :d])
+        z = jax.nn.sigmoid(xp[:, d:2 * d] + hp[:, d:2 * d])
+        n = jnp.tanh(xp[:, 2 * d:] + r * hp[:, 2 * d:])
+        hcur_ref[:] = (1.0 - z) * n + z * h
+
+    @pl.when(step == n_steps)
+    def _init_grads():
+        dh0_ref[:] = g_ref[:]
+        dew_ref[:] = jnp.zeros_like(dew_ref)
+        deb_ref[:] = jnp.zeros_like(deb_ref)
+        dxw_ref[:] = jnp.zeros_like(dxw_ref)
+        dxb_ref[:] = jnp.zeros_like(dxb_ref)
+        dhw_ref[:] = jnp.zeros_like(dhw_ref)
+        dhb_ref[:] = jnp.zeros_like(dhb_ref)
+
+    @pl.when(step >= n_steps)
+    def _reverse():
+        t = 2 * n_steps - 1 - step
+        h = hist_ref[pl.ds(t, 1)][0]
+        # recompute round t's intermediates from the banked state
+        msg_ref[:] = jnp.dot(h, ew_ref[:], preferred_element_type=f32) + eb_ref[:]
+        agg_ref[:] = jnp.zeros_like(agg_ref)
+
+        def edge_body(e, carry):
+            s = snd_ref[0, e]
+            r = rcv_ref[0, e]
+            agg_ref[pl.ds(r, 1), :] += msg_ref[pl.ds(s, 1), :]
+            return carry
+
+        jax.lax.fori_loop(0, n_edges, edge_body, 0)
+        xp = jnp.dot(agg_ref[:], xw_ref[:], preferred_element_type=f32) + xb_ref[:]
+        hp = jnp.dot(h, hw_ref[:], preferred_element_type=f32) + hb_ref[:]
+        r = jax.nn.sigmoid(xp[:, :d] + hp[:, :d])
+        z = jax.nn.sigmoid(xp[:, d:2 * d] + hp[:, d:2 * d])
+        hn = hp[:, 2 * d:]
+        n = jnp.tanh(xp[:, 2 * d:] + r * hn)
+
+        g = dh0_ref[:]
+        dz = g * (h - n)
+        dn = g * (1.0 - z)
+        dpre_n = dn * (1.0 - n * n)
+        dr = dpre_n * hn
+        dpre_r = dr * r * (1.0 - r)
+        dpre_z = dz * z * (1.0 - z)
+        dxp = jnp.concatenate([dpre_r, dpre_z, dpre_n], axis=1)
+        dhp = jnp.concatenate([dpre_r, dpre_z, dpre_n * r], axis=1)
+
+        contract_last = (((1,), (1,)), ((), ()))   # a @ b.T
+        contract_rows = (((0,), (0,)), ((), ()))   # a.T @ b
+        # x-projection: xp = agg @ xw + xb
+        dagg_ref[:] = jax.lax.dot_general(
+            dxp, xw_ref[:], contract_last, preferred_element_type=f32)
+        dxw_ref[:] += jax.lax.dot_general(
+            agg_ref[:], dxp, contract_rows, preferred_element_type=f32)
+        dxb_ref[:] += jnp.sum(dxp, axis=0, keepdims=True)
+        # h-projection: hp = h @ hw + hb (plus the direct z·h path)
+        dh = g * z + jax.lax.dot_general(
+            dhp, hw_ref[:], contract_last, preferred_element_type=f32)
+        dhw_ref[:] += jax.lax.dot_general(
+            h, dhp, contract_rows, preferred_element_type=f32)
+        dhb_ref[:] += jnp.sum(dhp, axis=0, keepdims=True)
+        # transpose of the receiver-ordered accumulation
+        dmsg_ref[:] = jnp.zeros_like(dmsg_ref)
+
+        def edge_body_t(e, carry):
+            s = snd_ref[0, e]
+            r = rcv_ref[0, e]
+            dmsg_ref[pl.ds(s, 1), :] += dagg_ref[pl.ds(r, 1), :]
+            return carry
+
+        jax.lax.fori_loop(0, n_edges, edge_body_t, 0)
+        # edge linear: msg = h @ ew + eb
+        dh = dh + jax.lax.dot_general(
+            dmsg_ref[:], ew_ref[:], contract_last, preferred_element_type=f32)
+        dew_ref[:] += jax.lax.dot_general(
+            h, dmsg_ref[:], contract_rows, preferred_element_type=f32)
+        deb_ref[:] += jnp.sum(dmsg_ref[:], axis=0, keepdims=True)
+        dh0_ref[:] = dh
+
+
+def _pallas_train_bwd(h0, senders, receivers, ew, eb, xw, xb, hw, hb, g,
+                      n_steps: int, interpret: bool):
+    """Dispatch the fused training kernel; returns UNPADDED cotangents
+    ``(dh0, dew, deb, dxw, dxb, dhw, dhb)`` in f32."""
+    n, d = h0.shape
+    e = senders.shape[0]
+    np_ = _round_up(max(n, 8), 8)
+    dp = _round_up(max(d, 1), 128)
+    ep = _round_up(max(e, 1), 128)
+
+    h0p = jnp.pad(h0.astype(jnp.float32), ((0, np_ - n), (0, dp - d)))
+    gp = jnp.pad(g.astype(jnp.float32), ((0, np_ - n), (0, dp - d)))
+    sndp = jnp.pad(senders.astype(jnp.int32), (0, ep - e)).reshape(1, ep)
+    rcvp = jnp.pad(receivers.astype(jnp.int32), (0, ep - e)).reshape(1, ep)
+    ewp = jnp.pad(ew.astype(jnp.float32), ((0, dp - d), (0, dp - d)))
+    ebp = jnp.pad(eb.astype(jnp.float32), (0, dp - d)).reshape(1, dp)
+    xwp = _pack_gates(xw.astype(jnp.float32), d, dp)
+    xbp = _pack_gate_bias(xb.astype(jnp.float32), d, dp)
+    hwp = _pack_gates(hw.astype(jnp.float32), d, dp)
+    hbp = _pack_gate_bias(hb.astype(jnp.float32), d, dp)
+
+    full = lambda shape: pl.BlockSpec(shape, lambda s: tuple(0 for _ in shape),
+                                      memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        functools.partial(_train_kernel, n_edges=e, width=dp, n_steps=n_steps),
+        grid=(2 * n_steps,),
+        in_specs=[
+            full((np_, dp)),            # h0
+            full((1, ep)),              # senders
+            full((1, ep)),              # receivers
+            full((dp, dp)),             # edge_linear kernel
+            full((1, dp)),              # edge_linear bias
+            full((dp, 3 * dp)),         # gru x_proj kernel
+            full((1, 3 * dp)),          # gru x_proj bias
+            full((dp, 3 * dp)),         # gru h_proj kernel
+            full((1, 3 * dp)),          # gru h_proj bias
+            full((np_, dp)),            # incoming cotangent g
+        ],
+        out_specs=[
+            full((np_, dp)),            # dh0 (doubles as the dh carry)
+            full((dp, dp)),             # dew
+            full((1, dp)),              # deb
+            full((dp, 3 * dp)),         # dxw
+            full((1, 3 * dp)),          # dxb
+            full((dp, 3 * dp)),         # dhw
+            full((1, 3 * dp)),          # dhb
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((np_, dp), jnp.float32),
+            jax.ShapeDtypeStruct((dp, dp), jnp.float32),
+            jax.ShapeDtypeStruct((1, dp), jnp.float32),
+            jax.ShapeDtypeStruct((dp, 3 * dp), jnp.float32),
+            jax.ShapeDtypeStruct((1, 3 * dp), jnp.float32),
+            jax.ShapeDtypeStruct((dp, 3 * dp), jnp.float32),
+            jax.ShapeDtypeStruct((1, 3 * dp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n_steps, np_, dp), jnp.float32),   # hist
+            pltpu.VMEM((np_, dp), jnp.float32),            # hcur
+            pltpu.VMEM((np_, dp), jnp.float32),            # msg
+            pltpu.VMEM((np_, dp), jnp.float32),            # agg
+            pltpu.VMEM((np_, dp), jnp.float32),            # dagg
+            pltpu.VMEM((np_, dp), jnp.float32),            # dmsg
+        ],
+        interpret=interpret,
+    )(h0p, sndp, rcvp, ewp, ebp, xwp, xbp, hwp, hbp, gp)
+    dh0p, dewp, debp, dxwp, dxbp, dhwp, dhbp = outs
+    return (
+        dh0p[:n, :d],
+        dewp[:d, :d],
+        debp[0, :d],
+        _unpack_gates(dxwp, d, dp),
+        _unpack_gate_bias(dxbp, d, dp),
+        _unpack_gates(dhwp, d, dp),
+        _unpack_gate_bias(dhbp, d, dp),
+    )
+
+
 def _unrolled_reference(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
                         n_steps: int, edges_sorted: bool):
     """The same math in plain XLA ops — the recompute the backward
@@ -173,9 +448,10 @@ def _unrolled_reference(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
     return h
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
 def _fused_ggnn(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
-                n_steps: int, interpret: bool, edges_sorted: bool):
+                n_steps: int, interpret: bool, edges_sorted: bool,
+                bwd_kernel: str):
     n, d = h0.shape
     e = senders.shape[0]
     if n_steps == 0:
@@ -224,27 +500,38 @@ def _fused_ggnn(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
 
 
 def _fused_ggnn_fwd(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
-                    n_steps, interpret, edges_sorted):
+                    n_steps, interpret, edges_sorted, bwd_kernel):
     out = _fused_ggnn(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
-                      n_steps, interpret, edges_sorted)
+                      n_steps, interpret, edges_sorted, bwd_kernel)
     # recompute-based backward: bank the (tiny) inputs, not per-round states
     return out, (h0, senders, receivers, ew, eb, xw, xb, hw, hb)
 
 
-def _fused_ggnn_bwd(n_steps, interpret, edges_sorted, res, g):
+def _fused_ggnn_bwd(n_steps, interpret, edges_sorted, bwd_kernel, res, g):
     h0, senders, receivers, ew, eb, xw, xb, hw, hb = res
+    n, d = h0.shape
+    e = senders.shape[0]
+    if bwd_kernel not in ("auto", "pallas", "xla"):
+        raise ValueError(f"bwd_kernel must be auto|pallas|xla, got {bwd_kernel!r}")
+    use_pallas = n_steps > 0 and (
+        bwd_kernel == "pallas"
+        or (bwd_kernel == "auto" and fits_vmem_train(n, e, d, n_steps)))
+    if use_pallas:
+        dh0, dew, deb, dxw, dxb, dhw, dhb = _pallas_train_bwd(
+            h0, senders, receivers, ew, eb, xw, xb, hw, hb, g,
+            n_steps, interpret)
+    else:
+        def ref(h0_, ew_, eb_, xw_, xb_, hw_, hb_):
+            return _unrolled_reference(
+                h0_.astype(jnp.float32), senders, receivers,
+                ew_.astype(jnp.float32), eb_.astype(jnp.float32),
+                xw_.astype(jnp.float32), xb_.astype(jnp.float32),
+                hw_.astype(jnp.float32), hb_.astype(jnp.float32),
+                n_steps, edges_sorted,
+            )
 
-    def ref(h0_, ew_, eb_, xw_, xb_, hw_, hb_):
-        return _unrolled_reference(
-            h0_.astype(jnp.float32), senders, receivers,
-            ew_.astype(jnp.float32), eb_.astype(jnp.float32),
-            xw_.astype(jnp.float32), xb_.astype(jnp.float32),
-            hw_.astype(jnp.float32), hb_.astype(jnp.float32),
-            n_steps, edges_sorted,
-        )
-
-    _, vjp = jax.vjp(ref, h0, ew, eb, xw, xb, hw, hb)
-    dh0, dew, deb, dxw, dxb, dhw, dhb = vjp(g.astype(jnp.float32))
+        _, vjp = jax.vjp(ref, h0, ew, eb, xw, xb, hw, hb)
+        dh0, dew, deb, dxw, dxb, dhw, dhb = vjp(g.astype(jnp.float32))
     # integer primals take float0 cotangents (JAX's tangent space for ints)
     dsnd = np.zeros(senders.shape, jax.dtypes.float0)
     drcv = np.zeros(receivers.shape, jax.dtypes.float0)
@@ -257,7 +544,8 @@ _fused_ggnn.defvjp(_fused_ggnn_fwd, _fused_ggnn_bwd)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("n_steps", "interpret", "edges_sorted"))
+                   static_argnames=("n_steps", "interpret", "edges_sorted",
+                                    "bwd_kernel"))
 def fused_ggnn(
     h0: jnp.ndarray,
     senders: jnp.ndarray,
@@ -272,6 +560,7 @@ def fused_ggnn(
     n_steps: int,
     interpret: bool = False,
     edges_sorted: bool = True,
+    bwd_kernel: str = "auto",
 ) -> jnp.ndarray:
     """``n_steps`` rounds of (edge linear → gather(senders) →
     receiver-ordered sum → GRU) with ``h`` VMEM-resident throughout.
@@ -286,7 +575,10 @@ def fused_ggnn(
     dtype (the VMEM-resident state is the accuracy-critical accumulator).
     ``interpret=True`` runs the same kernel under the Pallas interpreter
     (CPU tests). Differentiable w.r.t. ``h0`` and all weights via a
-    recompute-based ``custom_vjp``.
+    recompute-based ``custom_vjp``; ``bwd_kernel`` selects the backward
+    tier — ``"pallas"`` forces the fused training kernel, ``"xla"`` the
+    plain recompute, ``"auto"`` (default) picks Pallas exactly when
+    :func:`fits_vmem_train` admits the bucket.
     """
     return _fused_ggnn(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
-                       n_steps, interpret, edges_sorted)
+                       n_steps, interpret, edges_sorted, bwd_kernel)

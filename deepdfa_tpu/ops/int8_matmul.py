@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["int8_matmul"]
+__all__ = ["int8_matmul", "calibrate_int8"]
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -171,3 +171,37 @@ def int8_matmul(
     so LoRA adapters can train through an int8-resident stack."""
     return _int8_matmul(x, q, scale, block_m, block_n, block_k,
                         jnp.dtype(out_dtype), interpret)
+
+
+def calibrate_int8(w) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-output-channel int8 calibration of a ``[K, N]`` weight.
+
+    Returns ``(q int8 [K, N], scale f32 [N])`` such that
+    ``q * scale ≈ w`` — the exact layout :func:`int8_matmul` consumes (and
+    the ``QuantizedLeaf`` convention of ``llm/quant.py``). Edge cases are
+    explicit rather than silent:
+
+    - zero-range columns (all-zero weights) get ``scale = 1.0`` and
+      ``q = 0`` so the dequantised column is exactly zero, not ``0/0``;
+    - all-negative columns calibrate off ``|w|`` like any other (symmetric
+      absmax), so the full ``[-127, 127]`` range is used;
+    - NON-FINITE weights raise ``ValueError`` — a NaN/inf-poisoned
+      calibration source would otherwise clamp to ±127 and serve garbage
+      scores with no signal.
+
+    Host-side (numpy semantics via jnp on concrete arrays): calibration
+    happens once at engine build, never inside a jitted trace.
+    """
+    w = jnp.asarray(w, dtype=jnp.float32)
+    if w.ndim != 2:
+        raise ValueError(f"calibrate_int8 expects a [K, N] weight, got shape {w.shape}")
+    if not bool(jnp.all(jnp.isfinite(w))):
+        raise ValueError(
+            "calibrate_int8: non-finite values in calibration weights — "
+            "refusing to quantize a NaN/inf-poisoned source (clamping would "
+            "silently corrupt every score through this matmul)"
+        )
+    absmax = jnp.max(jnp.abs(w), axis=0)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale

@@ -89,6 +89,13 @@ def make_dp_train_step(
     axis. Params/opt-state/metrics are replicated; the gradient all-reduce is
     a single fused psum over ICI.
 
+    With ``donate=True`` BOTH the state (arg 0) and the metrics tree (arg 2)
+    are donated: each maps 1:1 onto an output of identical shape/dtype, so
+    XLA updates params/opt-state/confusion counters in place instead of
+    allocating a second copy. Callers must rebind both from the return value
+    (``state, metrics, loss, wsum = step(state, batch, metrics)``) — the
+    passed-in buffers are dead after the call.
+
     ``accum > 1`` enables gradient accumulation for mesh-elastic resume:
     each shard processes ``accum`` microbatches (stacked as ``[dp, accum,
     ...]`` by :func:`deepdfa_tpu.parallel.elastic.stack_elastic`), summing
@@ -166,7 +173,7 @@ def make_dp_train_step(
         )
         return fn(state, stacked_batch, metrics)
 
-    return jax.jit(wrapped, donate_argnums=(0,) if donate else ())
+    return jax.jit(wrapped, donate_argnums=(0, 2) if donate else ())
 
 
 def make_dp_eval_step(

@@ -25,6 +25,7 @@ the batcher, never as a dead server.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from pathlib import Path
 
 import numpy as np
@@ -32,7 +33,8 @@ import numpy as np
 from deepdfa_tpu.data.graphs import BucketSpec, Graph, _round_up, batch_np
 from deepdfa_tpu.resilience import faults
 
-__all__ = ["OversizeGraphError", "ServeBucket", "serve_buckets", "ScoringEngine"]
+__all__ = ["OversizeGraphError", "ServeBucket", "serve_buckets",
+           "ScoringEngine", "PendingScore"]
 
 
 class OversizeGraphError(ValueError):
@@ -74,16 +76,74 @@ def serve_buckets(max_batch: int) -> tuple[ServeBucket, ...]:
     return tuple(out)
 
 
+def _calibration_graphs(feat_keys, buckets, n_per_bucket: int = 4,
+                        seed: int = 0):
+    """Synthesized int8-gate inputs when the caller has no realworld
+    fixtures handy: a few random graphs per bucket size class (feature ids
+    in {0, 1} — valid rows in every embedding table). Deterministic
+    (seeded) so the gate verdict is reproducible across engine builds."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in buckets:
+        cap = min(b.graph_nodes, 48)
+        for _ in range(n_per_bucket):
+            n = int(rng.integers(max(2, cap // 2), cap + 1))
+            feats = {k: rng.integers(0, 2, size=n).astype(np.int32)
+                     for k in feat_keys}
+            out.append(Graph(
+                senders=rng.integers(0, n, size=2 * n).astype(np.int32),
+                receivers=rng.integers(0, n, size=2 * n).astype(np.int32),
+                node_feats=feats).with_self_loops())
+    return out
+
+
+class PendingScore:
+    """Handle returned by :meth:`ScoringEngine.submit` — the scores stay
+    device-resident (no host sync at dispatch); :meth:`result` is the one
+    blocking read."""
+
+    __slots__ = ("_dev", "_n")
+
+    def __init__(self, dev, n: int):
+        self._dev = dev
+        self._n = n
+
+    def result(self) -> np.ndarray:
+        return np.asarray(self._dev, np.float32)[: self._n]
+
+
 class ScoringEngine:
     """``score(graphs, bucket) -> fn_prob[len(graphs)]`` over a fixed
     bucket ladder. ``score_fn`` maps a padded ``BatchedGraphs`` to
-    per-graph probabilities ``[max_graphs]`` (already sigmoid'd)."""
+    per-graph probabilities ``[max_graphs]`` (already sigmoid'd).
+
+    ``device_fn`` (optional — the live-model constructors set it): a jitted
+    ``device batch -> device probs`` callable whose batch argument is
+    DONATED, enabling ``latency_mode`` — :meth:`submit` dispatches without
+    any host sync and hands back a :class:`PendingScore`; the input buffers
+    are consumed by the dispatch (donation) so a submitted batch is never
+    reused host-side. ``precision`` records which weight path the engine
+    serves (``f32`` or ``int8``); ``int8_score_delta`` the measured
+    calibration-batch gate value when int8 was requested."""
 
     def __init__(self, score_fn, buckets, label_style: str = "graph",
-                 feat_keys=(), vocab_hash: str | None = None):
+                 feat_keys=(), vocab_hash: str | None = None,
+                 device_fn=None, latency_mode: bool = False,
+                 precision: str = "f32",
+                 int8_score_delta: float | None = None):
         if not buckets:
             raise ValueError("need at least one serving bucket")
         self._score_fn = score_fn
+        self._device_fn = device_fn
+        if latency_mode and device_fn is None:
+            warnings.warn(
+                "latency_mode requires a jit-safe device_fn (live-model "
+                "engines only — StableHLO artifact reductions run host-side); "
+                "serving in synchronous mode", stacklevel=2)
+            latency_mode = False
+        self.latency_mode = latency_mode
+        self.precision = precision
+        self.int8_score_delta = int8_score_delta
         self.buckets = tuple(sorted(
             buckets, key=lambda b: (b.graph_nodes, b.spec.max_graphs)))
         self.label_style = label_style
@@ -106,7 +166,10 @@ class ScoringEngine:
 
     def score(self, graphs, bucket: ServeBucket) -> np.ndarray:
         """Pad ``graphs`` (all pre-routed to ``bucket``) and dispatch one
-        compiled call; returns the real graphs' probabilities."""
+        compiled call; returns the real graphs' probabilities. In latency
+        mode this is submit + blocking read — same semantics, one sync."""
+        if self.latency_mode:
+            return self.submit(graphs, bucket).result()
         faults.raise_if("serve.engine_raises")
         graphs = list(graphs)
         batch = batch_np(graphs, bucket.spec.max_graphs,
@@ -114,6 +177,28 @@ class ScoringEngine:
         probs = np.asarray(self._score_fn(batch), np.float32)
         self.n_dispatches += 1
         return probs[: len(graphs)]
+
+    def submit(self, graphs, bucket: ServeBucket) -> PendingScore:
+        """Latency-mode dispatch: pad, upload, launch — NO host sync. The
+        device batch is donated to the warm compiled callable, so the
+        launch consumes its input buffers and back-to-back submits pipeline
+        on-device instead of round-tripping through the host per request."""
+        if self._device_fn is None:
+            raise RuntimeError(
+                "submit() needs a live-model engine (device_fn) — artifact "
+                "engines reduce host-side and only support score()")
+        faults.raise_if("serve.engine_raises")
+        import jax
+        import jax.numpy as jnp
+
+        graphs = list(graphs)
+        batch = batch_np(graphs, bucket.spec.max_graphs,
+                         bucket.spec.max_nodes, bucket.spec.max_edges)
+        batch = batch._replace(
+            node_feats={k: batch.node_feats[k] for k in self.feat_keys})
+        dev = self._device_fn(jax.tree.map(jnp.asarray, batch))
+        self.n_dispatches += 1
+        return PendingScore(dev, len(graphs))
 
     def warmup(self) -> int:
         """Compile every bucket's callable on a dummy graph so the first
@@ -131,6 +216,19 @@ class ScoringEngine:
             batch = batch_np([g], b.spec.max_graphs, b.spec.max_nodes,
                              b.spec.max_edges)
             np.asarray(self._score_fn(batch), np.float32)
+            if self._device_fn is not None:
+                import jax
+                import jax.numpy as jnp
+
+                fbatch = batch._replace(node_feats={
+                    k: batch.node_feats[k] for k in self.feat_keys})
+                with warnings.catch_warnings():
+                    # probs don't alias any int32 input leaf, so XLA reports
+                    # the donation as unusable at compile — expected here
+                    warnings.filterwarnings(
+                        "ignore", message=".*donated.*", category=UserWarning)
+                    np.asarray(
+                        self._device_fn(jax.tree.map(jnp.asarray, fbatch)))
         return len(self.buckets)
 
     # -- constructors -------------------------------------------------------
@@ -138,30 +236,102 @@ class ScoringEngine:
     @classmethod
     def from_model(cls, model, params, label_style: str, feat_keys,
                    max_batch: int = 16, buckets=None,
-                   vocab_hash: str | None = None) -> "ScoringEngine":
+                   vocab_hash: str | None = None, precision: str = "f32",
+                   int8_max_score_delta: float = 0.01,
+                   latency_mode: bool = False, calibration_graphs=None,
+                   journal=None) -> "ScoringEngine":
         """Live-model engine (the checkpoint path's core, split out so
-        tests can inject fresh params without checkpoint machinery)."""
+        tests can inject fresh params without checkpoint machinery).
+
+        ``precision="int8"`` quantizes the conv matmuls
+        (:func:`~deepdfa_tpu.models.ggnn_int8.quantize_conv_params`) and
+        GATES the result: f32 and int8 scores are compared on a
+        calibration batch per bucket (``calibration_graphs`` or a
+        synthesized set) and int8 is REFUSED — engine falls back to f32
+        with a warning, journaled when ``journal`` (a ``RunJournal``) is
+        given — if the max probability delta exceeds
+        ``int8_max_score_delta``. ``latency_mode`` arms :meth:`submit`'s
+        warm donated-buffer dispatch path."""
+        import functools
+
         import jax
         import jax.numpy as jnp
 
         from deepdfa_tpu.predict import make_scorer
 
-        scorer = make_scorer(model, label_style)
         keys = tuple(feat_keys)
+        buckets = tuple(buckets or serve_buckets(max_batch))
 
-        def score_fn(batch):
-            # conform to the warmed pytree structure: request graphs carry
-            # extra columns the model never reads (``_VULN`` labels) — keep
-            # exactly ``feat_keys`` so every batch hits ONE jit cache entry
-            # (same policy as serving._Servable for artifacts)
-            batch = batch._replace(
-                node_feats={k: batch.node_feats[k] for k in keys})
-            fn_p, _ = scorer(params, jax.tree.map(jnp.asarray, batch))
-            return fn_p
+        def _fns(scorer, ps):
+            def score_fn(batch):
+                # conform to the warmed pytree structure: request graphs
+                # carry extra columns the model never reads (``_VULN``
+                # labels) — keep exactly ``feat_keys`` so every batch hits
+                # ONE jit cache entry (same policy as serving._Servable)
+                batch = batch._replace(
+                    node_feats={k: batch.node_feats[k] for k in keys})
+                fn_p, _ = scorer(ps, jax.tree.map(jnp.asarray, batch))
+                return fn_p
 
-        return cls(score_fn, buckets or serve_buckets(max_batch),
-                   label_style=label_style, feat_keys=feat_keys,
-                   vocab_hash=vocab_hash)
+            # the latency-mode entry: batch leaves are donated — the launch
+            # consumes them, so a submitted buffer is dead to the host
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def device_fn(batch):
+                fn_p, _ = scorer(ps, batch)
+                return fn_p
+
+            return score_fn, device_fn
+
+        scorer_f32 = make_scorer(model, label_style)
+        score_fn, device_fn = _fns(scorer_f32, params)
+        int8_delta = None
+        if precision == "int8":
+            accepted, int8_delta, reason = False, None, None
+            try:
+                from deepdfa_tpu.models.ggnn_int8 import (
+                    GGNNInt8, quantize_conv_params)
+
+                qparams = quantize_conv_params({"params": params})["params"]
+                model8 = GGNNInt8(cfg=model.cfg, input_dim=model.input_dim)
+                score8, device8 = _fns(make_scorer(model8, label_style), qparams)
+                cal = list(calibration_graphs or
+                           _calibration_graphs(keys, buckets))
+                int8_delta = 0.0
+                for b in buckets:
+                    gs = [g for g in cal if b.admits(g)][: b.capacity]
+                    if not gs:
+                        continue
+                    batch = batch_np(gs, b.spec.max_graphs, b.spec.max_nodes,
+                                     b.spec.max_edges)
+                    p32 = np.asarray(score_fn(batch), np.float32)[: len(gs)]
+                    p8 = np.asarray(score8(batch), np.float32)[: len(gs)]
+                    int8_delta = max(int8_delta,
+                                     float(np.max(np.abs(p32 - p8))))
+                accepted = int8_delta <= int8_max_score_delta
+                if not accepted:
+                    reason = (f"max score delta {int8_delta:.2e} exceeds "
+                              f"serve.int8_max_score_delta "
+                              f"{int8_max_score_delta:.2e}")
+            except ValueError as exc:  # e.g. NaN-poisoned checkpoint kernels
+                reason = f"calibration refused: {exc}"
+            if accepted:
+                score_fn, device_fn = score8, device8
+            else:
+                warnings.warn(
+                    f"int8 serving path refused — {reason}; serving f32",
+                    stacklevel=2)
+                if journal is not None:
+                    journal.write(event="int8_gate_refused", reason=reason,
+                                  int8_max_score_delta=int8_max_score_delta,
+                                  int8_score_delta=int8_delta)
+                precision = "f32"
+        elif precision != "f32":
+            raise ValueError(f"precision must be 'f32' or 'int8', got {precision!r}")
+
+        return cls(score_fn, buckets, label_style=label_style,
+                   feat_keys=feat_keys, vocab_hash=vocab_hash,
+                   device_fn=device_fn, latency_mode=latency_mode,
+                   precision=precision, int8_score_delta=int8_delta)
 
     @classmethod
     def from_checkpoint(cls, cfg, ckpt_dir: Path | str, vocabs,
@@ -199,7 +369,10 @@ class ScoringEngine:
             model, restored["params"], cfg.model.label_style,
             feat_keys=tuple(vocabs),
             max_batch=max_batch or cfg.serve.max_batch,
-            vocab_hash=vocab_content_hash(vocabs))
+            vocab_hash=vocab_content_hash(vocabs),
+            precision=cfg.serve.precision,
+            int8_max_score_delta=cfg.serve.int8_max_score_delta,
+            latency_mode=cfg.serve.latency_mode)
 
     @classmethod
     def from_artifact(cls, artifact_dir: Path | str,

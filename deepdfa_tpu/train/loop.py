@@ -334,7 +334,13 @@ class Trainer:
         if is_segment and self.fallback_train_step is not None:
             if self.cfg.model.layout == "fused":
                 # fused consumes segment batches natively; only buckets whose
-                # static shape blows the VMEM plan drop to the segment twin
+                # static shape blows the VMEM plan drop to the segment twin.
+                # Inside the fused step the backward degrades independently:
+                # buckets admitted by fits_vmem_train run the Pallas training
+                # kernel (fwd + recompute-bwd as two resident launches inside
+                # the one jitted dispatch), the rest recompute through XLA —
+                # either way the in-jit sentinel guard and loss_scale
+                # semantics of make_train_step apply unchanged.
                 from deepdfa_tpu.ops.fused_ggnn import fits_vmem
 
                 if fits_vmem(

@@ -43,8 +43,10 @@ class ConfusionState(NamedTuple):
 
     @classmethod
     def zeros(cls) -> "ConfusionState":
-        z = jnp.zeros((), jnp.float32)
-        return cls(z, z, z, z)
+        # four DISTINCT buffers, not one array bound four times: donated
+        # steps (make_dp_train_step donate=True) donate every leaf, and XLA
+        # rejects the same buffer donated twice in one call
+        return cls(*(jnp.zeros((), jnp.float32) for _ in range(4)))
 
 
 def update_confusion(

@@ -21,7 +21,11 @@ Two phases:
 
 Prints ONE JSON line (``bench.assemble_serve_result``): requests/sec,
 p50/p99 latency, mean batch occupancy (gate: >= 0.5 — the micro-batcher
-must actually coalesce), cache hit rate + hits, ok.
+must actually coalesce), cache hit rate + hits, ok. The notes block also
+carries ``precision_tiers`` — per-bucket-tier p50/p99 of single-graph
+engine dispatches at BOTH serving precisions (f32 and, gate permitting,
+int8) from the same checkpoint, so one artifact answers "what does each
+tier cost at each precision" (``serve.precision`` in config.py).
 """
 
 from __future__ import annotations
@@ -81,7 +85,60 @@ def _build_fixture(max_batch: int, max_wait_ms: float, corpus_n: int):
     serve_cfg = ServeConfig(port=0, max_batch=max_batch,
                             max_wait_ms=max_wait_ms)
     server = ScoreServer(engine, vocabs, serve_cfg)
-    return server, [r["before"] for r in rows]
+    ckpt = {"model": model, "params": params,
+            "label_style": cfg.model.label_style,
+            "feat_keys": tuple(vocabs)}
+    return server, [r["before"] for r in rows], ckpt
+
+
+def _precision_tiers(ckpt: dict, max_batch: int, requests_per_tier: int):
+    """Per-tier p50/p99 of single-graph engine dispatches at BOTH serving
+    precisions, from the same checkpoint the HTTP server ran. The int8
+    engine goes through the normal accuracy gate (synthesized calibration
+    graphs); a refusal is reported, not hidden — the tier table then
+    carries f32-only rows. Measures ``engine.score`` directly (no HTTP):
+    the tier numbers isolate dispatch, the phase numbers above carry the
+    full-service path."""
+    import warnings
+
+    import numpy as np
+
+    from deepdfa_tpu.serve.engine import ScoringEngine, _calibration_graphs
+
+    engines, refusal = {}, None
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for prec in ("f32", "int8"):
+            engines[prec] = ScoringEngine.from_model(
+                ckpt["model"], ckpt["params"], ckpt["label_style"],
+                feat_keys=ckpt["feat_keys"], max_batch=max_batch,
+                precision=prec)
+            engines[prec].warmup()
+    for w in caught:
+        if "int8 serving path refused" in str(w.message):
+            refusal = str(w.message)
+
+    cal = _calibration_graphs(
+        ckpt["feat_keys"], engines["f32"].buckets, n_per_bucket=4)
+    tiers = {}
+    for bi, bucket in enumerate(engines["f32"].buckets):
+        gs = [g for g in cal if bucket.admits(g)]
+        row = {}
+        for prec, eng in engines.items():
+            if prec == "int8" and eng.precision != "int8":
+                row[prec] = None  # gate refused: served f32, no int8 tier
+                continue
+            b = eng.buckets[bi]
+            eng.score([gs[0]], b)  # warm (compiled by warmup)
+            lat = []
+            for i in range(requests_per_tier):
+                t0 = time.perf_counter()
+                eng.score([gs[i % len(gs)]], b)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            row[prec] = {"p50_ms": round(float(np.percentile(lat, 50)), 3),
+                         "p99_ms": round(float(np.percentile(lat, 99)), 3)}
+        tiers[str(bucket.graph_nodes)] = row
+    return tiers, engines["int8"].precision, refusal
 
 
 def _run_phase(port: int, bodies: list[str], concurrency: int):
@@ -144,10 +201,13 @@ def main(argv=None) -> dict:
     ap.add_argument("--max-wait-ms", type=float, default=25.0)
     ap.add_argument("--corpus", type=int, default=12,
                     help="distinct demo-corpus base functions")
+    ap.add_argument("--tier-requests", type=int, default=16,
+                    help="single-graph dispatches per bucket tier for the "
+                    "per-precision p50/p99 table (0 disables)")
     args = ap.parse_args(argv)
 
     backend = jax.default_backend()
-    server, base_sources = _build_fixture(
+    server, base_sources, ckpt = _build_fixture(
         args.max_batch, args.max_wait_ms, args.corpus)
     bodies = [
         json.dumps({"source": _uniq_source(base_sources[i % len(base_sources)], i)})
@@ -160,6 +220,11 @@ def main(argv=None) -> dict:
         hot_s, hot_err = _run_phase(server.port, bodies, args.concurrency)
     finally:
         snap = server.shutdown()
+
+    tiers = tier_precision = tier_refusal = None
+    if args.tier_requests > 0:
+        tiers, tier_precision, tier_refusal = _precision_tiers(
+            ckpt, args.max_batch, args.tier_requests)
 
     total = 2 * len(bodies)
     elapsed = cold_s + hot_s
@@ -183,6 +248,9 @@ def main(argv=None) -> dict:
             "batch_graphs_total": snap.get("batch_graphs_total"),
             "max_batch": args.max_batch,
             "max_wait_ms": args.max_wait_ms,
+            "precision_tiers": tiers,
+            "tier_precision_served": tier_precision,
+            "int8_refused_reason": tier_refusal,
         },
     )
     # rc stays 0 even when a gate fails: the artifact carries ok:false +

@@ -236,7 +236,13 @@ def scenario_elastic_resume(work: Path, epochs: int, oracle: dict) -> dict:
     """--resume the preempted run on HALF the devices (1 vs 2): the restore
     reshards, replays the same global batch order, and matches the oracle."""
     run_dir = work / "preempted"
-    proc = run_fit(run_dir, work / "storage_preempt", epochs, resume=True)
+    # pin the half-mesh explicitly: relying on the ambient 1-device CPU
+    # default breaks under pytest, whose conftest exports an
+    # XLA_FLAGS=...device_count=8 that the subprocess would inherit
+    proc = run_fit(
+        run_dir, work / "storage_preempt", epochs, resume=True,
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
+    )
     detail: dict = {"ok": False, "returncode": proc.returncode}
     if proc.returncode != 0 or not (run_dir / "final_metrics.json").exists():
         detail["stderr_tail"] = proc.stderr[-2000:]

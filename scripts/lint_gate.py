@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""The fast pre-commit gate: ruff over the library + the device-free perf
+contract suite (``pytest -m perf_contract``) in one command.
+
+Neither half touches an accelerator, compiles XLA, or takes more than a few
+seconds, so this is safe to run on every commit: ruff catches the syntax/
+import rot, the perf-contract tests catch drift in the bench artifact
+schemas and ok-gates (``bench.assemble_*`` are pure functions — a field
+rename or gate-logic change fails HERE, not in a device run whose artifact
+the roadmap tooling then misreads).
+
+Exit code: 0 only when BOTH pass. Ruff missing is a skip (it is not a hard
+dependency — same policy as tests/test_lint.py), pytest missing is a
+failure (the repo's own test runner must exist).
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _ruff_cmd() -> list[str] | None:
+    exe = shutil.which("ruff")
+    if exe is not None:
+        return [exe]
+    try:
+        import ruff  # noqa: F401
+    except ImportError:
+        return None
+    return [sys.executable, "-m", "ruff"]
+
+
+def main() -> int:
+    failures = []
+
+    ruff = _ruff_cmd()
+    if ruff is None:
+        print("lint_gate: ruff not installed — skipping lint half")
+    else:
+        print("lint_gate: ruff check deepdfa_tpu/ scripts/")
+        proc = subprocess.run([*ruff, "check", "deepdfa_tpu/", "scripts/"],
+                              cwd=REPO)
+        if proc.returncode != 0:
+            failures.append("ruff")
+
+    print("lint_gate: pytest -m perf_contract")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "perf_contract", "-q",
+         "tests/test_perf_contract.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("perf_contract")
+
+    if failures:
+        print(f"lint_gate: FAILED ({', '.join(failures)})")
+        return 1
+    print("lint_gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

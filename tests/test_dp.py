@@ -149,3 +149,42 @@ def test_dp_dense_layout():
     eval_step = make_dp_eval_step(model, mesh)
     _, eval_loss, _ = eval_step(state.params, stacked, ConfusionState.zeros())
     assert np.isfinite(float(eval_loss))
+
+
+@pytest.mark.slow
+def test_dp_train_step_donates_state_and_metrics():
+    """``donate=True`` must actually donate BOTH the train state (arg 0) and
+    the metrics tree (arg 2): after the step the passed-in device buffers are
+    deleted — reusing them host-side is a bug in the caller, and this is the
+    contract the in-place param/counter update relies on. ``donate=False``
+    must leave them readable (the A/B harnesses in bench.py depend on it)."""
+    mesh = local_mesh(2)
+    model = GGNN(cfg=CFG, input_dim=INPUT_DIM)
+    tx = optax.sgd(0.1)
+    stacks, flat = make_stacks(2, n_batches=1)
+    stacked = jax.tree.map(jnp.asarray, stacks[0])
+
+    def one_step(donate):
+        step = make_dp_train_step(model, tx, mesh, pos_weight=3.0,
+                                  donate=donate)
+        state = dp_init_state(model, tx, jax.tree.map(jnp.asarray, flat[0]),
+                              seed=0)
+        metrics = jax.tree.map(jnp.asarray, ConfusionState.zeros())
+        out = step(state, stacked, metrics)
+        jax.block_until_ready(out[2])
+        return state, metrics, out
+
+    state, metrics, (new_state, new_metrics, loss, _) = one_step(donate=True)
+    # every donated leaf is gone; lowering text carries no donation marker on
+    # this jax, so buffer deletion IS the observable donation contract
+    assert all(leaf.is_deleted() for leaf in jax.tree.leaves(state.params))
+    assert all(leaf.is_deleted() for leaf in jax.tree.leaves(metrics))
+    # the outputs are live and usable — the donation rebinds, not destroys
+    assert np.isfinite(float(loss))
+    assert all(not leaf.is_deleted()
+               for leaf in jax.tree.leaves(new_state.params))
+    assert all(not leaf.is_deleted() for leaf in jax.tree.leaves(new_metrics))
+
+    state, metrics, _ = one_step(donate=False)
+    assert all(not leaf.is_deleted() for leaf in jax.tree.leaves(state.params))
+    assert all(not leaf.is_deleted() for leaf in jax.tree.leaves(metrics))

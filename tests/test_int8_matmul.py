@@ -225,3 +225,69 @@ def test_llama_int8_runtime_rejects_mesh():
     model = LlamaModel(cfg, mesh=mesh)
     with pytest.raises(ValueError, match="single-chip"):
         model.init(jax.random.key(0), jnp.ones((8, 8), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# calibrate_int8 — the serving-engine calibration entry (ggnn_int8 path)
+
+
+def test_calibrate_roundtrip_error_bounded():
+    from deepdfa_tpu.ops.int8_matmul import calibrate_int8
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 48)).astype(np.float32)
+    q, scale = calibrate_int8(w)
+    assert q.dtype == jnp.int8 and scale.dtype == jnp.float32
+    assert q.shape == w.shape and scale.shape == (48,)
+    # symmetric absmax: per-entry reconstruction error <= scale/2 (rounding)
+    err = np.abs(np.asarray(q, np.float32) * np.asarray(scale) - w)
+    assert np.all(err <= np.asarray(scale) / 2 + 1e-7)
+
+
+def test_calibrate_zero_range_columns_dequantize_to_exact_zero():
+    """An all-zero output column must produce scale=1, q=0 — NOT a 0/0
+    scale that NaN-poisons every score through the matmul."""
+    from deepdfa_tpu.ops.int8_matmul import calibrate_int8
+
+    w = np.zeros((16, 4), np.float32)
+    w[:, 1] = np.linspace(-1, 1, 16)  # one live column among dead ones
+    q, scale = calibrate_int8(w)
+    assert np.all(np.isfinite(np.asarray(scale)))
+    for col in (0, 2, 3):
+        assert float(scale[col]) == 1.0
+        assert np.all(np.asarray(q)[:, col] == 0)
+        assert np.all(np.asarray(q, np.float32)[:, col] * float(scale[col]) == 0.0)
+
+
+def test_calibrate_all_negative_columns_use_full_range():
+    """Symmetric absmax calibrates off |w|: an all-negative column still
+    spans down to -127 and reconstructs with the standard bound."""
+    from deepdfa_tpu.ops.int8_matmul import calibrate_int8
+
+    w = -np.abs(np.random.default_rng(1).normal(size=(32, 8))).astype(np.float32) - 0.01
+    q, scale = calibrate_int8(w)
+    qn = np.asarray(q, np.int32)
+    assert qn.max() <= 0  # sign preserved
+    assert qn.min() == -127  # each column's absmax entry hits the rail
+    err = np.abs(qn.astype(np.float32) * np.asarray(scale) - w)
+    assert np.all(err <= np.asarray(scale) / 2 + 1e-7)
+
+
+@pytest.mark.parametrize("poison", [np.nan, np.inf, -np.inf])
+def test_calibrate_rejects_non_finite(poison):
+    """A NaN/inf-poisoned calibration source must raise, not clamp to
+    +-127 and silently serve garbage (the engine turns this into a
+    journaled int8 refusal)."""
+    from deepdfa_tpu.ops.int8_matmul import calibrate_int8
+
+    w = np.ones((8, 8), np.float32)
+    w[3, 5] = poison
+    with pytest.raises(ValueError, match="non-finite"):
+        calibrate_int8(w)
+
+
+def test_calibrate_rejects_non_2d():
+    from deepdfa_tpu.ops.int8_matmul import calibrate_int8
+
+    with pytest.raises(ValueError, match=r"\[K, N\]"):
+        calibrate_int8(np.ones((4, 4, 4), np.float32))

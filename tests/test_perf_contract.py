@@ -1,0 +1,151 @@
+"""Device-free contract tests for the perf bench stages added with the
+fused-training/serving-latency work: the assemblers are pure functions from
+measured numbers to the ONE-line artifact blocks the roadmap gates read, so
+their schema and ok-gate logic are pinned here without touching a device.
+``pytest -m perf_contract`` runs only this fast suite — scripts/lint_gate.py
+wires it next to ruff as the pre-commit perf gate."""
+
+import re
+
+import pytest
+
+import bench
+
+pytestmark = pytest.mark.perf_contract
+
+PROVENANCE_KEYS = {"git_rev", "git_dirty", "emitted_at_unix"}
+
+
+def _run(step_ms, graphs_per_sec=100.0):
+    return {"step_ms": step_ms, "graphs_per_sec": graphs_per_sec}
+
+
+# ---------------------------------------------------------------- provenance
+
+
+def test_provenance_fields_real_hash_and_dirty_flag():
+    """Every artifact must carry the actual commit (40-hex chars) and a
+    BOOLEAN dirty flag — the ``git_rev: null`` emission this PR fixes."""
+    p = bench._provenance_fields()
+    assert set(p) == PROVENANCE_KEYS
+    assert p["git_rev"] is None or re.fullmatch(r"[0-9a-f]{40}", p["git_rev"])
+    assert p["git_dirty"] in (True, False, None)
+    assert isinstance(p["emitted_at_unix"], int)
+
+
+def test_every_new_assembler_carries_provenance():
+    arts = [
+        bench.assemble_fused_train_result("cpu", "cpu", _run(1.0), _run(2.0), 64),
+        bench.assemble_strict_latency_result("cpu", "cpu", 10.0, 2.0, 8, 64),
+        bench.assemble_int8_serving_result("cpu", "cpu", "int8", 1e-4, 0.01, {}),
+    ]
+    for art in arts:
+        assert PROVENANCE_KEYS <= set(art), art["metric"]
+
+
+# ------------------------------------------------------------- fused train
+
+
+def test_fused_train_schema_and_gate():
+    art = bench.assemble_fused_train_result(
+        "tpu", "TPU v5e", _run(1.0, 300.0), _run(2.0, 150.0), batch_graphs=64)
+    assert art["metric"] == "ggnn_fused_train_step_ms"
+    assert art["unit"] == "ms/step"
+    assert art["value"] == 1.0 and art["segment_step_ms"] == 2.0
+    assert art["ratio_vs_segment"] == 0.5
+    assert art["max_ratio"] == bench.FUSED_TRAIN_MAX_RATIO
+    assert art["batch_graphs"] == 64
+    assert art["ok"] is True
+
+
+def test_fused_train_gate_rejects_slow_fused_step():
+    art = bench.assemble_fused_train_result(
+        "tpu", "TPU v5e", _run(1.9), _run(2.0), batch_graphs=64)
+    assert art["ratio_vs_segment"] == 0.95
+    assert art["ok"] is False
+
+
+def test_fused_train_error_path_not_ok():
+    art = bench.assemble_fused_train_result(
+        "cpu", "cpu", None, None, batch_graphs=None, error="walk-down failed")
+    assert art["ok"] is False
+    assert art["value"] is None and art["ratio_vs_segment"] is None
+    assert art["error"] == "walk-down failed"
+
+
+# ----------------------------------------------------------- strict latency
+
+
+def test_strict_latency_gate_and_tpu_anchor():
+    # on TPU both the ratio AND the 0.25 x 71 ms anchor apply
+    good = bench.assemble_strict_latency_result(
+        "tpu", "TPU v5e", strict_step_ms=71.0, latency_step_ms=10.0,
+        window=8, requests=64)
+    assert good["metric"] == "strict_latency_step_ms"
+    assert good["ratio_vs_strict"] == round(10.0 / 71.0, 4)
+    assert good["anchor_ok"] is True
+    assert good["ok"] is True
+
+    # ratio passes but the absolute anchor fails -> not ok
+    slow = bench.assemble_strict_latency_result(
+        "tpu", "TPU v5e", strict_step_ms=400.0, latency_step_ms=80.0,
+        window=8, requests=64)
+    assert slow["ratio_vs_strict"] == 0.2
+    assert slow["anchor_ok"] is False
+    assert slow["ok"] is False
+
+
+def test_strict_latency_anchor_not_enforced_off_tpu():
+    """CPU artifacts record the anchor as None (not comparable) and gate on
+    the ratio alone — an honest CPU run where latency-mode buys ~nothing
+    (compute-bound) reads ok:false via the RATIO, never via the anchor."""
+    art = bench.assemble_strict_latency_result(
+        "cpu", "cpu", strict_step_ms=43.0, latency_step_ms=41.0,
+        window=8, requests=64)
+    assert art["anchor_ok"] is None
+    assert art["ok"] is False  # 0.95 ratio > 0.25: recorded honestly
+    assert art["anchor_strict_step_ms"] == bench.R05_STRICT_STEP_MS
+
+
+# ------------------------------------------------------------- int8 serving
+
+
+def test_int8_serving_accepted_within_gate_is_ok():
+    tiers = {"126": {"f32": {"p50_ms": 1.0, "p99_ms": 2.0},
+                     "int8": {"p50_ms": 0.7, "p99_ms": 1.5}}}
+    art = bench.assemble_int8_serving_result(
+        "tpu", "TPU v5e", precision_served="int8", int8_score_delta=5e-4,
+        max_score_delta=0.01, tiers=tiers)
+    assert art["metric"] == "int8_serving_precision"
+    assert art["value"] == "int8"
+    assert art["tiers"] == tiers
+    assert art["ok"] is True
+
+
+def test_int8_serving_journaled_refusal_is_ok():
+    """A refusal with a recorded reason is the GATE WORKING — f32 fallback
+    plus reason reads ok:true."""
+    art = bench.assemble_int8_serving_result(
+        "cpu", "cpu", precision_served="f32", int8_score_delta=0.3,
+        max_score_delta=0.01, tiers={},
+        refused_reason="max score delta 3.00e-01 exceeds ...")
+    assert art["value"] == "f32"
+    assert art["ok"] is True
+
+
+def test_int8_serving_silent_fallback_is_not_ok():
+    """f32 served with NO refusal reason means the gate was bypassed —
+    that must fail the stage."""
+    art = bench.assemble_int8_serving_result(
+        "cpu", "cpu", precision_served="f32", int8_score_delta=None,
+        max_score_delta=0.01, tiers={})
+    assert art["ok"] is False
+
+
+def test_int8_serving_over_delta_acceptance_is_not_ok():
+    """Claimed int8 with a measured delta above the bound is a gate
+    violation regardless of who let it through."""
+    art = bench.assemble_int8_serving_result(
+        "tpu", "TPU v5e", precision_served="int8", int8_score_delta=0.5,
+        max_score_delta=0.01, tiers={})
+    assert art["ok"] is False

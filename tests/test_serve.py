@@ -459,3 +459,143 @@ def test_bench_serving_uniq_sources_have_distinct_keys():
     assert len({source_key(s) for s in srcs}) == 3
     names = [fn for fn, _ in parse_functions(srcs[0])]
     assert names == ["f", "bench_uniq_0"]
+
+
+# ---------------------------------------------------------------------------
+# latency mode + precision gate (live-model engines)
+
+
+@pytest.fixture(scope="module")
+def live_model():
+    """Tiny segment-layout GGNN + fresh params over one feature column —
+    the smallest real model the live-engine constructors accept."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import GGNNConfig
+    from deepdfa_tpu.data.graphs import batch_np
+    from deepdfa_tpu.models import make_model
+
+    cfg = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2,
+                     concat_all_absdf=False)
+    keys = ("_ABS_DATAFLOW",)
+    model = make_model(cfg, input_dim=40)
+    example = jax.tree.map(jnp.asarray, batch_np([_chain(6, keys)], 2, 16, 64))
+    params = model.init(jax.random.key(0), example)["params"]
+    return model, params, cfg.label_style, keys
+
+
+def _live_engine(live_model, **kw):
+    from deepdfa_tpu.serve import ScoringEngine
+
+    model, params, label_style, keys = live_model
+    return ScoringEngine.from_model(model, params, label_style,
+                                    feat_keys=keys, max_batch=4, **kw)
+
+
+def test_latency_mode_submit_matches_strict_and_donates(live_model):
+    """submit().result() must equal the strict score() path, and the device
+    batch must be DONATED to the warm callable. A GGNN batch is all
+    int32/bool while the probs output is f32, so XLA has no aliasing
+    target and reports every donation unusable — that compile-time
+    UserWarning is the observable proof the argument is marked donated
+    (this jax emits no donor marker in lowering text, and unusable donated
+    buffers stay alive, so ``.is_deleted()`` can't witness it here; the
+    aliasable in-place-consumption case is covered by
+    ``test_dp_train_step_donates_state_and_metrics``)."""
+    eng = _live_engine(live_model, latency_mode=True)
+    assert eng.latency_mode
+    keys = eng.feat_keys
+    gs = [_chain(10, keys), _chain(25, keys)]
+    bucket = eng.buckets[0]
+    with pytest.warns(UserWarning, match="donated buffers were not usable"):
+        pending = eng.submit(gs, bucket)
+    got = pending.result()
+
+    eng.latency_mode = False
+    want = eng.score(gs, bucket)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+    # warm resubmission: the donated-arg path must be reusable per request
+    eng.latency_mode = True
+    again = eng.submit(gs, bucket).result()
+    np.testing.assert_allclose(again, want, atol=1e-6)
+    assert eng.n_dispatches >= 3
+
+
+def test_latency_mode_without_device_fn_warns_and_disables():
+    """Artifact-style engines (host-side reductions, no jittable callable)
+    cannot pipeline: latency_mode must downgrade loudly, not explode on
+    the first request."""
+    from deepdfa_tpu.serve import ScoringEngine, serve_buckets
+
+    with pytest.warns(UserWarning, match="latency_mode requires"):
+        eng = ScoringEngine(lambda b: np.zeros(4, np.float32),
+                            serve_buckets(4), feat_keys=("_ABS_DATAFLOW",),
+                            latency_mode=True)
+    assert eng.latency_mode is False
+    with pytest.raises(RuntimeError, match="device_fn"):
+        eng.submit([_chain(5)], eng.buckets[0])
+
+
+def test_int8_gate_accepts_and_scores_track_f32(live_model):
+    """With a sane bound the int8 path must pass its own gate, record the
+    measured delta, and serve scores within that bound of f32."""
+    eng8 = _live_engine(live_model, precision="int8",
+                        int8_max_score_delta=0.05)
+    assert eng8.precision == "int8"
+    assert eng8.int8_score_delta is not None
+    assert eng8.int8_score_delta <= 0.05
+
+    eng32 = _live_engine(live_model)
+    gs = [_chain(12, eng8.feat_keys)]
+    p8 = eng8.score(gs, eng8.buckets[0])
+    p32 = eng32.score(gs, eng32.buckets[0])
+    assert float(np.max(np.abs(p8 - p32))) <= 0.05
+    assert np.all((p8 >= 0.0) & (p8 <= 1.0))
+
+
+def test_int8_gate_refusal_falls_back_to_f32_and_journals(live_model, tmp_path):
+    """An impossible bound forces the accuracy gate to refuse: the engine
+    must warn, journal the refusal (reason + measured delta), and serve
+    f32 — never silently ship the failing int8 path."""
+    from deepdfa_tpu.resilience.journal import RunJournal
+
+    journal = RunJournal(tmp_path / "journal.json")
+    with pytest.warns(UserWarning, match="int8 serving path refused"):
+        eng = _live_engine(live_model, precision="int8",
+                           int8_max_score_delta=1e-12, journal=journal)
+    assert eng.precision == "f32"
+    rec = journal.read()
+    assert rec["event"] == "int8_gate_refused"
+    assert rec["int8_max_score_delta"] == 1e-12
+    assert rec["int8_score_delta"] > 1e-12
+    assert "exceeds" in rec["reason"]
+    # the fallback engine still serves
+    p = eng.score([_chain(8, eng.feat_keys)], eng.buckets[0])
+    assert p.shape == (1,) and np.isfinite(p).all()
+
+
+def test_int8_gate_refuses_nan_poisoned_checkpoint(live_model, tmp_path):
+    """calibrate_int8 raises on non-finite kernels; from_model must turn
+    that into a journaled refusal (reason prefixed 'calibration refused'),
+    not a crash and not an int8 engine."""
+    import jax
+
+    from deepdfa_tpu.resilience.journal import RunJournal
+
+    model, params, label_style, keys = live_model
+    poisoned = jax.tree.map(lambda x: np.array(x), params)
+    poisoned["ggnn"]["edge_linear"]["kernel"][0, 0] = np.nan
+
+    from deepdfa_tpu.serve import ScoringEngine
+
+    journal = RunJournal(tmp_path / "journal.json")
+    with pytest.warns(UserWarning, match="calibration refused"):
+        eng = ScoringEngine.from_model(
+            model, poisoned, label_style, feat_keys=keys, max_batch=4,
+            precision="int8", journal=journal)
+    assert eng.precision == "f32"
+    rec = journal.read()
+    assert rec["event"] == "int8_gate_refused"
+    assert "non-finite" in rec["reason"]
