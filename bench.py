@@ -495,7 +495,7 @@ SERVE_MIN_OCCUPANCY = 0.5
 def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
                           p99_ms, mean_batch_occupancy, cache_hit_rate,
                           cache_hits, requests_total, errors_total,
-                          concurrency=None, notes=None):
+                          concurrency=None, notes=None, fleet=None):
     """ONE-line artifact for the serving stage (scripts/bench_serving.py).
 
     Shared between the load generator and the bench-contract test so the
@@ -504,12 +504,15 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
     half-full on average (the micro-batcher actually coalesced — a 1-deep
     "batch" per request would pass a pure throughput check), and the
     repeated-corpus phase produced real cache hits (asserted via the hit
-    COUNTER, not timing)."""
+    COUNTER, not timing). ``fleet`` (an ``assemble_fleet_result`` block,
+    from ``--fleet N`` runs) rides along and ANDs its own ok."""
     ok = (requests_total > 0 and errors_total == 0
           and requests_per_sec > 0
           and mean_batch_occupancy is not None
           and mean_batch_occupancy >= SERVE_MIN_OCCUPANCY
           and cache_hits > 0)
+    if fleet is not None:
+        ok = ok and bool(fleet.get("ok"))
     return {
         "metric": "serve_requests_per_sec",
         "value": round(float(requests_per_sec), 2),
@@ -531,7 +534,82 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
         "errors_total": int(errors_total),
         "concurrency": concurrency,
         "notes": notes or {},
+        "fleet": fleet,
         "ok": ok,
+        **_provenance_fields(),
+    }
+
+
+# fleet gate: aggregate COLD throughput of N router-fronted replicas vs the
+# single-replica baseline from the same checkpoint. Linear scaling is the
+# ideal; 0.75x/replica absorbs router hop + shard imbalance. Like the strict-
+# latency anchor this is a DEVICE-PARALLELISM claim, so it is enforced on TPU
+# only: an N-replica fleet multiplexed onto one starved CPU core cannot
+# exhibit it, and a CPU artifact that "passed" would be a lie. CPU runs
+# record speedup_ok: null and gate on the structural invariants alone.
+FLEET_MIN_SPEEDUP_FRAC = 0.75
+
+
+def assemble_fleet_result(backend, device_kind, n_replicas, single_cold_rps,
+                          fleet_cold_rps, aggregate_p50_ms, aggregate_p99_ms,
+                          per_replica, shard_cache_hits, join_cold_compiles,
+                          compile_seconds_saved, load_x, errors_total,
+                          notes=None):
+    """ONE-line ``fleet`` block for ``bench_serving.py --fleet N``.
+
+    Structural gates (ALWAYS enforced — they are topology claims, not
+    speed claims): zero errors under ``load_x``× load; every replica took
+    traffic (the ring actually spread the keyspace); the sharded cache
+    produced hits (hot keys came back to the replica that cached them);
+    the joining replicas warmed from the store with ZERO cold bucket
+    compiles and positive journaled compile-seconds-saved. The speedup
+    gate (``fleet_cold_rps >= FLEET_MIN_SPEEDUP_FRAC * n_replicas *
+    single_cold_rps``, matched cold-phase workloads) applies on TPU;
+    elsewhere ``speedup_ok`` is null and the measured speedup is recorded
+    honestly."""
+    speedup = None
+    if single_cold_rps and fleet_cold_rps:
+        speedup = round(float(fleet_cold_rps) / float(single_cold_rps), 3)
+    min_speedup = round(FLEET_MIN_SPEEDUP_FRAC * n_replicas, 3)
+    speedup_ok = None
+    if backend == "tpu":
+        speedup_ok = speedup is not None and speedup >= min_speedup
+    all_routed = bool(per_replica) and all(
+        r.get("forwarded", 0) > 0 for r in per_replica.values())
+    structural_ok = (n_replicas >= 2 and errors_total == 0
+                     and all_routed
+                     and shard_cache_hits > 0
+                     and join_cold_compiles == 0
+                     and compile_seconds_saved is not None
+                     and compile_seconds_saved > 0)
+    return {
+        "metric": "fleet_requests_per_sec",
+        "value": (None if fleet_cold_rps is None
+                  else round(float(fleet_cold_rps), 2)),
+        "unit": "req/s",
+        "backend": backend,
+        "device_kind": device_kind,
+        "n_replicas": int(n_replicas),
+        "single_replica_rps": (None if single_cold_rps is None
+                               else round(float(single_cold_rps), 2)),
+        "speedup_vs_single": speedup,
+        "min_speedup": min_speedup,
+        "speedup_ok": speedup_ok,
+        "aggregate_p50_ms": (None if aggregate_p50_ms is None
+                             else round(float(aggregate_p50_ms), 3)),
+        "aggregate_p99_ms": (None if aggregate_p99_ms is None
+                             else round(float(aggregate_p99_ms), 3)),
+        "per_replica": per_replica,
+        "all_replicas_routed": all_routed,
+        "shard_cache_hits": int(shard_cache_hits),
+        "join_cold_compiles": int(join_cold_compiles),
+        "compile_seconds_saved": (
+            None if compile_seconds_saved is None
+            else round(float(compile_seconds_saved), 3)),
+        "load_x": load_x,
+        "errors_total": int(errors_total),
+        "notes": notes or {},
+        "ok": structural_ok and speedup_ok is not False,
         **_provenance_fields(),
     }
 

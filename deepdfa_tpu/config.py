@@ -289,6 +289,19 @@ class ServeConfig:
     # donated to the jitted callable and scores come back as futures (no
     # host sync inside submit) — strict-mode p99 approaches the chained rate
     latency_mode: bool = False
+    # fleet identity: how this replica names itself in /healthz and the
+    # router's backend table (default: host:port at serve time)
+    replica_id: str | None = None
+    # warm-start store directory (serve/warmstore.py): compiled bucket
+    # programs are committed/loaded content-addressed so a joining replica
+    # warms with zero cold compiles; None disables the store
+    warm_store_dir: str | None = None
+    # router health-probe cadence (serve/router.py)
+    probe_interval_s: float = 2.0
+    # >1: replicate the engine across this many local devices (one replica
+    # per device over a dp mesh; the batcher packs across replicas). The
+    # in-process alternative to the router fleet for single-host scale-up.
+    mesh_replicas: int = 0
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -305,6 +318,10 @@ class ServeConfig:
             raise ValueError("precision must be 'f32' or 'int8'")
         if self.int8_max_score_delta <= 0:
             raise ValueError("int8_max_score_delta must be > 0")
+        if self.probe_interval_s <= 0:
+            raise ValueError("probe_interval_s must be > 0")
+        if self.mesh_replicas < 0:
+            raise ValueError("mesh_replicas must be >= 0")
 
 
 @dataclass(frozen=True)

@@ -9,15 +9,30 @@ Composition (one request's path)::
         ──(max_batch | max_wait_ms)──▶ ScoringEngine.score (padded,
         per-bucket compiled callable) ──▶ futures resolve ──▶ JSON rows
 
-Entry points: ``python -m deepdfa_tpu.serve.server`` or
-``deepdfa-tpu serve``; load-test with ``scripts/bench_serving.py``.
+Fleet mode (many replicas, one cache): ``serve/router.py`` fronts N
+ScoreServers, consistent-hashing ``source_key`` so the scan cache shards
+shared-nothing; ``serve/warmstore.py`` hands joining replicas their
+compiled bucket ladder (zero cold compiles); ``mesh=`` engines replicate
+scoring across local devices in one process.
+
+Entry points: ``python -m deepdfa_tpu.serve.server`` (one replica),
+``python -m deepdfa_tpu.serve.router`` (the fleet front); load-test with
+``scripts/bench_serving.py`` (``--fleet N`` drives the whole topology).
 """
 
 from .batcher import MicroBatcher, QueueFullError
 from .cache import ScanCache, ScanEntry
-from .engine import OversizeGraphError, ScoringEngine, ServeBucket, serve_buckets
+from .engine import (
+    OversizeGraphError,
+    PendingScore,
+    ScoringEngine,
+    ServeBucket,
+    serve_buckets,
+)
 from .metrics import LatencyReservoir, ServeMetrics
+from .router import Backend, FleetRouter, HashRing, RouterMetrics
 from .server import ScoreServer, build_server, serve_command
+from .warmstore import WarmEntry, WarmStore, bucket_artifact_key
 
 __all__ = [
     "MicroBatcher",
@@ -25,12 +40,20 @@ __all__ = [
     "ScanCache",
     "ScanEntry",
     "OversizeGraphError",
+    "PendingScore",
     "ScoringEngine",
     "ServeBucket",
     "serve_buckets",
     "LatencyReservoir",
     "ServeMetrics",
+    "Backend",
+    "FleetRouter",
+    "HashRing",
+    "RouterMetrics",
     "ScoreServer",
     "build_server",
     "serve_command",
+    "WarmEntry",
+    "WarmStore",
+    "bucket_artifact_key",
 ]

@@ -136,9 +136,14 @@ class MicroBatcher:
         by_bucket: dict[ServeBucket, list[_Pending]] = {}
         for item in window:
             by_bucket.setdefault(item.bucket, []).append(item)
+        # chunks of n_replicas packed batches go down as ONE dispatch on
+        # mesh-replicated engines (one batch per device); single-replica
+        # engines degrade to the per-batch loop unchanged
+        chunk = max(1, self.engine.n_replicas)
         for bucket, items in by_bucket.items():
-            for batch in self._pack(bucket, items):
-                self._dispatch(bucket, batch)
+            packed = self._pack(bucket, items)
+            for i in range(0, len(packed), chunk):
+                self._dispatch(bucket, packed[i:i + chunk])
 
     def _pack(self, bucket: ServeBucket, items: list[_Pending]):
         """Greedy-fill within the bucket's graph/node/edge budgets (the
@@ -160,14 +165,18 @@ class MicroBatcher:
             out.append(cur)
         return out
 
-    def _dispatch(self, bucket: ServeBucket, items: list[_Pending]) -> None:
+    def _dispatch(self, bucket: ServeBucket,
+                  batches: list[list[_Pending]]) -> None:
         try:
-            probs = self.engine.score([i.graph for i in items], bucket)
-        except Exception as exc:  # noqa: BLE001 — per-batch failure domain
-            for item in items:
-                item.future.set_exception(exc)
+            results = self.engine.score_groups(
+                [[i.graph for i in b] for b in batches], bucket)
+        except Exception as exc:  # noqa: BLE001 — per-chunk failure domain
+            for b in batches:
+                for item in b:
+                    item.future.set_exception(exc)
             return
-        if self.metrics is not None:
-            self.metrics.observe_batch(len(items), bucket.capacity)
-        for item, p in zip(items, probs):
-            item.future.set_result(float(p))
+        for b, probs in zip(batches, results):
+            if self.metrics is not None:
+                self.metrics.observe_batch(len(b), bucket.capacity)
+            for item, p in zip(b, probs):
+                item.future.set_result(float(p))

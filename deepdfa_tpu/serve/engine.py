@@ -17,14 +17,34 @@ Two constructors, one contract:
   (:mod:`deepdfa_tpu.serving`), whose ONE baked shape becomes the only
   bucket; node-label artifacts are reduced to function scores host-side.
 
+Fleet extensions (the distributed-serving layer):
+
+- ``mesh=`` on :meth:`from_model` replicates the engine across every
+  device of a ``dp`` mesh (the :mod:`deepdfa_tpu.parallel.dp` shard-map
+  machinery): :meth:`score_groups` stacks up to ``n_replicas`` padded
+  batches on a leading device axis and scores them in ONE dispatch, one
+  batch per device. The micro-batcher packs across replicas.
+- :meth:`warmup` takes a :class:`~deepdfa_tpu.serve.warmstore.WarmStore`:
+  a miss compiles as before and EXPORTS the bucket's program
+  (StableHLO, content-addressed on vocab hash + model rev + bucket
+  shape); a hit loads the serialized program instead of re-tracing —
+  a joining replica warms its whole ladder with zero cold compiles.
+  ``warmup`` returns a report (hits/misses/compile-seconds-saved) and
+  journals it when given a journal.
+
 `score` is where the ``serve.engine_raises`` fault point lives: an
 injected (or real) engine failure must surface as a per-request error in
-the batcher, never as a dead server.
+the batcher, never as a dead server. All dispatch entry points serialize
+on one engine lock — concurrent ``submit()`` callers in latency mode
+must never interleave their donated buffers.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import threading
+import time
 import warnings
 from pathlib import Path
 
@@ -97,6 +117,21 @@ def _calibration_graphs(feat_keys, buckets, n_per_bucket: int = 4,
     return out
 
 
+def _params_content_hash(params) -> str:
+    """Model revision: a content address of the full parameter tree
+    (structure + dtypes + bytes). Two engines share warm-store keys
+    exactly when they serve the same weights."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(params)
+    h = hashlib.sha256(str(treedef).encode())
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(f"{arr.dtype}{arr.shape}".encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()[:16]
+
+
 class PendingScore:
     """Handle returned by :meth:`ScoringEngine.submit` — the scores stay
     device-resident (no host sync at dispatch); :meth:`result` is the one
@@ -124,17 +159,41 @@ class ScoringEngine:
     are consumed by the dispatch (donation) so a submitted batch is never
     reused host-side. ``precision`` records which weight path the engine
     serves (``f32`` or ``int8``); ``int8_score_delta`` the measured
-    calibration-batch gate value when int8 was requested."""
+    calibration-batch gate value when int8 was requested.
+
+    ``stacked_fn`` (mesh-replicated engines): maps a ``[n_replicas, ...]``
+    stacked batch pytree to ``[n_replicas, max_graphs]`` probabilities —
+    one engine replica per device, one dispatch for the whole stack.
+    ``export_fn`` (live single-replica engines): ``bucket -> (bytes,
+    export_seconds)`` serializing the bucket's compiled program for the
+    warm store. ``model_rev`` is the parameter content hash that keys it.
+
+    Every dispatch path holds the engine lock: the donated-buffer submit
+    sequence (pad → upload → launch) is a critical section — two threads
+    interleaving it could hand one thread's donated buffers to the
+    other's dispatch.
+    """
 
     def __init__(self, score_fn, buckets, label_style: str = "graph",
                  feat_keys=(), vocab_hash: str | None = None,
                  device_fn=None, latency_mode: bool = False,
                  precision: str = "f32",
-                 int8_score_delta: float | None = None):
+                 int8_score_delta: float | None = None,
+                 stacked_fn=None, n_replicas: int = 1,
+                 model_rev: str | None = None, export_fn=None):
         if not buckets:
             raise ValueError("need at least one serving bucket")
+        if score_fn is None and stacked_fn is None:
+            raise ValueError("need a score_fn (or a stacked_fn for "
+                             "mesh-replicated engines)")
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
         self._score_fn = score_fn
         self._device_fn = device_fn
+        self._stacked_fn = stacked_fn
+        self._export_fn = export_fn
+        self.n_replicas = int(n_replicas)
+        self.model_rev = model_rev
         if latency_mode and device_fn is None:
             warnings.warn(
                 "latency_mode requires a jit-safe device_fn (live-model "
@@ -150,6 +209,10 @@ class ScoringEngine:
         self.feat_keys = tuple(feat_keys)
         self.vocab_hash = vocab_hash
         self.n_dispatches = 0
+        self.warm_buckets: list[int] = []
+        self.last_warmup_report: dict | None = None
+        self._bucket_fns: dict[ServeBucket, object] = {}
+        self._lock = threading.RLock()
 
     # -- routing ------------------------------------------------------------
 
@@ -164,25 +227,72 @@ class ScoringEngine:
 
     # -- scoring ------------------------------------------------------------
 
+    def _padded_batch(self, graphs, bucket: ServeBucket, feat_only=False):
+        batch = batch_np(graphs, bucket.spec.max_graphs,
+                         bucket.spec.max_nodes, bucket.spec.max_edges)
+        if feat_only:
+            # an EMPTY group (a replica slot with no requests this window)
+            # batches to no feature columns at all — synthesize all-padding
+            # ones so every replica's leaf structure matches for stacking
+            zeros = np.zeros(bucket.spec.max_nodes, np.int32)
+            batch = batch._replace(node_feats={
+                k: batch.node_feats.get(k, zeros) for k in self.feat_keys})
+        return batch
+
     def score(self, graphs, bucket: ServeBucket) -> np.ndarray:
         """Pad ``graphs`` (all pre-routed to ``bucket``) and dispatch one
         compiled call; returns the real graphs' probabilities. In latency
         mode this is submit + blocking read — same semantics, one sync."""
         if self.latency_mode:
             return self.submit(graphs, bucket).result()
+        if self._stacked_fn is not None:
+            return self.score_groups([graphs], bucket)[0]
         faults.raise_if("serve.engine_raises")
         graphs = list(graphs)
-        batch = batch_np(graphs, bucket.spec.max_graphs,
-                         bucket.spec.max_nodes, bucket.spec.max_edges)
-        probs = np.asarray(self._score_fn(batch), np.float32)
-        self.n_dispatches += 1
+        with self._lock:
+            batch = self._padded_batch(graphs, bucket)
+            fn = self._bucket_fns.get(bucket, self._score_fn)
+            probs = np.asarray(fn(batch), np.float32)
+            self.n_dispatches += 1
         return probs[: len(graphs)]
+
+    def score_groups(self, groups, bucket: ServeBucket) -> list[np.ndarray]:
+        """Score up to ``n_replicas`` request groups in ONE dispatch.
+
+        Mesh-replicated engines stack one padded batch per replica on a
+        leading device axis (missing replica slots get an all-padding
+        batch) and shard-map the stack across the mesh; single-replica
+        engines fall back to one :meth:`score` per group. Returns one
+        probability array per input group, in order."""
+        groups = [list(g) for g in groups]
+        if self._stacked_fn is None:
+            return [self.score(g, bucket) for g in groups]
+        if len(groups) > self.n_replicas:
+            raise ValueError(
+                f"{len(groups)} groups > {self.n_replicas} replicas — the "
+                "batcher must chunk windows to the replica count")
+        faults.raise_if("serve.engine_raises")
+        with self._lock:
+            padded = groups + [[] for _ in range(self.n_replicas - len(groups))]
+            batches = [self._padded_batch(g, bucket, feat_only=True)
+                       for g in padded]
+            import jax
+
+            stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *batches)
+            probs = np.asarray(self._stacked_fn(stacked), np.float32)
+            self.n_dispatches += 1
+        return [probs[i, : len(g)] for i, g in enumerate(groups)]
 
     def submit(self, graphs, bucket: ServeBucket) -> PendingScore:
         """Latency-mode dispatch: pad, upload, launch — NO host sync. The
         device batch is donated to the warm compiled callable, so the
         launch consumes its input buffers and back-to-back submits pipeline
-        on-device instead of round-tripping through the host per request."""
+        on-device instead of round-tripping through the host per request.
+
+        Thread-safe: the pad→upload→launch sequence runs under the engine
+        lock, so concurrent callers cannot interleave donated buffers —
+        each caller's :class:`PendingScore` owns exactly the device values
+        its own dispatch produced."""
         if self._device_fn is None:
             raise RuntimeError(
                 "submit() needs a live-model engine (device_fn) — artifact "
@@ -192,44 +302,156 @@ class ScoringEngine:
         import jax.numpy as jnp
 
         graphs = list(graphs)
-        batch = batch_np(graphs, bucket.spec.max_graphs,
-                         bucket.spec.max_nodes, bucket.spec.max_edges)
-        batch = batch._replace(
-            node_feats={k: batch.node_feats[k] for k in self.feat_keys})
-        dev = self._device_fn(jax.tree.map(jnp.asarray, batch))
-        self.n_dispatches += 1
+        with self._lock:
+            batch = self._padded_batch(graphs, bucket, feat_only=True)
+            dev = self._device_fn(jax.tree.map(jnp.asarray, batch))
+            self.n_dispatches += 1
         return PendingScore(dev, len(graphs))
 
-    def warmup(self) -> int:
-        """Compile every bucket's callable on a dummy graph so the first
-        real request never pays XLA compilation; returns buckets warmed.
-        Calls ``score_fn`` directly, NOT :meth:`score`: the
+    # -- warmup + warm store ------------------------------------------------
+
+    def bucket_key(self, bucket: ServeBucket) -> str:
+        """Warm-store content address of one bucket's compiled program."""
+        from .warmstore import bucket_artifact_key
+
+        return bucket_artifact_key(
+            self.vocab_hash, self.model_rev, self.precision,
+            self.label_style, self.feat_keys, bucket.spec.max_graphs,
+            bucket.spec.max_nodes, bucket.spec.max_edges)
+
+    def _dummy_graph(self) -> Graph:
+        n = 2
+        feats = {k: np.zeros(n, np.int32) for k in self.feat_keys}
+        return Graph(senders=np.arange(n - 1, dtype=np.int32),
+                     receivers=np.arange(1, n, dtype=np.int32),
+                     node_feats=feats).with_self_loops()
+
+    def _warm_cold(self, bucket: ServeBucket, g: Graph) -> None:
+        """Compile the bucket's callable(s) the pre-store way. Calls the
+        underlying fns directly, NOT :meth:`score`: the
         ``serve.engine_raises`` fault point poisons a *request's* batch —
         an armed ``@1`` spec must hit the first client, not kill the
         server during startup warmup."""
-        n = 2
-        feats = {k: np.zeros(n, np.int32) for k in self.feat_keys}
-        g = Graph(senders=np.arange(n - 1, dtype=np.int32),
-                  receivers=np.arange(1, n, dtype=np.int32),
-                  node_feats=feats).with_self_loops()
-        for b in self.buckets:
-            batch = batch_np([g], b.spec.max_graphs, b.spec.max_nodes,
-                             b.spec.max_edges)
-            np.asarray(self._score_fn(batch), np.float32)
-            if self._device_fn is not None:
-                import jax
-                import jax.numpy as jnp
+        if self._stacked_fn is not None:
+            batches = [self._padded_batch([g] if i == 0 else [], bucket,
+                                          feat_only=True)
+                       for i in range(self.n_replicas)]
+            import jax
 
-                fbatch = batch._replace(node_feats={
-                    k: batch.node_feats[k] for k in self.feat_keys})
-                with warnings.catch_warnings():
-                    # probs don't alias any int32 input leaf, so XLA reports
-                    # the donation as unusable at compile — expected here
-                    warnings.filterwarnings(
-                        "ignore", message=".*donated.*", category=UserWarning)
-                    np.asarray(
-                        self._device_fn(jax.tree.map(jnp.asarray, fbatch)))
-        return len(self.buckets)
+            stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *batches)
+            np.asarray(self._stacked_fn(stacked), np.float32)
+            return
+        batch = self._padded_batch([g], bucket)
+        np.asarray(self._score_fn(batch), np.float32)
+        if self._device_fn is not None:
+            import jax
+            import jax.numpy as jnp
+
+            fbatch = batch._replace(node_feats={
+                k: batch.node_feats[k] for k in self.feat_keys})
+            with warnings.catch_warnings():
+                # probs don't alias any int32 input leaf, so XLA reports
+                # the donation as unusable at compile — expected here
+                warnings.filterwarnings(
+                    "ignore", message=".*donated.*", category=UserWarning)
+                np.asarray(
+                    self._device_fn(jax.tree.map(jnp.asarray, fbatch)))
+
+    def _load_bucket_fn(self, payload: bytes):
+        """Deserialize a warm-store payload into this bucket's score_fn
+        (same feat-key conformance contract as the live path)."""
+        import jax
+        import jax.numpy as jnp
+
+        from jax import export as jexport
+
+        from deepdfa_tpu.serving import _register_pytrees
+
+        _register_pytrees()
+        exported = jexport.deserialize(payload)
+
+        def fn(batch):
+            batch = batch._replace(
+                node_feats={k: batch.node_feats[k] for k in self.feat_keys})
+            return np.asarray(exported.call(jax.tree.map(jnp.asarray, batch)),
+                              np.float32)
+
+        return fn
+
+    def warmup(self, warm_store=None, journal=None) -> dict:
+        """Warm every bucket's callable so the first real request never
+        pays XLA compilation; returns a report dict (``buckets``, ``hits``,
+        ``misses``, ``compile_seconds_saved``, ``per_bucket``).
+
+        With a ``warm_store``, each bucket first tries the store: a HIT
+        deserializes the content-addressed exported program (no trace, no
+        lowering) and records ``compile_seconds_saved`` = the populating
+        replica's recorded compile time minus this load's wall time; a
+        MISS compiles cold and, when the engine can export (live
+        single-replica, synchronous mode), commits the program for the
+        next joiner. Journaled (``event="warmup"``) alongside the
+        ``int8_gate_refused`` entries when ``journal`` is given."""
+        use_store = (warm_store is not None and self._export_fn is not None
+                     and not self.latency_mode)
+        g = self._dummy_graph()
+        report = {"buckets": len(self.buckets), "hits": 0, "misses": 0,
+                  "compile_seconds_saved": 0.0, "per_bucket": {}}
+        for b in self.buckets:
+            key = self.bucket_key(b) if use_store else None
+            entry = warm_store.get(key) if use_store else None
+            row: dict = {"key": key}
+            if entry is not None:
+                t0 = time.perf_counter()
+                fn = self._load_bucket_fn(entry.payload)
+                fn(self._padded_batch([g], b))  # compiles the StableHLO once
+                warm_s = time.perf_counter() - t0
+                self._bucket_fns[b] = fn
+                recorded = float(entry.meta.get("compile_seconds", 0.0))
+                saved = max(0.0, recorded - warm_s)
+                report["hits"] += 1
+                report["compile_seconds_saved"] += saved
+                row.update(source="store", warm_seconds=round(warm_s, 3),
+                           compile_seconds=round(recorded, 3),
+                           compile_seconds_saved=round(saved, 3))
+            else:
+                t0 = time.perf_counter()
+                self._warm_cold(b, g)
+                compile_s = time.perf_counter() - t0
+                report["misses"] += 1
+                row.update(source="compile",
+                           compile_seconds=round(compile_s, 3))
+                if use_store:
+                    try:
+                        payload, export_s = self._export_fn(b)
+                        warm_store.put(key, payload, {
+                            "compile_seconds": compile_s,
+                            "vocab_hash": self.vocab_hash,
+                            "model_rev": self.model_rev,
+                            "precision": self.precision,
+                            "label_style": self.label_style,
+                            "graph_nodes": b.graph_nodes,
+                            "spec": [b.spec.max_graphs, b.spec.max_nodes,
+                                     b.spec.max_edges],
+                        })
+                        row["export_seconds"] = round(export_s, 3)
+                    except Exception as exc:  # noqa: BLE001 — store is an
+                        # optimization: a failed export must not take down
+                        # warmup (the bucket is already compiled and warm)
+                        warnings.warn(
+                            f"warm-store export failed for bucket "
+                            f"{b.graph_nodes}: {type(exc).__name__}: {exc}",
+                            stacklevel=2)
+                        row["export_error"] = f"{type(exc).__name__}: {exc}"
+            report["per_bucket"][str(b.graph_nodes)] = row
+        report["compile_seconds_saved"] = round(
+            report["compile_seconds_saved"], 3)
+        self.warm_buckets = [b.graph_nodes for b in self.buckets]
+        self.last_warmup_report = report
+        if journal is not None:
+            journal.write(event="warmup", vocab_hash=self.vocab_hash,
+                          model_rev=self.model_rev, precision=self.precision,
+                          **report)
+        return report
 
     # -- constructors -------------------------------------------------------
 
@@ -239,7 +461,7 @@ class ScoringEngine:
                    vocab_hash: str | None = None, precision: str = "f32",
                    int8_max_score_delta: float = 0.01,
                    latency_mode: bool = False, calibration_graphs=None,
-                   journal=None) -> "ScoringEngine":
+                   journal=None, mesh=None) -> "ScoringEngine":
         """Live-model engine (the checkpoint path's core, split out so
         tests can inject fresh params without checkpoint machinery).
 
@@ -251,7 +473,16 @@ class ScoringEngine:
         with a warning, journaled when ``journal`` (a ``RunJournal``) is
         given — if the max probability delta exceeds
         ``int8_max_score_delta``. ``latency_mode`` arms :meth:`submit`'s
-        warm donated-buffer dispatch path."""
+        warm donated-buffer dispatch path.
+
+        ``mesh`` (a ``jax.sharding.Mesh`` with a ``dp`` axis, e.g.
+        :func:`deepdfa_tpu.parallel.mesh.local_mesh`) replicates the
+        chosen scorer across every ``dp`` device: the engine scores
+        ``dp``-stacked batches device-parallel via :meth:`score_groups`
+        and the batcher packs across replicas. Mesh engines dispatch
+        synchronously (no donated-buffer submit loop) and keep their
+        compiled stack in-process (the warm store serves the
+        single-replica router-fleet topology)."""
         import functools
 
         import jax
@@ -261,6 +492,7 @@ class ScoringEngine:
 
         keys = tuple(feat_keys)
         buckets = tuple(buckets or serve_buckets(max_batch))
+        model_rev = _params_content_hash(params)
 
         def _fns(scorer, ps):
             def score_fn(batch):
@@ -284,6 +516,8 @@ class ScoringEngine:
 
         scorer_f32 = make_scorer(model, label_style)
         score_fn, device_fn = _fns(scorer_f32, params)
+        chosen_model, chosen_params = model, params
+        chosen_scorer = scorer_f32
         int8_delta = None
         if precision == "int8":
             accepted, int8_delta, reason = False, None, None
@@ -293,7 +527,8 @@ class ScoringEngine:
 
                 qparams = quantize_conv_params({"params": params})["params"]
                 model8 = GGNNInt8(cfg=model.cfg, input_dim=model.input_dim)
-                score8, device8 = _fns(make_scorer(model8, label_style), qparams)
+                scorer8 = make_scorer(model8, label_style)
+                score8, device8 = _fns(scorer8, qparams)
                 cal = list(calibration_graphs or
                            _calibration_graphs(keys, buckets))
                 int8_delta = 0.0
@@ -316,6 +551,8 @@ class ScoringEngine:
                 reason = f"calibration refused: {exc}"
             if accepted:
                 score_fn, device_fn = score8, device8
+                chosen_model, chosen_params = model8, qparams
+                chosen_scorer = scorer8
             else:
                 warnings.warn(
                     f"int8 serving path refused — {reason}; serving f32",
@@ -328,16 +565,31 @@ class ScoringEngine:
         elif precision != "f32":
             raise ValueError(f"precision must be 'f32' or 'int8', got {precision!r}")
 
+        if mesh is not None:
+            stacked_fn = _make_replicated_fn(chosen_scorer, chosen_params,
+                                             mesh)
+            return cls(None, buckets, label_style=label_style,
+                       feat_keys=keys, vocab_hash=vocab_hash,
+                       latency_mode=latency_mode, precision=precision,
+                       int8_score_delta=int8_delta, stacked_fn=stacked_fn,
+                       n_replicas=int(mesh.shape["dp"]), model_rev=model_rev)
+
+        export_fn = _make_export_fn(chosen_model, chosen_params, label_style,
+                                    keys)
         return cls(score_fn, buckets, label_style=label_style,
-                   feat_keys=feat_keys, vocab_hash=vocab_hash,
+                   feat_keys=keys, vocab_hash=vocab_hash,
                    device_fn=device_fn, latency_mode=latency_mode,
-                   precision=precision, int8_score_delta=int8_delta)
+                   precision=precision, int8_score_delta=int8_delta,
+                   model_rev=model_rev, export_fn=export_fn)
 
     @classmethod
     def from_checkpoint(cls, cfg, ckpt_dir: Path | str, vocabs,
-                        max_batch: int | None = None) -> "ScoringEngine":
+                        max_batch: int | None = None,
+                        journal=None) -> "ScoringEngine":
         """Restore best-else-latest params (same policy as predict/test)
-        and serve through the layout-portable segment forward."""
+        and serve through the layout-portable segment forward. With
+        ``cfg.serve.mesh_replicas > 1`` the engine replicates across that
+        many local devices (one replica per device)."""
         import jax
         import jax.numpy as jnp
 
@@ -365,6 +617,11 @@ class ScoringEngine:
         restored = (ckpts.restore_best(template={"params": params})
                     if ckpts.best_step() is not None
                     else ckpts.restore_latest(template={"params": params}))
+        mesh = None
+        if getattr(cfg.serve, "mesh_replicas", 0) > 1:
+            from deepdfa_tpu.parallel.mesh import local_mesh
+
+            mesh = local_mesh(cfg.serve.mesh_replicas)
         return cls.from_model(
             model, restored["params"], cfg.model.label_style,
             feat_keys=tuple(vocabs),
@@ -372,7 +629,7 @@ class ScoringEngine:
             vocab_hash=vocab_content_hash(vocabs),
             precision=cfg.serve.precision,
             int8_max_score_delta=cfg.serve.int8_max_score_delta,
-            latency_mode=cfg.serve.latency_mode)
+            latency_mode=cfg.serve.latency_mode, journal=journal, mesh=mesh)
 
     @classmethod
     def from_artifact(cls, artifact_dir: Path | str,
@@ -414,3 +671,89 @@ class ScoringEngine:
         return cls(score_fn, (bucket,), label_style=label_style,
                    feat_keys=tuple(man["node_feat_keys"]),
                    vocab_hash=man.get("vocab_hash"))
+
+
+# ---------------------------------------------------------------------------
+# mesh replication + warm-store export helpers (live-model engines)
+
+
+def _plain_score_callable(model, params, label_style: str):
+    """The exportable form of the scorer: plain apply (no mutable
+    intermediates — jax.export cannot serialize them), same probabilities
+    as :func:`deepdfa_tpu.predict.make_scorer`. Node-style checkpoints
+    bake the node→function max reduction into the program."""
+    import jax
+    import jax.numpy as jnp
+
+    def score(batch):
+        if label_style == "node":
+            node_p = jax.nn.sigmoid(model.apply({"params": params}, batch))
+            masked = jnp.where(batch.node_mask, node_p,
+                               jnp.full_like(node_p, -jnp.inf))
+            return jax.ops.segment_max(masked, batch.node_gidx,
+                                       num_segments=batch.max_graphs)
+        return jax.nn.sigmoid(model.apply({"params": params}, batch))
+
+    return score
+
+
+def _make_export_fn(model, params, label_style: str, feat_keys):
+    """``bucket -> (serialized StableHLO, export_seconds)`` for the warm
+    store — the same ``jax.export`` path :func:`deepdfa_tpu.serving.
+    export_ggnn` uses, specialized to one bucket's padded shape."""
+
+    def export_bucket(bucket: ServeBucket):
+        import jax
+
+        from jax import export as jexport
+
+        from deepdfa_tpu.serving import _register_pytrees
+
+        _register_pytrees()
+        t0 = time.perf_counter()
+        n = 2
+        feats = {k: np.zeros(n, np.int32) for k in feat_keys}
+        g = Graph(senders=np.arange(n - 1, dtype=np.int32),
+                  receivers=np.arange(1, n, dtype=np.int32),
+                  node_feats=feats).with_self_loops()
+        ex = batch_np([g], bucket.spec.max_graphs, bucket.spec.max_nodes,
+                      bucket.spec.max_edges)
+        ex = ex._replace(node_feats={k: ex.node_feats[k] for k in feat_keys})
+        args_spec = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+            ex)
+        score = _plain_score_callable(model, params, label_style)
+        exported = jexport.export(jax.jit(score),
+                                  platforms=["cpu", "tpu"])(args_spec)
+        return exported.serialize(), time.perf_counter() - t0
+
+    return export_bucket
+
+
+def _make_replicated_fn(scorer, params, mesh):
+    """One-dispatch device-parallel scoring over a ``dp`` mesh: the
+    stacked ``[dp, ...]`` batch splits one padded batch per device
+    (shard_map), each replica runs the scorer locally, and the probs come
+    back stacked ``[dp, max_graphs]``. Params are replicated — no
+    collectives exist in this program at all; it is pure replication."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from deepdfa_tpu.parallel.dp import _shard_map
+
+    def one(ps, stacked):
+        batch = jax.tree.map(lambda x: x[0], stacked)
+        fn_p, _ = scorer(ps, batch)
+        return fn_p[None]
+
+    replicated = jax.jit(_shard_map(
+        one, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P("dp"),
+        check_vma=False))
+
+    def stacked_fn(stacked):
+        return np.asarray(
+            replicated(params, jax.tree.map(jnp.asarray, stacked)),
+            np.float32)
+
+    return stacked_fn

@@ -74,6 +74,13 @@ class ServeMetrics:
         self.queue_depth = 0
         self.inflight = 0
         self.latency = LatencyReservoir(latency_window)
+        self.warmup: dict | None = None  # last engine warmup report
+
+    def set_warmup(self, report: dict) -> None:
+        """Publish an engine warmup report (per-bucket compile seconds +
+        warm-store hit/miss/saved counters) for /metrics scrapes."""
+        with self._lock:
+            self.warmup = dict(report)
 
     def inc(self, name: str, by: float = 1) -> None:
         with self._lock:
@@ -115,6 +122,7 @@ class ServeMetrics:
                 "occupancy_sum": self.occupancy_sum,
                 "queue_depth": self.queue_depth,
                 "inflight": self.inflight,
+                "warmup": dict(self.warmup) if self.warmup else None,
             }
         snap["mean_batch_occupancy"] = (
             snap["occupancy_sum"] / snap["batches_total"]
@@ -153,6 +161,22 @@ class ServeMetrics:
                 lines.append("# TYPE deepdfa_serve_latency_ms gauge")
                 lines.append(
                     f'deepdfa_serve_latency_ms{{quantile="{q}"}} {v}')
+        warm = snap.get("warmup")
+        if warm:
+            emit("warm_store_hits_total", "counter", warm.get("hits"))
+            emit("warm_store_misses_total", "counter", warm.get("misses"))
+            emit("warm_store_compile_seconds_saved", "gauge",
+                 warm.get("compile_seconds_saved"))
+            for bucket, row in sorted((warm.get("per_bucket") or {}).items()):
+                secs = row.get("compile_seconds")
+                if secs is None:
+                    continue
+                lines.append(
+                    "# TYPE deepdfa_serve_warmup_compile_seconds gauge")
+                lines.append(
+                    f'deepdfa_serve_warmup_compile_seconds'
+                    f'{{bucket="{bucket}",source="{row.get("source")}"}} '
+                    f'{secs}')
         if cache_stats:
             emit("cache_hits_total", "counter", cache_stats.get("hits"))
             emit("cache_encode_hits_total", "counter",

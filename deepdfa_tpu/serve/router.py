@@ -1,0 +1,467 @@
+"""Shared-nothing fleet router: consistent-hash ``source_key`` sharding.
+
+One :class:`~deepdfa_tpu.serve.server.ScoreServer` owns one in-process
+:class:`~deepdfa_tpu.serve.cache.ScanCache`. Run N of them behind a
+round-robin LB and every replica re-scans (and re-caches) the same
+sources — N× the memory for 1× the hit rate. The router fixes the
+topology instead of the cache: requests are routed by the SAME content
+address the cache keys on (``pipeline.source_key``, sha256 of the
+whitespace-normalized source), so each source lands on exactly one
+backend and the fleet's cache is the union of N disjoint shards.
+
+Routing is a consistent-hash ring (``vnodes`` points per backend from
+sha256, binary-searched): a backend joining or leaving remaps only
+~1/N of the keyspace — the other shards keep their hits, which is the
+entire point versus ``hash(key) % N``.
+
+Backend lifecycle mirrors the PR 5 elasticity invariants:
+
+- **readiness-gated registration** — a configured backend enters the
+  ring only after a ``/healthz`` 200 whose body says the bucket ladder
+  is warm; a replica that is still compiling takes no traffic;
+- **health probes** — a background thread re-probes every backend on an
+  interval; a connection failure or 5xx takes it out of the ring
+  (state ``down``) until it probes healthy again;
+- **drain-aware rebalancing** — a backend answering 503/``draining``
+  (its SIGTERM flag) leaves the ring immediately; its keyspace slides
+  to ring neighbours while in-flight requests finish. The router's own
+  SIGTERM sets the same flag-only drain: ``/healthz`` goes 503, new
+  scores get 503, in-flight forwards complete.
+
+Per-request failover: a forward that fails at the socket marks the
+backend down and retries the next ring node (bounded by the live
+backend count) — one crashed replica costs its cache shard, not its
+keyspace's availability.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import http.client
+import json
+import logging
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepdfa_tpu.pipeline import source_key
+
+from .metrics import LatencyReservoir
+
+__all__ = ["HashRing", "Backend", "RouterMetrics", "FleetRouter", "main"]
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_VNODES = 64
+FORWARD_TIMEOUT_S = 90.0  # one backend round-trip (covers a cold compile)
+
+
+def _ring_hash(token: str) -> int:
+    return int.from_bytes(hashlib.sha256(token.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes. ``route(key)`` walks
+    clockwise from the key's point to the first live node; ``exclude``
+    keeps walking past named nodes (per-request failover)."""
+
+    def __init__(self, vnodes: int = DEFAULT_VNODES):
+        self.vnodes = int(vnodes)
+        self._points: list[int] = []     # sorted ring positions
+        self._owners: list[str] = []     # node name at each position
+        self._nodes: set[str] = set()
+        self._lock = threading.Lock()
+
+    @property
+    def nodes(self) -> set[str]:
+        with self._lock:
+            return set(self._nodes)
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def add(self, name: str) -> None:
+        with self._lock:
+            if name in self._nodes:
+                return
+            self._nodes.add(name)
+            for i in range(self.vnodes):
+                pt = _ring_hash(f"{name}#{i}")
+                idx = bisect.bisect(self._points, pt)
+                self._points.insert(idx, pt)
+                self._owners.insert(idx, name)
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            if name not in self._nodes:
+                return
+            self._nodes.discard(name)
+            keep = [(p, o) for p, o in zip(self._points, self._owners)
+                    if o != name]
+            self._points = [p for p, _ in keep]
+            self._owners = [o for _, o in keep]
+
+    def route(self, key: str, exclude=frozenset()) -> str | None:
+        """Owner of ``key``, skipping ``exclude``; None when no eligible
+        node remains."""
+        with self._lock:
+            if not self._points:
+                return None
+            candidates = self._nodes - set(exclude)
+            if not candidates:
+                return None
+            start = bisect.bisect(self._points, _ring_hash(key))
+            n = len(self._points)
+            for step in range(n):
+                owner = self._owners[(start + step) % n]
+                if owner in candidates:
+                    return owner
+            return None
+
+
+@dataclass
+class Backend:
+    """One ScoreServer the router fronts. ``state`` transitions:
+    pending → ready (first warm healthz 200) → draining/down → ready."""
+
+    name: str                     # "host:port" — also the ring node name
+    host: str
+    port: int
+    state: str = "pending"
+    health: dict = field(default_factory=dict)  # last healthz body
+    forwarded: int = 0
+    failures: int = 0
+
+    @classmethod
+    def parse(cls, spec: str) -> "Backend":
+        host, _, port = spec.rpartition(":")
+        return cls(name=spec, host=host or "127.0.0.1", port=int(port))
+
+
+class RouterMetrics:
+    """Router-side counters; rendered as ``deepdfa_router_*``."""
+
+    def __init__(self, latency_window: int = 2048):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.forwarded_total: dict[str, int] = {}
+        self.retries_total = 0
+        self.no_backend_total = 0
+        self.errors_total = 0
+        self.latency = LatencyReservoir(latency_window)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + by)
+
+    def observe_forward(self, backend: str) -> None:
+        with self._lock:
+            self.forwarded_total[backend] = (
+                self.forwarded_total.get(backend, 0) + 1)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "requests_total": self.requests_total,
+                "forwarded_total": dict(self.forwarded_total),
+                "retries_total": self.retries_total,
+                "no_backend_total": self.no_backend_total,
+                "errors_total": self.errors_total,
+                "latency_p50_ms": self.latency.quantile(0.50),
+                "latency_p99_ms": self.latency.quantile(0.99),
+            }
+
+    def render(self) -> str:
+        snap = self.snapshot()
+        lines = ["# TYPE deepdfa_router_requests_total counter",
+                 f"deepdfa_router_requests_total {snap['requests_total']}"]
+        for name in sorted(snap["forwarded_total"]):
+            lines.append("# TYPE deepdfa_router_forwarded_total counter")
+            lines.append(f'deepdfa_router_forwarded_total{{backend="{name}"}} '
+                         f'{snap["forwarded_total"][name]}')
+        for key in ("retries_total", "no_backend_total", "errors_total"):
+            lines.append(f"# TYPE deepdfa_router_{key} counter")
+            lines.append(f"deepdfa_router_{key} {snap[key]}")
+        for q in (0.50, 0.99):
+            v = self.latency.quantile(q)
+            if v is not None:
+                lines.append("# TYPE deepdfa_router_latency_ms gauge")
+                lines.append(f'deepdfa_router_latency_ms{{quantile="{q}"}} {v}')
+        return "\n".join(lines) + "\n"
+
+
+class FleetRouter:
+    """The fleet's one client-facing surface.
+
+    ``POST /score`` computes the body's ``source_key``, routes it on the
+    ring, and proxies the backend's response verbatim (plus an
+    ``X-DeepDFA-Backend`` header naming the shard). ``GET /healthz``
+    reports the router + per-backend states; ``GET /metrics`` the
+    ``deepdfa_router_*`` counters."""
+
+    def __init__(self, backends, host: str = "127.0.0.1", port: int = 0,
+                 vnodes: int = DEFAULT_VNODES,
+                 probe_interval_s: float = 2.0,
+                 metrics: RouterMetrics | None = None):
+        self.backends: dict[str, Backend] = {}
+        for spec in backends:
+            b = spec if isinstance(spec, Backend) else Backend.parse(str(spec))
+            self.backends[b.name] = b
+        if not self.backends:
+            raise ValueError("router needs at least one backend")
+        self.ring = HashRing(vnodes)
+        self.metrics = metrics or RouterMetrics()
+        self.probe_interval_s = float(probe_interval_s)
+        self._draining = threading.Event()
+        self._stop_requested = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self.httpd.daemon_threads = True
+        self._serve_thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set() or self._stop_requested.is_set()
+
+    def start(self, probe: bool = True) -> "FleetRouter":
+        if probe:
+            self.probe_once()
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="router-probe", daemon=True)
+            self._probe_thread.start()
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="router-http", daemon=True)
+        self._serve_thread.start()
+        logger.info("routing on :%s over %d backend(s), %d ready",
+                    self.port, len(self.backends), len(self.ring))
+        return self
+
+    def install_signal_handlers(self) -> None:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: self._stop_requested.set())
+
+    def wait(self) -> dict:
+        while not self._stop_requested.wait(timeout=0.2):
+            pass
+        return self.shutdown()
+
+    def request_stop(self) -> None:
+        self._stop_requested.set()
+
+    def shutdown(self) -> dict:
+        self._draining.set()
+        self._stop_requested.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        return self.metrics.snapshot()
+
+    # -- backend health -----------------------------------------------------
+
+    def _probe_backend(self, b: Backend) -> None:
+        try:
+            conn = http.client.HTTPConnection(b.host, b.port, timeout=5.0)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                body = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+        except (OSError, json.JSONDecodeError) as exc:
+            self._mark(b, "down", {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        if resp.status == 200 and not body.get("draining"):
+            # readiness gate: only a WARM replica joins the ring — a
+            # compiling one would stall its whole keyspace
+            if body.get("warm", True):
+                self._mark(b, "ready", body)
+            else:
+                self._mark(b, "pending", body)
+        elif body.get("draining"):
+            self._mark(b, "draining", body)
+        else:
+            self._mark(b, "down", body)
+
+    def _mark(self, b: Backend, state: str, health: dict) -> None:
+        prev = b.state
+        b.state = state
+        b.health = health
+        if state == "ready":
+            self.ring.add(b.name)
+        else:
+            self.ring.remove(b.name)
+        if state != prev:
+            logger.info("backend %s: %s -> %s", b.name, prev, state)
+
+    def probe_once(self) -> dict:
+        """Probe every backend once; returns ``{name: state}``."""
+        for b in list(self.backends.values()):
+            self._probe_backend(b)
+        return {name: b.state for name, b in self.backends.items()}
+
+    def _probe_loop(self) -> None:
+        while not self._stop_requested.wait(timeout=self.probe_interval_s):
+            self.probe_once()
+
+    # -- request path -------------------------------------------------------
+
+    def handle_score(self, raw: bytes) -> tuple[int, dict, dict]:
+        """Route + forward one ``/score`` body. Returns
+        ``(status, body, extra_headers)``."""
+        if self.draining:
+            return 503, {"error": "router is draining"}, {}
+        try:
+            payload = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            return 400, {"error": "body is not valid JSON"}, {}
+        source = payload.get("source") if isinstance(payload, dict) else None
+        if not isinstance(source, str) or not source.strip():
+            return 400, {"error": "body must be JSON with a 'source' string"}, {}
+        key = source_key(source)
+
+        tried: set[str] = set()
+        max_hops = max(1, len(self.ring))
+        for _ in range(max_hops):
+            name = self.ring.route(key, exclude=tried)
+            if name is None:
+                break
+            b = self.backends[name]
+            try:
+                status, body = self._forward(b, raw)
+            except OSError as exc:
+                tried.add(name)
+                b.failures += 1
+                self._mark(b, "down",
+                           {"error": f"{type(exc).__name__}: {exc}"})
+                self.metrics.inc("retries_total")
+                logger.warning("forward to %s failed (%s) — failing over",
+                               name, type(exc).__name__)
+                continue
+            b.forwarded += 1
+            self.metrics.observe_forward(name)
+            return status, body, {"X-DeepDFA-Backend": name}
+        self.metrics.inc("no_backend_total")
+        return 503, {"error": "no ready backend for this key"}, {}
+
+    def _forward(self, b: Backend, raw: bytes) -> tuple[int, dict]:
+        conn = http.client.HTTPConnection(b.host, b.port,
+                                          timeout=FORWARD_TIMEOUT_S)
+        try:
+            conn.request("POST", "/score", body=raw,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+        finally:
+            conn.close()
+        try:
+            return resp.status, json.loads(data or b"{}")
+        except json.JSONDecodeError:
+            return 502, {"error": "backend returned invalid JSON"}
+
+    def healthz(self) -> tuple[int, dict]:
+        ready = sorted(self.ring.nodes)
+        body = {
+            "status": "draining" if self.draining else (
+                "ok" if ready else "no_ready_backends"),
+            "draining": self.draining,
+            "ready_backends": ready,
+            "backends": {name: {"state": b.state,
+                                "replica_id": b.health.get("replica_id"),
+                                "forwarded": b.forwarded,
+                                "failures": b.failures}
+                         for name, b in self.backends.items()},
+        }
+        ok = bool(ready) and not self.draining
+        return (200 if ok else 503), body
+
+
+def _make_handler(router: FleetRouter):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            logger.debug("router http: " + fmt, *args)
+
+        def _send(self, code: int, body, headers=None,
+                  content_type="application/json"):
+            data = (body.encode() if isinstance(body, str)
+                    else json.dumps(body).encode())
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                code, body = router.healthz()
+                self._send(code, body)
+            elif self.path == "/metrics":
+                self._send(200, router.metrics.render(),
+                           content_type="text/plain; version=0.0.4")
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/score":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            t0 = time.perf_counter()
+            router.metrics.inc("requests_total")
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length)
+                code, body, extra = router.handle_score(raw)
+            except Exception as exc:  # noqa: BLE001 — request dies, router not
+                code, body, extra = 500, {
+                    "error": f"{type(exc).__name__}: {exc}"}, {}
+            if code >= 400:
+                router.metrics.inc("errors_total")
+            self._send(code, body, headers=extra)
+            router.metrics.latency.observe(
+                (time.perf_counter() - t0) * 1000.0)
+
+    return Handler
+
+
+def main(argv=None) -> dict:
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="deepdfa-tpu-route")
+    parser.add_argument("--backend", action="append", default=[],
+                        required=False, dest="backends", metavar="HOST:PORT",
+                        help="a ScoreServer to front (repeatable)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8900)
+    parser.add_argument("--vnodes", type=int, default=DEFAULT_VNODES)
+    parser.add_argument("--probe-interval", type=float, default=2.0,
+                        dest="probe_interval_s")
+    args = parser.parse_args(argv)
+    if not args.backends:
+        parser.error("need at least one --backend HOST:PORT")
+
+    logging.basicConfig(level=logging.INFO)
+    router = FleetRouter(args.backends, host=args.host, port=args.port,
+                         vnodes=args.vnodes,
+                         probe_interval_s=args.probe_interval_s)
+    router.install_signal_handlers()
+    router.start()
+    print(json.dumps({"status": "routing", "port": router.port,
+                      "backends": router.probe_once()}), flush=True)
+    summary = router.wait()
+    print(json.dumps({"status": "drained", **summary}), flush=True)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

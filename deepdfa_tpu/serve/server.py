@@ -58,10 +58,15 @@ class ScoreServer:
 
     def __init__(self, engine: ScoringEngine, vocabs,
                  cfg: ServeConfig | None = None, cache: ScanCache | None = None,
-                 metrics: ServeMetrics | None = None):
+                 metrics: ServeMetrics | None = None,
+                 replica_id: str | None = None, warm_store=None,
+                 journal=None):
         self.cfg = cfg or ServeConfig()
         self.engine = engine
         self.vocabs = vocabs
+        self.replica_id = replica_id or self.cfg.replica_id
+        self.warm_store = warm_store
+        self.journal = journal
         self.metrics = metrics or ServeMetrics(self.cfg.latency_window)
         self.cache = cache if cache is not None else ScanCache(
             self.cfg.cache_entries)
@@ -90,7 +95,17 @@ class ScoreServer:
         # LB routes elsewhere while in-flight work finishes
         return self._draining.is_set() or self._stop_requested.is_set()
 
+    def warmup(self) -> dict:
+        """Warm the engine's bucket ladder (through the warm store when
+        one is wired), publish the report to /metrics, and return it."""
+        report = self.engine.warmup(warm_store=self.warm_store,
+                                    journal=self.journal)
+        self.metrics.set_warmup(report)
+        return report
+
     def start(self) -> "ScoreServer":
+        if self.replica_id is None:
+            self.replica_id = f"{self.cfg.host}:{self.port}"
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="serve-http", daemon=True)
         self._serve_thread.start()
@@ -219,11 +234,22 @@ def _make_handler(server: ScoreServer):
                 # distinct draining state + 503 once SIGTERM is received:
                 # LB health checks key on the status code, so the replica
                 # drops out of rotation before the drain completes
+                # the router's readiness gate keys on this body: replica
+                # identity, the warm bucket ladder, and the content hashes
+                # that decide whether a warm-store artifact is usable
                 draining = server.draining
+                eng = server.engine
                 self._send(503 if draining else 200,
                            {"status": "draining" if draining else "ok",
                             "draining": draining,
-                            "label_style": server.engine.label_style})
+                            "replica_id": server.replica_id,
+                            "warm": bool(eng.warm_buckets),
+                            "warm_buckets": list(eng.warm_buckets),
+                            "vocab_hash": eng.vocab_hash,
+                            "model_rev": eng.model_rev,
+                            "precision": eng.precision,
+                            "n_replicas": eng.n_replicas,
+                            "label_style": eng.label_style})
             elif self.path == "/metrics":
                 self._send(200, server.metrics.render(server.cache.stats()),
                            content_type="text/plain; version=0.0.4")
@@ -263,9 +289,11 @@ def _make_handler(server: ScoreServer):
 def build_server(cfg: ExperimentConfig, run_dir: Path | None = None,
                  ckpt_dir: Path | None = None,
                  artifact: Path | str | None = None,
-                 shard_dir: Path | str | None = None) -> ScoreServer:
+                 shard_dir: Path | str | None = None,
+                 journal=None) -> ScoreServer:
     """Wire vocabs + engine + server from a config: either a checkpoint
-    run (``run_dir``/``ckpt_dir``) or a pre-exported ``artifact`` dir."""
+    run (``run_dir``/``ckpt_dir``) or a pre-exported ``artifact`` dir.
+    ``serve.warm_store_dir`` attaches the fleet warm-start store."""
     from deepdfa_tpu import utils
 
     if shard_dir is None:
@@ -279,25 +307,37 @@ def build_server(cfg: ExperimentConfig, run_dir: Path | None = None,
             raise ValueError("need --run-dir/--ckpt-dir or --artifact")
         engine = ScoringEngine.from_checkpoint(
             cfg, ckpt_dir or Path(run_dir) / "checkpoints", vocabs,
-            max_batch=cfg.serve.max_batch)
-    return ScoreServer(engine, vocabs, cfg.serve)
+            max_batch=cfg.serve.max_batch, journal=journal)
+    warm_store = None
+    if cfg.serve.warm_store_dir:
+        from .warmstore import WarmStore
+
+        warm_store = WarmStore(cfg.serve.warm_store_dir)
+    return ScoreServer(engine, vocabs, cfg.serve, warm_store=warm_store,
+                       journal=journal)
 
 
 def serve_command(cfg: ExperimentConfig, run_dir: Path | None = None,
                   ckpt_dir: Path | None = None,
                   artifact: Path | str | None = None,
-                  shard_dir: Path | str | None = None) -> dict:
+                  shard_dir: Path | str | None = None,
+                  journal=None) -> dict:
     """Foreground service: build, warm, serve until SIGTERM, drain."""
     server = build_server(cfg, run_dir=run_dir, ckpt_dir=ckpt_dir,
-                          artifact=artifact, shard_dir=shard_dir)
-    warmed = server.engine.warmup()
+                          artifact=artifact, shard_dir=shard_dir,
+                          journal=journal)
+    warmed = server.warmup()
     server.install_signal_handlers()
     server.start()
     print(json.dumps({
         "status": "serving", "host": server.cfg.host, "port": server.port,
-        "buckets_warmed": warmed,
+        "replica_id": server.replica_id,
+        "buckets_warmed": warmed["buckets"],
+        "warm_store": {k: warmed[k] for k in
+                       ("hits", "misses", "compile_seconds_saved")},
         "label_style": server.engine.label_style,
         "vocab_hash": server.engine.vocab_hash,
+        "model_rev": server.engine.model_rev,
     }), flush=True)
     summary = server.wait()
     print(json.dumps({"status": "drained", **{
@@ -324,6 +364,8 @@ def main(argv=None) -> dict:
     parser.add_argument("--shard-dir", default=None,
                         help="shard dir holding vocab.json (default: the "
                              "config's processed dataset dir)")
+    parser.add_argument("--journal", default=None,
+                        help="journal file for warmup / int8-gate events")
     args = parser.parse_args(argv)
 
     layers = list(args.config)
@@ -342,10 +384,15 @@ def main(argv=None) -> dict:
 
     cfg = load_config(*layers, overrides=_parse(args.overrides))
     logging.basicConfig(level=logging.INFO)
+    journal = None
+    if args.journal:
+        from deepdfa_tpu.resilience.journal import RunJournal
+
+        journal = RunJournal(Path(args.journal))
     return serve_command(
         cfg, run_dir=Path(args.run_dir) if args.run_dir else None,
         ckpt_dir=Path(args.ckpt_dir) if args.ckpt_dir else None,
-        artifact=args.artifact, shard_dir=args.shard_dir)
+        artifact=args.artifact, shard_dir=args.shard_dir, journal=journal)
 
 
 if __name__ == "__main__":

@@ -1,15 +1,19 @@
 #!/usr/bin/env python
 """The fast pre-commit gate: ruff over the library + the device-free perf
-contract suite (``pytest -m perf_contract``) in one command.
+contract suite (``pytest -m perf_contract``) + the fleet unit suite
+(``pytest -m fleet``: hash ring, router, warm store) in one command.
 
-Neither half touches an accelerator, compiles XLA, or takes more than a few
+No step touches an accelerator, compiles XLA, or takes more than a few
 seconds, so this is safe to run on every commit: ruff catches the syntax/
 import rot, the perf-contract tests catch drift in the bench artifact
 schemas and ok-gates (``bench.assemble_*`` are pure functions — a field
 rename or gate-logic change fails HERE, not in a device run whose artifact
-the roadmap tooling then misreads).
+the roadmap tooling then misreads), and the fleet tests catch routing /
+warm-store regressions (consistent-hash stability is a pure-logic property
+that deserves pre-commit cadence — a ring bug silently halves the fleet's
+cache hit rate).
 
-Exit code: 0 only when BOTH pass. Ruff missing is a skip (it is not a hard
+Exit code: 0 only when ALL pass. Ruff missing is a skip (it is not a hard
 dependency — same policy as tests/test_lint.py), pytest missing is a
 failure (the repo's own test runner must exist).
 """
@@ -55,6 +59,14 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("perf_contract")
+
+    print("lint_gate: pytest -m fleet")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "fleet", "-q",
+         "tests/test_serve.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("fleet")
 
     if failures:
         print(f"lint_gate: FAILED ({', '.join(failures)})")

@@ -149,3 +149,106 @@ def test_int8_serving_over_delta_acceptance_is_not_ok():
         "tpu", "TPU v5e", precision_served="int8", int8_score_delta=0.5,
         max_score_delta=0.01, tiers={})
     assert art["ok"] is False
+
+
+# ------------------------------------------------------------------- fleet
+
+
+def _fleet_kwargs(**over):
+    """A fully-green fleet measurement; tests flip ONE knob at a time."""
+    kw = dict(backend="tpu", device_kind="TPU v5e", n_replicas=4,
+              single_cold_rps=10.0, fleet_cold_rps=35.0,
+              aggregate_p50_ms=12.0, aggregate_p99_ms=40.0,
+              per_replica={f"r{i}": {"forwarded": 25, "cache_hits": 6}
+                           for i in range(4)},
+              shard_cache_hits=24, join_cold_compiles=0,
+              compile_seconds_saved=5.5, load_x=10, errors_total=0)
+    kw.update(over)
+    return kw
+
+
+def test_fleet_schema_and_tpu_speedup_gate():
+    art = bench.assemble_fleet_result(**_fleet_kwargs())
+    assert art["metric"] == "fleet_requests_per_sec"
+    assert art["unit"] == "req/s"
+    assert art["value"] == 35.0 and art["single_replica_rps"] == 10.0
+    assert art["speedup_vs_single"] == 3.5
+    assert art["min_speedup"] == bench.FLEET_MIN_SPEEDUP_FRAC * 4 == 3.0
+    assert art["speedup_ok"] is True
+    assert art["all_replicas_routed"] is True
+    assert art["ok"] is True
+    assert PROVENANCE_KEYS <= set(art)
+
+
+def test_fleet_tpu_speedup_below_floor_fails():
+    """3x on 4 replicas is the acceptance floor — 2.9x single-replica
+    multiples on TPU read ok:false even with clean structure."""
+    art = bench.assemble_fleet_result(**_fleet_kwargs(fleet_cold_rps=29.0))
+    assert art["speedup_vs_single"] == 2.9
+    assert art["speedup_ok"] is False
+    assert art["ok"] is False
+
+
+def test_fleet_cpu_speedup_is_null_but_structure_still_gates():
+    """A 1-core CPU host cannot show 4 replicas scoring 4x faster — the
+    speedup gate is a TPU claim (same policy as the strict-latency
+    anchor). The topology claims still gate: the artifact records the
+    measured speedup honestly with ``speedup_ok: null``."""
+    art = bench.assemble_fleet_result(
+        **_fleet_kwargs(backend="cpu", device_kind="cpu",
+                        fleet_cold_rps=9.0))
+    assert art["speedup_ok"] is None
+    assert art["speedup_vs_single"] == 0.9  # recorded, not hidden
+    assert art["ok"] is True  # structure green
+
+    bad = bench.assemble_fleet_result(
+        **_fleet_kwargs(backend="cpu", device_kind="cpu",
+                        fleet_cold_rps=9.0, shard_cache_hits=0))
+    assert bad["ok"] is False  # structural gates never waived
+
+
+@pytest.mark.parametrize("knob, value", [
+    ("join_cold_compiles", 1),       # a joiner recompiled: warm store failed
+    ("compile_seconds_saved", 0.0),  # nothing journaled as saved
+    ("compile_seconds_saved", None),
+    ("shard_cache_hits", 0),         # hot keys missed their shard
+    ("errors_total", 3),             # load produced failures
+    ("n_replicas", 1),               # a "fleet" of one proves nothing
+])
+def test_fleet_structural_gates_each_fail_alone(knob, value):
+    art = bench.assemble_fleet_result(**{**_fleet_kwargs(), knob: value})
+    assert art["ok"] is False, knob
+
+
+def test_fleet_unrouted_replica_fails():
+    """One replica with zero forwards means the ring never spread its
+    keyspace — a dead shard must fail the stage even at full speed."""
+    per = {f"r{i}": {"forwarded": 25 if i else 0} for i in range(4)}
+    art = bench.assemble_fleet_result(**_fleet_kwargs(per_replica=per))
+    assert art["all_replicas_routed"] is False
+    assert art["ok"] is False
+    assert bench.assemble_fleet_result(
+        **_fleet_kwargs(per_replica={}))["ok"] is False
+
+
+def test_serve_result_ands_fleet_block():
+    """The serving artifact carries the fleet block and ANDs its ok —
+    a green single-replica run cannot mask a failed fleet phase."""
+    serve_kw = dict(backend="cpu", device_kind="cpu", requests_per_sec=50.0,
+                    p50_ms=5.0, p99_ms=20.0, mean_batch_occupancy=3.0,
+                    cache_hit_rate=0.5, cache_hits=10, requests_total=100,
+                    errors_total=0)
+    solo = bench.assemble_serve_result(**serve_kw)
+    assert solo["ok"] is True and solo["fleet"] is None
+
+    good = bench.assemble_serve_result(
+        **serve_kw, fleet=bench.assemble_fleet_result(
+            **_fleet_kwargs(backend="cpu", device_kind="cpu")))
+    assert good["ok"] is True and good["fleet"]["ok"] is True
+
+    bad = bench.assemble_serve_result(
+        **serve_kw, fleet=bench.assemble_fleet_result(
+            **_fleet_kwargs(backend="cpu", device_kind="cpu",
+                            join_cold_compiles=2)))
+    assert bad["fleet"]["ok"] is False
+    assert bad["ok"] is False  # fleet failure surfaces at the top level

@@ -140,8 +140,11 @@ def test_bucket_ladder_routing_and_oversize():
 
 def test_engine_warmup_compiles_every_bucket():
     eng = _StubEngine(max_batch=4)
-    assert eng.warmup() == 3
+    report = eng.warmup()
+    assert report["buckets"] == 3
+    assert (report["hits"], report["misses"]) == (0, 3)  # no store: all cold
     assert len(eng.record) == 3  # one compile call per bucket shape
+    assert eng.warm_buckets == [126, 1022, 4094]
 
 
 @pytest.mark.faults
@@ -153,7 +156,7 @@ def test_engine_warmup_does_not_consume_armed_fault():
 
     eng = _StubEngine(max_batch=4)
     with faults.installed("serve.engine_raises@1"):
-        assert eng.warmup() == 3  # no InjectedFault
+        assert eng.warmup()["buckets"] == 3  # no InjectedFault
         with pytest.raises(faults.InjectedFault):
             eng.score([_chain(5)], eng.buckets[0])
 
@@ -599,3 +602,556 @@ def test_int8_gate_refuses_nan_poisoned_checkpoint(live_model, tmp_path):
     rec = journal.read()
     assert rec["event"] == "int8_gate_refused"
     assert "non-finite" in rec["reason"]
+
+
+# ---------------------------------------------------------------------------
+# distributed fleet: consistent-hash ring + warm store (pytest -m fleet —
+# the lint_gate unit slice: pure logic, no engine compiles)
+
+
+@pytest.mark.fleet
+def test_hash_ring_join_moves_about_one_over_n_keys():
+    """The consistent-hashing contract: adding the (N+1)th backend remaps
+    ~1/(N+1) of the keyspace — NOT the ~N/(N+1) a modulo scheme would."""
+    from deepdfa_tpu.serve import HashRing
+
+    ring = HashRing()
+    for i in range(4):
+        ring.add(f"b{i}:80")
+    keys = [f"key-{i}" for i in range(2000)]
+    before = {k: ring.route(k) for k in keys}
+    assert all(v is not None for v in before.values())
+    ring.add("b4:80")
+    moved = sum(before[k] != ring.route(k) for k in keys)
+    # ideal is 1/5 = 400; allow generous vnode variance either side
+    assert 0.10 * len(keys) < moved < 0.35 * len(keys)
+    # every moved key moved TO the new node (stability for the others)
+    for k in keys:
+        if before[k] != ring.route(k):
+            assert ring.route(k) == "b4:80"
+
+
+@pytest.mark.fleet
+def test_hash_ring_leave_only_reassigns_leaving_nodes_keys():
+    from deepdfa_tpu.serve import HashRing
+
+    ring = HashRing()
+    for i in range(4):
+        ring.add(f"b{i}:80")
+    keys = [f"key-{i}" for i in range(2000)]
+    before = {k: ring.route(k) for k in keys}
+    ring.remove("b2:80")
+    for k in keys:
+        after = ring.route(k)
+        assert after != "b2:80"
+        if before[k] != "b2:80":
+            assert after == before[k]  # survivors keep their shard
+
+
+@pytest.mark.fleet
+def test_hash_ring_exclude_walks_and_empty_ring_routes_none():
+    from deepdfa_tpu.serve import HashRing
+
+    ring = HashRing()
+    assert ring.route("k") is None
+    ring.add("a:1")
+    ring.add("b:2")
+    owner = ring.route("k")
+    other = ring.route("k", exclude={owner})
+    assert other is not None and other != owner
+    assert ring.route("k", exclude={"a:1", "b:2"}) is None
+
+
+@pytest.mark.fleet
+def test_hash_ring_spreads_keys_across_all_nodes():
+    from deepdfa_tpu.serve import HashRing
+
+    ring = HashRing()
+    names = [f"b{i}:80" for i in range(4)]
+    for n in names:
+        ring.add(n)
+    counts = {n: 0 for n in names}
+    for i in range(2000):
+        counts[ring.route(f"key-{i}")] += 1
+    assert all(c > 0.1 * 2000 / 4 for c in counts.values()), counts
+
+
+@pytest.mark.fleet
+def test_warm_store_roundtrip_keys_and_stats(tmp_path):
+    from deepdfa_tpu.serve import WarmStore
+
+    ws = WarmStore(tmp_path / "store")
+    assert ws.get("nope") is None and ws.keys() == []
+    ws.put("k1", b"program-bytes", {"compile_seconds": 1.25})
+    e = ws.get("k1")
+    assert e.payload == b"program-bytes"
+    assert e.meta["compile_seconds"] == 1.25
+    assert ws.keys() == ["k1"]
+    assert ws.stats() == {"entries": 1, "bytes": len(b"program-bytes")}
+
+
+@pytest.mark.fleet
+def test_warm_store_payload_without_meta_is_absent(tmp_path):
+    """The commit protocol: meta.json is the marker. A payload that landed
+    without its meta (kill -9 mid-put) must read as a MISS, never as a
+    torn artifact."""
+    from deepdfa_tpu.serve import WarmStore
+
+    ws = WarmStore(tmp_path / "store")
+    (ws.root / "torn.stablehlo").write_bytes(b"half-written")
+    assert ws.get("torn") is None and ws.keys() == []
+    (ws.root / "bad.stablehlo").write_bytes(b"x")
+    (ws.root / "bad.json").write_text("{not json")
+    assert ws.get("bad") is None and ws.keys() == []
+
+
+@pytest.mark.fleet
+def test_bucket_artifact_key_covers_every_program_input():
+    """Everything that changes the lowered module must change the key —
+    a collision would hand a replica a program compiled for different
+    weights/vocab/shape."""
+    from deepdfa_tpu.serve import bucket_artifact_key
+
+    base = dict(vocab_hash="vh", model_rev="mr", precision="f32",
+                label_style="graph", feat_keys=("_ABS_DATAFLOW",),
+                max_graphs=5, max_nodes=128, max_edges=512)
+    k0 = bucket_artifact_key(**base)
+    assert k0 == bucket_artifact_key(**base)  # deterministic
+    for field, val in [("vocab_hash", "other"), ("model_rev", "other"),
+                       ("precision", "int8"), ("label_style", "node"),
+                       ("feat_keys", ("_ABS_DATAFLOW", "_API")),
+                       ("max_graphs", 9), ("max_nodes", 256),
+                       ("max_edges", 1024)]:
+        assert bucket_artifact_key(**{**base, field: val}) != k0, field
+
+
+# ---------------------------------------------------------------------------
+# fleet router over stub backends (pytest -m fleet — no engines)
+
+
+class _FakeBackend:
+    """A /healthz + /score stub standing in for a ScoreServer replica:
+    records every source it scores, health body is mutable per test."""
+
+    def __init__(self, name):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.name = name
+        self.scored = []
+        self.health = {"status": "ok", "draining": False, "warm": True,
+                       "replica_id": name}
+        backend = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                h = backend.health
+                self._send(503 if h.get("draining") else 200, h)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                backend.scored.append(payload.get("source"))
+                self._send(200, {"results": [], "backend": backend.name})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def addr(self):
+        return f"127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def fake_fleet():
+    backends = [_FakeBackend(f"r{i}") for i in range(3)]
+    from deepdfa_tpu.serve import FleetRouter
+
+    router = FleetRouter([b.addr for b in backends], port=0,
+                         probe_interval_s=60.0)
+    router.probe_once()
+    router.start(probe=False)
+    try:
+        yield router, backends
+    finally:
+        router.shutdown()
+        for b in backends:
+            b.stop()
+
+
+def _route_post(port, source):
+    status, data = _req(port, "POST", "/score",
+                        json.dumps({"source": source}))
+    return status, json.loads(data)
+
+
+@pytest.mark.fleet
+def test_router_shards_keys_stably_across_backends(fake_fleet):
+    """Same source → same backend on every request (the property the
+    sharded cache rides on), and the keyspace actually spreads."""
+    router, backends = fake_fleet
+    assert all(b.state == "ready" for b in router.backends.values())
+    sources = [f"int f{i}(int x) {{ return x + {i}; }}" for i in range(24)]
+    for s in sources:
+        assert _route_post(router.port, s)[0] == 200
+    counts_first = {b.name: len(b.scored) for b in backends}
+    assert sum(counts_first.values()) == 24
+    assert all(c > 0 for c in counts_first.values())  # every replica routed
+    for s in sources:  # replay: every key lands on the SAME shard
+        assert _route_post(router.port, s)[0] == 200
+    for b in backends:
+        assert b.scored[: len(b.scored) // 2] == b.scored[len(b.scored) // 2:]
+
+
+@pytest.mark.fleet
+def test_router_readiness_gates_cold_replicas(fake_fleet):
+    """warm:false in /healthz keeps a replica out of the ring (state
+    pending) until it reports warm — a compiling replica must not stall
+    its keyspace."""
+    router, backends = fake_fleet
+    backends[0].health["warm"] = False
+    router.probe_once()
+    assert router.backends[backends[0].addr].state == "pending"
+    assert backends[0].addr not in router.ring.nodes
+    for i in range(12):
+        assert _route_post(router.port, f"int g{i}() {{ return {i}; }}")[0] == 200
+    assert backends[0].scored == []  # took no traffic while cold
+    backends[0].health["warm"] = True
+    router.probe_once()
+    assert router.backends[backends[0].addr].state == "ready"
+
+
+@pytest.mark.fleet
+def test_router_drain_rebalances_keyspace(fake_fleet):
+    """A draining backend (503 + draining:true — its SIGTERM flag) leaves
+    the ring on the next probe; its keys reroute to survivors, the
+    survivors keep theirs."""
+    router, backends = fake_fleet
+    sources = [f"int h{i}(int x) {{ return x * {i}; }}" for i in range(18)]
+    for s in sources:
+        _route_post(router.port, s)
+    owner_before = {s: next(b.name for b in backends if s in b.scored)
+                    for s in sources}
+    drained = backends[1]
+    drained.health.update(status="draining", draining=True)
+    router.probe_once()
+    assert router.backends[drained.addr].state == "draining"
+    assert drained.addr not in router.ring.nodes
+    n_drained_before = len(drained.scored)
+    for s in sources:
+        assert _route_post(router.port, s)[0] == 200
+    assert len(drained.scored) == n_drained_before  # no new traffic
+    survivors = [b for b in backends if b is not drained]
+    for s in sources:
+        if owner_before[s] == drained.name:
+            # drained keys rerouted somewhere live
+            assert any(s in b.scored for b in survivors), s
+        else:
+            # survivor keys stayed put: scored twice by the SAME backend
+            b = next(x for x in survivors if x.name == owner_before[s])
+            assert b.scored.count(s) == 2, s
+
+
+@pytest.mark.fleet
+def test_router_fails_over_dead_backend_and_healthz_reports(fake_fleet):
+    """A backend dying mid-service: the forward fails at the socket, the
+    router marks it down and retries the next ring node — the request
+    still answers 200."""
+    router, backends = fake_fleet
+    dead = backends[2]
+    dead.stop()
+    for i in range(12):
+        status, body = _route_post(router.port,
+                                   f"int k{i}(int x) {{ return x - {i}; }}")
+        assert status == 200, body
+    assert router.backends[dead.addr].state == "down"
+    status, data = _req(router.port, "GET", "/healthz")
+    health = json.loads(data)
+    assert status == 200  # fleet still has ready backends
+    assert dead.addr not in health["ready_backends"]
+    assert health["backends"][dead.addr]["state"] == "down"
+    assert router.metrics.snapshot()["retries_total"] >= 1
+
+
+@pytest.mark.fleet
+def test_router_with_no_ready_backend_is_503(fake_fleet):
+    router, backends = fake_fleet
+    for b in backends:
+        b.health.update(status="draining", draining=True)
+    router.probe_once()
+    status, data = _req(router.port, "GET", "/healthz")
+    assert status == 503
+    status, body = _route_post(router.port, "int z() { return 0; }")
+    assert status == 503 and "no ready backend" in body["error"]
+
+
+@pytest.mark.fleet
+def test_router_metrics_render(fake_fleet):
+    router, backends = fake_fleet
+    _route_post(router.port, "int m() { return 1; }")
+    status, data = _req(router.port, "GET", "/metrics")
+    text = data.decode()
+    assert status == 200
+    for field in ("deepdfa_router_requests_total",
+                  "deepdfa_router_forwarded_total",
+                  "deepdfa_router_retries_total",
+                  "deepdfa_router_no_backend_total"):
+        assert field in text, field
+
+
+@pytest.mark.fleet
+def test_router_sharded_cache_hits_real_servers(demo):
+    """The cache-shard property end-to-end on REAL ScoreServers (stub
+    engines): replayed sources route back to the replica that cached
+    them, so per-shard hit counters climb and no shard duplicates
+    another's entries."""
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.serve import FleetRouter, ScoreServer
+
+    vocabs, sources = demo
+    servers = [ScoreServer(_StubEngine(vocabs, max_batch=4), vocabs,
+                           ServeConfig(port=0, max_wait_ms=2.0),
+                           replica_id=f"r{i}").start()
+               for i in range(2)]
+    for s in servers:
+        s.engine.warmup()  # readiness: the probe gates on warm
+    router = FleetRouter([f"127.0.0.1:{s.port}" for s in servers], port=0,
+                         probe_interval_s=60.0)
+    router.probe_once()
+    router.start(probe=False)
+    try:
+        assert sorted(router.ring.nodes) == sorted(
+            f"127.0.0.1:{s.port}" for s in servers)
+        for src in sources:  # cold: populate the shards
+            status, body = _route_post(router.port, src)
+            assert status == 200 and body["cached"] is False
+        for src in sources:  # hot: every replay must hit ITS shard
+            status, body = _route_post(router.port, src)
+            assert status == 200 and body["cached"] is True, body
+        hits = [s.cache.stats()["hits"] for s in servers]
+        entries = [s.cache.stats()["entries"] for s in servers]
+        assert sum(hits) == len(sources)  # all replays were shard hits
+        assert all(h > 0 for h in hits)   # both shards took keys
+        assert sum(entries) == len(sources)  # shards partition, not mirror
+    finally:
+        router.shutdown()
+        for s in servers:
+            s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet perf-gate plumbing that needs no devices
+
+
+@pytest.mark.fleet
+def test_healthz_reports_fleet_readiness_fields(server):
+    srv, _ = server
+    status, data = _req(srv.port, "GET", "/healthz")
+    health = json.loads(data)
+    assert status == 200
+    assert health["replica_id"] == f"127.0.0.1:{srv.port}"
+    assert health["warm"] is False and health["warm_buckets"] == []
+    report = srv.warmup()
+    assert (report["hits"], report["misses"]) == (0, 3)
+    health = json.loads(_req(srv.port, "GET", "/healthz")[1])
+    assert health["warm"] is True
+    assert health["warm_buckets"] == [126, 1022, 4094]
+    assert health["precision"] == "f32" and health["n_replicas"] == 1
+    assert "vocab_hash" in health and "model_rev" in health
+
+
+@pytest.mark.fleet
+def test_metrics_render_warmup_and_warm_store_counters(server):
+    srv, _ = server
+    srv.warmup()
+    text = _req(srv.port, "GET", "/metrics")[1].decode()
+    for field in ("deepdfa_serve_warm_store_hits_total 0",
+                  "deepdfa_serve_warm_store_misses_total 3",
+                  "deepdfa_serve_warm_store_compile_seconds_saved",
+                  'deepdfa_serve_warmup_compile_seconds{bucket="126"'):
+        assert field in text, field
+
+
+# ---------------------------------------------------------------------------
+# warm-store joins + mesh replication (live engines — serve marker only:
+# these compile, so they stay out of the fast `pytest -m fleet` gate)
+
+
+def test_warm_store_join_loads_ladder_with_zero_recompiles(live_model,
+                                                           tmp_path):
+    """The zero-cold-compile join, end to end in-process: replica A
+    compiles + exports every bucket; replica B (same weights → same
+    model_rev → same keys) warms entirely from the store, journals
+    compile-seconds-saved, and serves IDENTICAL scores."""
+    from deepdfa_tpu.resilience.journal import RunJournal
+    from deepdfa_tpu.serve import WarmStore
+
+    ws = WarmStore(tmp_path / "store")
+    ja = RunJournal(tmp_path / "a.json")
+    jb = RunJournal(tmp_path / "b.json")
+
+    eng_a = _live_engine(live_model)
+    rep_a = eng_a.warmup(warm_store=ws, journal=ja)
+    assert (rep_a["hits"], rep_a["misses"]) == (0, 3)
+    assert len(ws.keys()) == 3
+    assert ja.read()["event"] == "warmup"
+
+    gs = [_chain(10, eng_a.feat_keys), _chain(25, eng_a.feat_keys)]
+    want = eng_a.score(gs, eng_a.buckets[0])
+
+    eng_b = _live_engine(live_model)
+    assert eng_b.model_rev == eng_a.model_rev  # content-addressed weights
+    rep_b = eng_b.warmup(warm_store=ws, journal=jb)
+    assert (rep_b["hits"], rep_b["misses"]) == (3, 0)  # zero recompiles
+    rec = jb.read()
+    assert rec["event"] == "warmup"
+    assert rec["compile_seconds_saved"] > 0  # journaled, positive
+    got = eng_b.score(gs, eng_b.buckets[0])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_warm_store_keys_change_with_model_rev(live_model, tmp_path):
+    """Different weights → different model_rev → a joiner must MISS (and
+    recompile) rather than load another revision's program."""
+    import jax
+
+    from deepdfa_tpu.serve import WarmStore
+
+    ws = WarmStore(tmp_path / "store")
+    eng_a = _live_engine(live_model)
+    eng_a.warmup(warm_store=ws)
+
+    model, params, label_style, keys = live_model
+    bumped = jax.tree.map(lambda x: np.asarray(x) + 0.01, params)
+    from deepdfa_tpu.serve import ScoringEngine
+
+    eng_c = ScoringEngine.from_model(model, bumped, label_style,
+                                     feat_keys=keys, max_batch=4)
+    assert eng_c.model_rev != eng_a.model_rev
+    rep = eng_c.warmup(warm_store=ws)
+    assert rep["hits"] == 0 and rep["misses"] == 3
+    assert len(ws.keys()) == 6  # both revisions coexist, shared-nothing
+
+
+def test_concurrent_latency_submits_do_not_interleave_buffers(live_model):
+    """The engine-lock regression test: concurrent submit()/result()
+    callers in latency mode, each with DISTINCT inputs, must each get the
+    scores of their own batch — interleaved donated buffers would hand
+    one thread the other's probabilities (or poison a donated buffer
+    mid-upload)."""
+    import warnings
+
+    eng = _live_engine(live_model, latency_mode=True)
+    keys = eng.feat_keys
+    bucket = eng.buckets[0]
+    inputs = [[_chain(5 + i, keys)] for i in range(6)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # donation-unusable compile noise
+        want = []
+        eng.latency_mode = False
+        for gs in inputs:
+            want.append(eng.score(gs, bucket))
+        eng.latency_mode = True
+
+        results = {}
+        errors = []
+        barrier = threading.Barrier(len(inputs))
+
+        def worker(idx):
+            try:
+                barrier.wait(timeout=30)
+                for _ in range(8):
+                    got = eng.submit(inputs[idx], bucket).result()
+                    np.testing.assert_allclose(got, want[idx], atol=1e-6)
+                results[idx] = got
+            except Exception as exc:  # noqa: BLE001
+                errors.append((idx, exc))
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(len(inputs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    assert not errors, errors
+    assert len(results) == len(inputs)
+
+
+def test_mesh_replicated_engine_matches_single_replica(live_model):
+    """mesh= replication: score_groups stacks one padded batch per dp
+    device, ONE dispatch scores them all, and every group's probabilities
+    match the single-replica engine bit-for-bit (pure replication — no
+    collectives, no math changes)."""
+    from deepdfa_tpu.parallel.mesh import local_mesh
+    from deepdfa_tpu.serve import ScoringEngine
+
+    model, params, label_style, keys = live_model
+    single = _live_engine(live_model)
+    mesh = local_mesh(2)
+    eng = ScoringEngine.from_model(model, params, label_style,
+                                   feat_keys=keys, max_batch=4, mesh=mesh)
+    assert eng.n_replicas == 2
+    assert eng.model_rev == single.model_rev
+    rep = eng.warmup()
+    assert rep["buckets"] == 3
+
+    bucket = eng.buckets[0]
+    groups = [[_chain(10, keys)], [_chain(25, keys), _chain(7, keys)]]
+    eng.n_dispatches = 0
+    got = eng.score_groups(groups, bucket)
+    assert eng.n_dispatches == 1  # two groups, one stacked dispatch
+    for g, w in zip(got, (single.score(x, single.buckets[0])
+                          for x in groups)):
+        np.testing.assert_allclose(g, w, atol=1e-5)
+    # plain score() routes through the stack too (batcher compatibility)
+    np.testing.assert_allclose(
+        eng.score(groups[1], bucket),
+        single.score(groups[1], single.buckets[0]), atol=1e-5)
+    with pytest.raises(ValueError, match="groups > 2 replicas"):
+        eng.score_groups([[], [], []], bucket)
+
+
+def test_batcher_chunks_window_across_replicas():
+    """With a stacked (mesh) engine the batcher must hand up to
+    n_replicas packed batches to ONE score_groups dispatch instead of
+    n sequential score() calls."""
+    from deepdfa_tpu.serve import MicroBatcher, ScoringEngine, serve_buckets
+
+    calls = []
+
+    def stacked_fn(stacked):
+        n_graphs = np.asarray(stacked.graph_mask).sum(axis=1)
+        calls.append([int(x) for x in n_graphs])
+        return np.full((stacked.graph_mask.shape[0],
+                        stacked.graph_mask.shape[1]), 0.125, np.float32)
+
+    eng = ScoringEngine(None, serve_buckets(2), feat_keys=("_ABS_DATAFLOW",),
+                        stacked_fn=stacked_fn, n_replicas=2)
+    b = MicroBatcher(eng, max_batch=8, max_wait_ms=100.0)
+    futs = [b.submit(_chain(5)) for _ in range(5)]  # packs to 3 batches of <=2
+    b.start()
+    assert [f.result(timeout=10) for f in futs] == [0.125] * 5
+    # 3 packed batches / 2 replicas -> 2 stacked dispatches, none wider
+    # than the replica count
+    assert eng.n_dispatches == 2
+    assert len(calls) == 2 and all(len(c) == 2 for c in calls)
+    b.stop()
