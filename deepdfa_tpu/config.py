@@ -264,6 +264,45 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Observability knobs (``deepdfa_tpu/obs``; CLI: ``--set
+    serve.obs.*``): request/step tracing, slow-trace exemplar journaling,
+    the score-drift sentinel, and the optional trainer telemetry port."""
+
+    trace: bool = True  # record spans on the serve + train paths
+    trace_buffer: int = 4096  # bounded in-memory span buffer per process
+    # root spans slower than this journal their whole trace as an
+    # event=trace exemplar (None/<=0 disables)
+    slow_trace_ms: float = 1000.0
+    trace_dir: str | None = None  # exemplar directory; None = no journaling
+    max_exemplars: int = 16  # exemplar files kept per process (mtime-evicted)
+    # score-drift sentinel (ROADMAP direction 5(b)): per-model_rev PSI of
+    # the sliding score window vs the rev's frozen first window
+    drift_window: int = 512
+    drift_bins: int = 10
+    drift_threshold: float = 0.2  # PSI above this flips deepdfa_serve_score_drift_alert
+    drift_min_samples: int = 64  # both windows need this many scores to judge
+    # trainer telemetry HTTP endpoint: -1 disables, 0 binds an ephemeral port
+    train_port: int = -1
+
+    def __post_init__(self):
+        if self.trace_buffer < 1:
+            raise ValueError("trace_buffer must be >= 1")
+        if self.max_exemplars < 0:
+            raise ValueError("max_exemplars must be >= 0")
+        if self.drift_window < 2:
+            raise ValueError("drift_window must be >= 2")
+        if self.drift_bins < 2:
+            raise ValueError("drift_bins must be >= 2")
+        if self.drift_threshold <= 0:
+            raise ValueError("drift_threshold must be > 0")
+        if self.drift_min_samples < 1:
+            raise ValueError("drift_min_samples must be >= 1")
+        if self.train_port < -1:
+            raise ValueError("train_port must be >= -1 (-1 disables)")
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online scoring service knobs (``deepdfa_tpu/serve``; CLI:
     ``--set serve.*``): the micro-batching window, admission control, the
@@ -302,6 +341,8 @@ class ServeConfig:
     # per device over a dp mesh; the batcher packs across replicas). The
     # in-process alternative to the router fleet for single-host scale-up.
     mesh_replicas: int = 0
+    # observability plane (deepdfa_tpu/obs): tracing, exemplars, drift
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -395,6 +436,7 @@ _NESTED: dict[tuple[str, str], type] = {
     ("ExperimentConfig", "checkpoint"): CheckpointConfig,
     ("ExperimentConfig", "resilience"): ResilienceConfig,
     ("ExperimentConfig", "serve"): ServeConfig,
+    ("ServeConfig", "obs"): ObsConfig,
 }
 
 

@@ -1,0 +1,35 @@
+"""One telemetry plane: request/step tracing (W3C ``traceparent``,
+Chrome trace-event export), the shared Prometheus-exposition metrics
+registry, training-step timelines, and the score-drift sentinel."""
+
+from deepdfa_tpu.obs.drift import ScoreDriftSentinel, psi
+from deepdfa_tpu.obs.registry import Family, MetricsRegistry, escape_label_value
+from deepdfa_tpu.obs.telemetry import TelemetryServer, TrainTelemetry
+from deepdfa_tpu.obs.tracing import (
+    Span,
+    SpanContext,
+    Tracer,
+    chrome_trace,
+    load_trace_records,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
+
+__all__ = [
+    "Family",
+    "MetricsRegistry",
+    "ScoreDriftSentinel",
+    "Span",
+    "SpanContext",
+    "TelemetryServer",
+    "Tracer",
+    "TrainTelemetry",
+    "chrome_trace",
+    "escape_label_value",
+    "load_trace_records",
+    "new_span_id",
+    "new_trace_id",
+    "parse_traceparent",
+    "psi",
+]

@@ -48,6 +48,10 @@ Known points (grep for ``faults.fire(`` / ``crash_if`` / ``raise_if``):
                                          aware sleep the HangWatchdog must
                                          convert into a bounded, journaled
                                          timeout abort (train/loop)
+``obs.trace_drop``                       lose one span at export — counted
+                                         in ``dropped_total``; the request
+                                         it annotates must still succeed
+                                         (obs/tracing.py)
 =======================================  ====================================
 """
 
@@ -89,6 +93,7 @@ KNOWN_POINTS = (
     "preempt.sigterm",
     "mesh.device_lost",
     "step.hang",
+    "obs.trace_drop",
 )
 
 

@@ -45,17 +45,23 @@ class _Pending:
     graph: object
     bucket: ServeBucket
     future: Future = field(default_factory=Future)
+    # tracing handoff: the submitting request's span context and enqueue
+    # wall time, so the dispatcher thread can close the queue.wait span
+    # against the right trace
+    ctx: object = None
+    enqueued_s: float = 0.0
 
 
 class MicroBatcher:
     def __init__(self, engine: ScoringEngine, max_batch: int = 16,
                  max_wait_ms: float = 5.0, max_queue: int = 128,
-                 metrics=None):
+                 metrics=None, tracer=None):
         self.engine = engine
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self.max_queue = int(max_queue)
         self.metrics = metrics
+        self.tracer = tracer
         self._pending: list[_Pending] = []
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -78,7 +84,10 @@ class MicroBatcher:
         :class:`~.engine.OversizeGraphError` (no bucket), or RuntimeError
         once draining."""
         bucket = self.engine.assign_bucket(graph)  # raises OversizeGraphError
-        item = _Pending(graph=graph, bucket=bucket)
+        item = _Pending(graph=graph, bucket=bucket,
+                        ctx=(self.tracer.current()
+                             if self.tracer is not None else None),
+                        enqueued_s=time.time())
         with self._wake:
             if self._stopping:
                 raise RuntimeError("batcher is draining — not accepting work")
@@ -133,6 +142,7 @@ class MicroBatcher:
             self._dispatch_window(window)
 
     def _dispatch_window(self, window: list[_Pending]) -> None:
+        assembled_s = time.time()
         by_bucket: dict[ServeBucket, list[_Pending]] = {}
         for item in window:
             by_bucket.setdefault(item.bucket, []).append(item)
@@ -140,8 +150,14 @@ class MicroBatcher:
         # mesh-replicated engines (one batch per device); single-replica
         # engines degrade to the per-batch loop unchanged
         chunk = max(1, self.engine.n_replicas)
-        for bucket, items in by_bucket.items():
-            packed = self._pack(bucket, items)
+        plans = [(bucket, self._pack(bucket, items))
+                 for bucket, items in by_bucket.items()]
+        if self.tracer is not None and window:
+            parent = next((i.ctx for i in window if i.ctx is not None), None)
+            self.tracer.record("batch.assembly", assembled_s, parent=parent,
+                               n_graphs=len(window),
+                               n_buckets=len(by_bucket))
+        for bucket, packed in plans:
             for i in range(0, len(packed), chunk):
                 self._dispatch(bucket, packed[i:i + chunk])
 
@@ -167,16 +183,45 @@ class MicroBatcher:
 
     def _dispatch(self, bucket: ServeBucket,
                   batches: list[list[_Pending]]) -> None:
+        tracer, now = self.tracer, time.time()
+        n_real = sum(len(b) for b in batches)
+        first_ctx = None
+        for b in batches:
+            for item in b:
+                if first_ctx is None and item.ctx is not None:
+                    first_ctx = item.ctx
+                if item.enqueued_s:
+                    if self.metrics is not None:
+                        self.metrics.queue_wait.observe(
+                            (now - item.enqueued_s) * 1e3)
+                    if tracer is not None:
+                        tracer.record("queue.wait", item.enqueued_s, now,
+                                      parent=item.ctx,
+                                      bucket=bucket.capacity)
+        t0 = time.time()
         try:
             results = self.engine.score_groups(
                 [[i.graph for i in b] for b in batches], bucket)
         except Exception as exc:  # noqa: BLE001 — per-chunk failure domain
+            if tracer is not None:
+                tracer.record("engine.dispatch", t0, parent=first_ctx,
+                              n_graphs=n_real, error=type(exc).__name__)
             for b in batches:
                 for item in b:
                     item.future.set_exception(exc)
             return
+        t1 = time.time()
+        if self.metrics is not None:
+            self.metrics.dispatch.observe((t1 - t0) * 1e3)
+        if tracer is not None:
+            tracer.record("engine.dispatch", t0, t1, parent=first_ctx,
+                          n_graphs=n_real, n_batches=len(batches),
+                          bucket=bucket.capacity)
         for b, probs in zip(batches, results):
             if self.metrics is not None:
                 self.metrics.observe_batch(len(b), bucket.capacity)
             for item, p in zip(b, probs):
                 item.future.set_result(float(p))
+        if tracer is not None:
+            tracer.record("host.reduce", t1, parent=first_ctx,
+                          n_graphs=n_real)

@@ -13,6 +13,8 @@ from __future__ import annotations
 import threading
 from collections import deque
 
+from deepdfa_tpu.obs.registry import MetricsRegistry
+
 __all__ = ["LatencyReservoir", "ServeMetrics"]
 
 
@@ -74,7 +76,17 @@ class ServeMetrics:
         self.queue_depth = 0
         self.inflight = 0
         self.latency = LatencyReservoir(latency_window)
+        # stage-level reservoirs fed by the tracing instrumentation: time a
+        # graph sat in the micro-batch queue, and time one engine dispatch
+        # took — the split that locates a slow /score (bench_serving
+        # records both in its notes block)
+        self.queue_wait = LatencyReservoir(latency_window)
+        self.dispatch = LatencyReservoir(latency_window)
         self.warmup: dict | None = None  # last engine warmup report
+        # attachment points set by the server: the request tracer and the
+        # score-drift sentinel both render through /metrics when present
+        self.tracer = None
+        self.drift = None
 
     def set_warmup(self, report: dict) -> None:
         """Publish an engine warmup report (per-bucket compile seconds +
@@ -129,61 +141,108 @@ class ServeMetrics:
             if snap["batches_total"] else None)
         snap["latency_p50_ms"] = self.latency.quantile(0.50)
         snap["latency_p99_ms"] = self.latency.quantile(0.99)
+        snap["queue_wait_p50_ms"] = self.queue_wait.quantile(0.50)
+        snap["queue_wait_p99_ms"] = self.queue_wait.quantile(0.99)
+        snap["dispatch_p50_ms"] = self.dispatch.quantile(0.50)
+        snap["dispatch_p99_ms"] = self.dispatch.quantile(0.99)
         return snap
 
     def render(self, cache_stats: dict | None = None) -> str:
-        """Prometheus text format (`# TYPE` lines + samples)."""
+        """Prometheus text format via the shared registry: one ``# HELP``
+        + one ``# TYPE`` per family (the seed's hand-rolled formatter
+        repeated ``# TYPE`` before every labeled sample)."""
         snap = self.snapshot()
-        lines = []
-
-        def emit(name, kind, value, labels=""):
-            if value is None:
-                return
-            lines.append(f"# TYPE deepdfa_serve_{name} {kind}")
-            lines.append(f"deepdfa_serve_{name}{labels} {value}")
-
-        emit("requests_total", "counter", snap["requests_total"])
-        for code in sorted(snap["responses_total"]):
-            lines.append("# TYPE deepdfa_serve_responses_total counter")
-            lines.append(
-                f'deepdfa_serve_responses_total{{code="{code}"}} '
-                f'{snap["responses_total"][code]}')
-        emit("errors_total", "counter", snap["errors_total"])
-        emit("dropped_total", "counter", snap["dropped_total"])
-        emit("batches_total", "counter", snap["batches_total"])
-        emit("batch_graphs_total", "counter", snap["batch_graphs_total"])
-        emit("batch_occupancy_mean", "gauge", snap["mean_batch_occupancy"])
-        emit("queue_depth", "gauge", snap["queue_depth"])
-        emit("inflight", "gauge", snap["inflight"])
-        for q in (0.50, 0.99):
-            v = self.latency.quantile(q)
-            if v is not None:
-                lines.append("# TYPE deepdfa_serve_latency_ms gauge")
-                lines.append(
-                    f'deepdfa_serve_latency_ms{{quantile="{q}"}} {v}')
+        reg = MetricsRegistry("deepdfa_serve_")
+        reg.counter("requests_total",
+                    "Every /score request received").set(
+            snap["requests_total"])
+        responses = reg.counter("responses_total",
+                                "Responses by HTTP status", labels=("code",))
+        for code, n in snap["responses_total"].items():
+            responses.set(n, code=code)
+        reg.counter("errors_total", "4xx/5xx responses").set(
+            snap["errors_total"])
+        reg.counter("dropped_total",
+                    "Requests rejected by admission control").set(
+            snap["dropped_total"])
+        reg.counter("batches_total", "Dispatched micro-batches").set(
+            snap["batches_total"])
+        reg.counter("batch_graphs_total",
+                    "Real graphs in dispatched batches").set(
+            snap["batch_graphs_total"])
+        reg.gauge("batch_occupancy_mean",
+                  "Mean real-graphs / bucket-capacity per batch").set(
+            snap["mean_batch_occupancy"])
+        reg.gauge("queue_depth",
+                  "Requests waiting in the micro-batch queue").set(
+            snap["queue_depth"])
+        reg.gauge("inflight", "/score requests currently in flight").set(
+            snap["inflight"])
+        for family, help_, reservoir in (
+                ("latency_ms", "End-to-end /score latency", self.latency),
+                ("queue_wait_ms", "Time a graph waited in the micro-batch "
+                                  "queue", self.queue_wait),
+                ("dispatch_ms", "Engine dispatch wall time per batch",
+                 self.dispatch)):
+            fam = reg.gauge(family, f"{help_} (windowed quantiles)",
+                            labels=("quantile",))
+            for q in (0.50, 0.99):
+                fam.set(reservoir.quantile(q), quantile=q)
         warm = snap.get("warmup")
         if warm:
-            emit("warm_store_hits_total", "counter", warm.get("hits"))
-            emit("warm_store_misses_total", "counter", warm.get("misses"))
-            emit("warm_store_compile_seconds_saved", "gauge",
-                 warm.get("compile_seconds_saved"))
-            for bucket, row in sorted((warm.get("per_bucket") or {}).items()):
-                secs = row.get("compile_seconds")
-                if secs is None:
-                    continue
-                lines.append(
-                    "# TYPE deepdfa_serve_warmup_compile_seconds gauge")
-                lines.append(
-                    f'deepdfa_serve_warmup_compile_seconds'
-                    f'{{bucket="{bucket}",source="{row.get("source")}"}} '
-                    f'{secs}')
+            reg.counter("warm_store_hits_total",
+                        "Warm-store program hits at warmup").set(
+                warm.get("hits"))
+            reg.counter("warm_store_misses_total",
+                        "Warm-store misses at warmup").set(warm.get("misses"))
+            reg.gauge("warm_store_compile_seconds_saved",
+                      "Compile seconds skipped via warm-store hits").set(
+                warm.get("compile_seconds_saved"))
+            compile_s = reg.gauge("warmup_compile_seconds",
+                                  "Per-bucket warmup compile seconds",
+                                  labels=("bucket", "source"))
+            for bucket, row in (warm.get("per_bucket") or {}).items():
+                compile_s.set(row.get("compile_seconds"), bucket=bucket,
+                              source=row.get("source"))
         if cache_stats:
-            emit("cache_hits_total", "counter", cache_stats.get("hits"))
-            emit("cache_encode_hits_total", "counter",
-                 cache_stats.get("encode_hits"))
-            emit("cache_misses_total", "counter", cache_stats.get("misses"))
-            emit("cache_evictions_total", "counter",
-                 cache_stats.get("evictions"))
-            emit("cache_entries", "gauge", cache_stats.get("entries"))
-            emit("cache_hit_rate", "gauge", cache_stats.get("hit_rate"))
-        return "\n".join(lines) + "\n"
+            reg.counter("cache_hits_total", "Scan-cache result hits").set(
+                cache_stats.get("hits"))
+            reg.counter("cache_encode_hits_total",
+                        "Scan-cache encoded-graph hits").set(
+                cache_stats.get("encode_hits"))
+            reg.counter("cache_misses_total", "Scan-cache misses").set(
+                cache_stats.get("misses"))
+            reg.counter("cache_evictions_total", "Scan-cache evictions").set(
+                cache_stats.get("evictions"))
+            reg.gauge("cache_entries", "Scan-cache entries").set(
+                cache_stats.get("entries"))
+            reg.gauge("cache_hit_rate", "Scan-cache hit rate").set(
+                cache_stats.get("hit_rate"))
+        tracer = self.tracer
+        if tracer is not None:
+            reg.counter("trace_spans_total",
+                        "Spans recorded by this replica's tracer").set(
+                tracer.recorded_total)
+            reg.counter("trace_spans_dropped_total",
+                        "Spans lost at export (never fatal)").set(
+                tracer.dropped_total)
+        drift = self.drift
+        if drift is not None:
+            psi_g = reg.gauge("score_drift",
+                              "PSI of the sliding score window vs the "
+                              "model rev's reference window",
+                              labels=("model_rev",))
+            alert_g = reg.gauge("score_drift_alert",
+                                "1 when score_drift crossed the configured "
+                                "threshold", labels=("model_rev",))
+            hist = reg.histogram(
+                "score", "Current-window score distribution",
+                buckets=[round((i + 1) / drift.bins, 6)
+                         for i in range(drift.bins)],
+                labels=("model_rev",))
+            for rev, row in drift.snapshot().items():
+                psi_g.set(row["psi"], model_rev=rev)
+                alert_g.set(int(row["alert"]), model_rev=rev)
+                hist.set_histogram(row["current_counts"], row["current_sum"],
+                                   row["current_n"], model_rev=rev)
+        return reg.render()

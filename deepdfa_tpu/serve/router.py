@@ -44,9 +44,12 @@ import logging
 import signal
 import threading
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from deepdfa_tpu.config import ObsConfig
+from deepdfa_tpu.obs import MetricsRegistry, Tracer, parse_traceparent
 from deepdfa_tpu.pipeline import source_key
 
 from .metrics import LatencyReservoir
@@ -152,6 +155,7 @@ class RouterMetrics:
         self.no_backend_total = 0
         self.errors_total = 0
         self.latency = LatencyReservoir(latency_window)
+        self.tracer = None  # attachment point set by the router
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -175,22 +179,38 @@ class RouterMetrics:
             }
 
     def render(self) -> str:
+        """Prometheus text via the shared registry (one ``# HELP`` +
+        ``# TYPE`` per family, same renderer as serve + train)."""
         snap = self.snapshot()
-        lines = ["# TYPE deepdfa_router_requests_total counter",
-                 f"deepdfa_router_requests_total {snap['requests_total']}"]
-        for name in sorted(snap["forwarded_total"]):
-            lines.append("# TYPE deepdfa_router_forwarded_total counter")
-            lines.append(f'deepdfa_router_forwarded_total{{backend="{name}"}} '
-                         f'{snap["forwarded_total"][name]}')
-        for key in ("retries_total", "no_backend_total", "errors_total"):
-            lines.append(f"# TYPE deepdfa_router_{key} counter")
-            lines.append(f"deepdfa_router_{key} {snap[key]}")
+        reg = MetricsRegistry("deepdfa_router_")
+        reg.counter("requests_total", "Every /score the router received").set(
+            snap["requests_total"])
+        fwd = reg.counter("forwarded_total", "Forwards by backend",
+                          labels=("backend",))
+        for name, n in snap["forwarded_total"].items():
+            fwd.set(n, backend=name)
+        reg.counter("retries_total",
+                    "Per-request failovers past a dead backend").set(
+            snap["retries_total"])
+        reg.counter("no_backend_total",
+                    "Requests with no ready backend").set(
+            snap["no_backend_total"])
+        reg.counter("errors_total", "4xx/5xx responses").set(
+            snap["errors_total"])
+        lat = reg.gauge("latency_ms",
+                        "Router round-trip latency (windowed quantiles)",
+                        labels=("quantile",))
         for q in (0.50, 0.99):
-            v = self.latency.quantile(q)
-            if v is not None:
-                lines.append("# TYPE deepdfa_router_latency_ms gauge")
-                lines.append(f'deepdfa_router_latency_ms{{quantile="{q}"}} {v}')
-        return "\n".join(lines) + "\n"
+            lat.set(self.latency.quantile(q), quantile=q)
+        tracer = self.tracer
+        if tracer is not None:
+            reg.counter("trace_spans_total",
+                        "Spans recorded by the router tracer").set(
+                tracer.recorded_total)
+            reg.counter("trace_spans_dropped_total",
+                        "Spans lost at export (never fatal)").set(
+                tracer.dropped_total)
+        return reg.render()
 
 
 class FleetRouter:
@@ -205,7 +225,8 @@ class FleetRouter:
     def __init__(self, backends, host: str = "127.0.0.1", port: int = 0,
                  vnodes: int = DEFAULT_VNODES,
                  probe_interval_s: float = 2.0,
-                 metrics: RouterMetrics | None = None):
+                 metrics: RouterMetrics | None = None,
+                 obs: ObsConfig | None = None):
         self.backends: dict[str, Backend] = {}
         for spec in backends:
             b = spec if isinstance(spec, Backend) else Backend.parse(str(spec))
@@ -214,6 +235,15 @@ class FleetRouter:
             raise ValueError("router needs at least one backend")
         self.ring = HashRing(vnodes)
         self.metrics = metrics or RouterMetrics()
+        obs = obs or ObsConfig()
+        self.tracer = Tracer(
+            proc="router", max_spans=obs.trace_buffer,
+            slow_ms=(obs.slow_trace_ms
+                     if obs.slow_trace_ms and obs.slow_trace_ms > 0
+                     else None),
+            exemplar_dir=obs.trace_dir, max_exemplars=obs.max_exemplars,
+        ) if obs.trace else None
+        self.metrics.tracer = self.tracer
         self.probe_interval_s = float(probe_interval_s)
         self._draining = threading.Event()
         self._stop_requested = threading.Event()
@@ -313,6 +343,11 @@ class FleetRouter:
 
     # -- request path -------------------------------------------------------
 
+    def _span(self, name: str, parent=None, root: bool = False, **attrs):
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(name, parent=parent, root=root, **attrs)
+
     def handle_score(self, raw: bytes) -> tuple[int, dict, dict]:
         """Route + forward one ``/score`` body. Returns
         ``(status, body, extra_headers)``."""
@@ -325,7 +360,10 @@ class FleetRouter:
         source = payload.get("source") if isinstance(payload, dict) else None
         if not isinstance(source, str) or not source.strip():
             return 400, {"error": "body must be JSON with a 'source' string"}, {}
-        key = source_key(source)
+        with self._span("router.route") as sp:
+            key = source_key(source)
+            if sp is not None:
+                sp.attrs["key"] = key[:16]
 
         tried: set[str] = set()
         max_hops = max(1, len(self.ring))
@@ -335,7 +373,14 @@ class FleetRouter:
                 break
             b = self.backends[name]
             try:
-                status, body = self._forward(b, raw)
+                # the forward span's context rides the hop as the
+                # traceparent header: the backend's server.request span
+                # parents itself under it, one trace across both procs
+                with self._span("router.forward", backend=name) as sp:
+                    status, body = self._forward(
+                        b, raw, ctx=None if sp is None else sp.ctx)
+                    if sp is not None:
+                        sp.attrs["code"] = status
             except OSError as exc:
                 tried.add(name)
                 b.failures += 1
@@ -351,12 +396,15 @@ class FleetRouter:
         self.metrics.inc("no_backend_total")
         return 503, {"error": "no ready backend for this key"}, {}
 
-    def _forward(self, b: Backend, raw: bytes) -> tuple[int, dict]:
+    def _forward(self, b: Backend, raw: bytes,
+                 ctx=None) -> tuple[int, dict]:
+        headers = {"Content-Type": "application/json"}
+        if ctx is not None:
+            headers["traceparent"] = ctx.traceparent()
         conn = http.client.HTTPConnection(b.host, b.port,
                                           timeout=FORWARD_TIMEOUT_S)
         try:
-            conn.request("POST", "/score", body=raw,
-                         headers={"Content-Type": "application/json"})
+            conn.request("POST", "/score", body=raw, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
         finally:
@@ -421,7 +469,13 @@ def _make_handler(router: FleetRouter):
             try:
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length)
-                code, body, extra = router.handle_score(raw)
+                parent = (parse_traceparent(self.headers.get("traceparent"))
+                          if router.tracer is not None else None)
+                with router._span("router.request", parent=parent,
+                                  root=True) as sp:
+                    code, body, extra = router.handle_score(raw)
+                    if sp is not None:
+                        sp.attrs["code"] = code
             except Exception as exc:  # noqa: BLE001 — request dies, router not
                 code, body, extra = 500, {
                     "error": f"{type(exc).__name__}: {exc}"}, {}

@@ -34,10 +34,12 @@ import signal
 import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
+from contextlib import nullcontext
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
 from deepdfa_tpu.config import ExperimentConfig, ServeConfig
+from deepdfa_tpu.obs import ScoreDriftSentinel, Tracer, parse_traceparent
 from deepdfa_tpu.pipeline import encode_source, load_vocabs, source_key
 from deepdfa_tpu.resilience import faults
 
@@ -70,10 +72,24 @@ class ScoreServer:
         self.metrics = metrics or ServeMetrics(self.cfg.latency_window)
         self.cache = cache if cache is not None else ScanCache(
             self.cfg.cache_entries)
+        obs = self.cfg.obs
+        self.tracer = Tracer(
+            proc="serve", max_spans=obs.trace_buffer,
+            slow_ms=(obs.slow_trace_ms
+                     if obs.slow_trace_ms and obs.slow_trace_ms > 0
+                     else None),
+            exemplar_dir=obs.trace_dir, max_exemplars=obs.max_exemplars,
+        ) if obs.trace else None
+        self.drift = ScoreDriftSentinel(
+            window=obs.drift_window, bins=obs.drift_bins,
+            threshold=obs.drift_threshold,
+            min_samples=obs.drift_min_samples)
+        self.metrics.tracer = self.tracer
+        self.metrics.drift = self.drift
         self.batcher = MicroBatcher(
             engine, max_batch=self.cfg.max_batch,
             max_wait_ms=self.cfg.max_wait_ms, max_queue=self.cfg.max_queue,
-            metrics=self.metrics).start()
+            metrics=self.metrics, tracer=self.tracer).start()
         self._draining = threading.Event()
         self._stop_requested = threading.Event()
         self._stopped = threading.Event()
@@ -106,6 +122,8 @@ class ScoreServer:
     def start(self) -> "ScoreServer":
         if self.replica_id is None:
             self.replica_id = f"{self.cfg.host}:{self.port}"
+        if self.tracer is not None:
+            self.tracer.proc = f"serve:{self.replica_id}"
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="serve-http", daemon=True)
         self._serve_thread.start()
@@ -152,6 +170,13 @@ class ScoreServer:
 
     # -- request handling ---------------------------------------------------
 
+    def _span(self, name: str, parent=None, root: bool = False, **attrs):
+        """Tracer span when tracing is on, else a no-op context (yields
+        None — callers must guard attribute writes)."""
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(name, parent=parent, root=root, **attrs)
+
     def handle_score(self, payload: dict) -> tuple[int, dict]:
         source = payload.get("source") if isinstance(payload, dict) else None
         if not isinstance(source, str) or not source.strip():
@@ -164,7 +189,11 @@ class ScoreServer:
                                   "serve.drop_request)"}
 
         key = source_key(source)
-        entry = self.cache.lookup(key)
+        with self._span("cache.lookup") as sp:
+            entry = self.cache.lookup(key)
+            if sp is not None:
+                sp.attrs["result_hit"] = bool(
+                    entry is not None and entry.results is not None)
         if entry is not None and entry.results is not None:
             return 200, {"results": entry.results, "cached": True}
 
@@ -208,6 +237,8 @@ class ScoreServer:
             except Exception as exc:  # noqa: BLE001 — engine fault = 500
                 return 500, {"error": f"{type(exc).__name__}: {exc}"}
             row["vulnerable_probability"] = round(prob, 6)
+            self.drift.observe(
+                prob, getattr(self.engine, "model_rev", None) or "unknown")
 
         self.cache.store(key, results=rows)
         return 200, {"results": rows, "cached": False}
@@ -270,7 +301,17 @@ def _make_handler(server: ScoreServer):
                 except (ValueError, json.JSONDecodeError):
                     code, body = 400, {"error": "body is not valid JSON"}
                 else:
-                    code, body = server.handle_score(payload)
+                    # the backend half of the trace: the router's
+                    # traceparent (when forwarded) parents this root span,
+                    # so one trace_id covers both processes
+                    parent = (parse_traceparent(
+                        self.headers.get("traceparent"))
+                        if server.tracer is not None else None)
+                    with server._span("server.request", parent=parent,
+                                      root=True) as sp:
+                        code, body = server.handle_score(payload)
+                        if sp is not None:
+                            sp.attrs["code"] = code
             except Exception as exc:  # noqa: BLE001 — request dies, server not
                 code, body = 500, {"error": f"{type(exc).__name__}: {exc}"}
             finally:

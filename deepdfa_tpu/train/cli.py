@@ -34,6 +34,7 @@ import argparse
 import json
 import logging
 import sys
+import time
 from pathlib import Path
 from typing import Sequence
 
@@ -383,6 +384,26 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
     watchdog = (
         HangWatchdog(res.step_deadline_s) if res.step_deadline_s > 0 else None
     )
+    # training telemetry (obs.TrainTelemetry): per-step timelines into the
+    # per-epoch journal, step spans into <run>/traces/ (exported by
+    # `deepdfa-tpu trace export`), and an optional scrape endpoint
+    obs = cfg.serve.obs
+    telemetry = None
+    telemetry_server = None
+    if obs.trace:
+        from deepdfa_tpu.obs import TelemetryServer, Tracer, TrainTelemetry
+
+        telemetry = TrainTelemetry(tracer=Tracer(
+            proc="train", max_spans=obs.trace_buffer,
+            slow_ms=0.0,  # journal every epoch root, capped by max_exemplars
+            exemplar_dir=(Path(obs.trace_dir) if obs.trace_dir
+                          else run_dir / "traces"),
+            max_exemplars=obs.max_exemplars))
+        if obs.train_port >= 0:
+            telemetry_server = TelemetryServer(
+                telemetry, port=obs.train_port).start()
+            logger.info("trainer telemetry on :%d (/metrics, /healthz)",
+                        telemetry_server.port)
 
     def _aux(s: TrainState) -> dict:
         # the trainer state beyond params — what bit-identical resume needs
@@ -475,6 +496,8 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
             # retry of that epoch restores the same emergency checkpoint,
             # so the offset stays valid
             skip = pre_skip if epoch == start_epoch else 0
+            if telemetry is not None:
+                telemetry.observe_epoch(epoch)
             try:
                 state, train_m, train_loss = trainer.train_epoch(
                     state,
@@ -483,6 +506,7 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
                     preemption=preemption,
                     skip_steps=skip,
                     watchdog=watchdog,
+                    telemetry=telemetry,
                 )
             except Preempted as p:
                 # deadline-bounded emergency checkpoint through the ordinary
@@ -564,6 +588,7 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
                 for k, v in {"train_loss": train_loss, "val_loss": val_loss,
                              **train_m, **val_m}.items():
                     tb.add_scalar(k, v, epoch)
+            t_ckpt = time.time()
             ckpts.save(
                 int(state.step), {"params": state.params},
                 metrics={"val_loss": val_loss, "val_F1Score": val_m["val_F1Score"]},
@@ -571,6 +596,9 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
                 aux=_aux(state),
                 mesh=topology,
             )
+            if telemetry is not None:
+                telemetry.tracer.record("ckpt.commit", t_ckpt,
+                                        step=int(state.step), epoch=epoch)
             journal.write(
                 epoch=epoch,
                 global_step=int(state.step),
@@ -587,6 +615,8 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
                 mesh=topology,
                 resharded=resharded,
                 **(sentinel.stats() if sentinel is not None else {}),
+                **({"telemetry": telemetry.epoch_stats()}
+                   if telemetry is not None else {}),
             )
             with open(tuning_file, "a") as f:
                 f.write(json.dumps({"epoch": epoch, "val_F1Score": val_m["val_F1Score"]}) + "\n")
@@ -615,6 +645,8 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
     finally:
         if preemption is not None:
             preemption.uninstall()
+        if telemetry_server is not None:
+            telemetry_server.stop()
 
     # post-fit: restore best checkpoint and re-validate (main_cli.py:175-184)
     best_step = ckpts.best_step()
@@ -1117,11 +1149,36 @@ def _parse_overrides(pairs: Sequence[str]) -> dict:
     return out
 
 
+def trace_export(src: Path, out: Path | None = None) -> dict:
+    """Collect ``event=trace`` exemplar records under ``src`` (a run dir,
+    a trace dir, or one file) into ONE Chrome trace-event JSON — open it
+    in Perfetto / ``chrome://tracing``."""
+    from deepdfa_tpu.obs import chrome_trace, load_trace_records
+
+    records = load_trace_records(src)
+    spans = [s for rec in records for s in rec.get("spans", [])]
+    trace = chrome_trace(spans)
+    if out is None:
+        out = (src / "trace_events.json" if src.is_dir()
+               else src.with_suffix(".chrome.json"))
+    Path(out).write_text(json.dumps(trace, indent=2))
+    summary = {"trace_records": len(records), "spans": len(spans),
+               "out": str(out)}
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
 def main(argv: Sequence[str] | None = None) -> dict:
     parser = argparse.ArgumentParser(prog="deepdfa-tpu")
     parser.add_argument("command",
                         choices=["fit", "test", "analyze", "predict",
-                                 "export", "serve"])
+                                 "export", "serve", "trace"])
+    parser.add_argument("subcommand", nargs="?", default=None,
+                        help="trace: 'export' (the default) — merge a run "
+                        "dir's trace exemplars into Chrome trace-event JSON")
+    parser.add_argument("--out", default=None,
+                        help="trace export: output path (default: "
+                        "<run-dir>/trace_events.json)")
     parser.add_argument("--config", action="append", default=[],
                         help="layered config files (later files win)")
     parser.add_argument("--set", action="append", default=[], dest="overrides",
@@ -1148,6 +1205,15 @@ def main(argv: Sequence[str] | None = None) -> dict:
     args = parser.parse_args(argv)
     if args.command == "predict" and not args.source:
         parser.error("predict requires at least one --source")
+    if args.command == "trace":
+        # a reporting path: no config load, no run-dir creation, no logging
+        # re-init — it must work against a finished (or foreign) run dir
+        if (args.subcommand or "export") != "export":
+            parser.error(f"unknown trace subcommand {args.subcommand!r}")
+        if not args.run_dir:
+            parser.error("trace export requires --run-dir")
+        return trace_export(Path(args.run_dir),
+                            Path(args.out) if args.out else None)
 
     layers = list(args.config)
     if args.command in ("predict", "export", "serve") and args.run_dir:

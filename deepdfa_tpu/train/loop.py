@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
+from contextlib import nullcontext
 from functools import partial
 from typing import Any, Callable, Iterable, NamedTuple
 
@@ -389,6 +391,7 @@ class Trainer:
         preemption=None,
         skip_steps: int = 0,
         watchdog=None,
+        telemetry=None,
     ) -> tuple[TrainState, dict[str, float], float]:
         """One pass. ``sentinel``: an optional
         :class:`~deepdfa_tpu.resilience.sentinel.DivergenceSentinel`
@@ -424,53 +427,94 @@ class Trainer:
         hang_armed = watchdog is not None and faults.active("step.hang")
         consumed = 0
         stream = self._stream(batches)
+        # telemetry (obs.TrainTelemetry) is timing-only: it must not touch
+        # batches, rng, or step order, so a telemetered epoch stays
+        # bit-identical to a bare one (the elasticity invariants depend on
+        # that). Its tracer hangs every step's spans under one epoch root.
+        tracer = telemetry.tracer if telemetry is not None else None
+        epoch_cm = (tracer.span("train.epoch", root=True)
+                    if tracer is not None else nullcontext())
         try:
-            for batch in stream:
-                if consumed < skip_steps:
-                    consumed += 1
-                    continue
-                if pre_armed and faults.fire("preempt.sigterm"):
-                    preemption.trigger("injected fault preempt.sigterm")
-                if preemption is not None and preemption.triggered:
-                    from deepdfa_tpu.resilience.preemption import Preempted
+            with epoch_cm as epoch_sp:
+                it = iter(stream)
+                while True:
+                    t_wait = time.time()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    wait_end = time.time()
+                    if consumed < skip_steps:
+                        consumed += 1
+                        continue
+                    if pre_armed and faults.fire("preempt.sigterm"):
+                        preemption.trigger("injected fault preempt.sigterm")
+                    if preemption is not None and preemption.triggered:
+                        from deepdfa_tpu.resilience.preemption import Preempted
 
-                    raise Preempted(
-                        state, consumed, preemption.reason or "preempted"
+                        raise Preempted(
+                            state, consumed, preemption.reason or "preempted"
+                        )
+                    batch = jax.tree.map(jnp.asarray, batch)
+                    step, _ = self.steps_for(batch)
+                    if hang_armed and faults.fire("step.hang"):
+                        # simulated wedged dispatch: parks until the
+                        # watchdog's deadline cancels it → WatchdogTimeout,
+                        # thread unwinds
+                        watchdog.call(
+                            "train_step",
+                            lambda cancel: cancel.wait(),
+                            cancel_aware=True,
+                        )
+                    args = (
+                        (state, batch, metrics, float("nan"))
+                        if nan_armed and faults.fire("step.nan_grads")
+                        else (state, batch, metrics)
                     )
-                batch = jax.tree.map(jnp.asarray, batch)
-                step, _ = self.steps_for(batch)
-                if hang_armed and faults.fire("step.hang"):
-                    # simulated wedged dispatch: parks until the watchdog's
-                    # deadline cancels it → WatchdogTimeout, thread unwinds
-                    watchdog.call(
-                        "train_step",
-                        lambda cancel: cancel.wait(),
-                        cancel_aware=True,
-                    )
-                args = (
-                    (state, batch, metrics, float("nan"))
-                    if nan_armed and faults.fire("step.nan_grads")
-                    else (state, batch, metrics)
-                )
-                if watchdog is not None:
-                    state, metrics, loss, wsum = watchdog.call(
-                        "train_step", step, *args
-                    )
-                else:
-                    state, metrics, loss, wsum = step(*args)
-                consumed += 1
+                    t_disp = time.time()
+                    if watchdog is not None:
+                        state, metrics, loss, wsum = watchdog.call(
+                            "train_step", step, *args
+                        )
+                    else:
+                        state, metrics, loss, wsum = step(*args)
+                    disp_end = time.time()
+                    consumed += 1
+                    if telemetry is not None:
+                        shape_key = tuple(
+                            tuple(getattr(leaf, "shape", ()))
+                            for leaf in jax.tree.leaves(batch))
+                        telemetry.observe_step(
+                            wait_end - t_wait, disp_end - t_disp,
+                            shape_key=shape_key)
+                        if tracer is not None:
+                            parent = None if epoch_sp is None else epoch_sp.ctx
+                            tracer.record("data.wait", t_wait, wait_end,
+                                          parent=parent, step=consumed - 1)
+                            tracer.record("step.dispatch", t_disp, disp_end,
+                                          parent=parent, step=consumed - 1)
+                    if sentinel is not None:
+                        sentinel.observe(loss)
+                    losses.append(loss)
+                    wsums.append(wsum)
                 if sentinel is not None:
-                    sentinel.observe(loss)
-                losses.append(loss)
-                wsums.append(wsum)
-            if sentinel is not None:
-                sentinel.flush()
+                    sentinel.flush()
+                # the host-side reduction below is where the epoch's async
+                # dispatches actually block — the device.sync span
+                t_sync = time.time()
+                out = (state, compute_metrics(metrics, "train_"),
+                       _weighted_mean(losses, wsums))
+                if tracer is not None:
+                    tracer.record(
+                        "device.sync", t_sync,
+                        parent=None if epoch_sp is None else epoch_sp.ctx,
+                        n_steps=consumed)
+                return out
         finally:
             # deterministic producer shutdown even when the sentinel raises
             # mid-epoch (prefetch_to_device joins its thread on close)
             if hasattr(stream, "close"):
                 stream.close()
-        return state, compute_metrics(metrics, "train_"), _weighted_mean(losses, wsums)
 
     def evaluate(
         self, params, batches: Iterable[BatchedGraphs], prefix: str = "val_"

@@ -25,7 +25,11 @@ must actually coalesce), cache hit rate + hits, ok. The notes block also
 carries ``precision_tiers`` — per-bucket-tier p50/p99 of single-graph
 engine dispatches at BOTH serving precisions (f32 and, gate permitting,
 int8) from the same checkpoint, so one artifact answers "what does each
-tier cost at each precision" (``serve.precision`` in config.py).
+tier cost at each precision" (``serve.precision`` in config.py). Notes
+also record p50/p99 QUEUE-WAIT and DISPATCH durations (from the serve
+metrics reservoirs the tracing plane feeds) plus a ``trace_overhead``
+block — micro-measured span-record cost vs the measured p50, guarding
+the roadmap invariant that tracing stays under 2% of request latency.
 
 ``--fleet N`` grows the run into the distributed topology: the baseline
 single replica above doubles as the warm-store POPULATOR (its cold
@@ -178,6 +182,33 @@ def _precision_tiers(ckpt: dict, max_batch: int, requests_per_tier: int):
                          "p99_ms": round(float(np.percentile(lat, 99)), 3)}
         tiers[str(bucket.graph_nodes)] = row
     return tiers, engines["int8"].precision, refusal
+
+
+def _trace_overhead(p50_ms, spans_per_request: int = 6, n: int = 2000):
+    """Micro-measured cost of the tracing plane: time ``n`` raw span
+    records on a throwaway :class:`Tracer`, scale by the spans a scoring
+    request actually emits (server.request, cache.lookup, queue.wait,
+    batch.assembly, engine.dispatch, host.reduce), and compare against
+    the measured p50. Reported in notes (ROADMAP invariant: < 2% of
+    request latency) but NOT ANDed into the artifact gate — overhead is
+    a budget to watch, not a serving-correctness property."""
+    from deepdfa_tpu.obs import Tracer
+
+    tracer = Tracer(proc="bench-overhead", max_spans=n + 16)
+    t0 = time.perf_counter()
+    for i in range(n):
+        t = time.perf_counter()
+        tracer.record("overhead.probe", t, t, i=i)
+    per_span_ms = (time.perf_counter() - t0) / n * 1e3
+    per_request_ms = per_span_ms * spans_per_request
+    frac = (per_request_ms / p50_ms) if p50_ms else None
+    return {
+        "per_span_us": round(per_span_ms * 1e3, 3),
+        "spans_per_request": spans_per_request,
+        "per_request_ms": round(per_request_ms, 4),
+        "fraction_of_p50": round(frac, 5) if frac is not None else None,
+        "under_2pct": (frac < 0.02) if frac is not None else None,
+    }
 
 
 def _run_phase(port: int, bodies: list[str], concurrency: int):
@@ -415,6 +446,11 @@ def main(argv=None) -> dict:
             "max_wait_ms": args.max_wait_ms,
             "baseline_warmup": {k: baseline_warm[k] for k in
                                 ("hits", "misses", "compile_seconds_saved")},
+            "queue_wait_ms": {"p50": snap.get("queue_wait_p50_ms"),
+                              "p99": snap.get("queue_wait_p99_ms")},
+            "dispatch_ms": {"p50": snap.get("dispatch_p50_ms"),
+                            "p99": snap.get("dispatch_p99_ms")},
+            "trace_overhead": _trace_overhead(snap.get("latency_p50_ms")),
             "precision_tiers": tiers,
             "tier_precision_served": tier_precision,
             "int8_refused_reason": tier_refusal,

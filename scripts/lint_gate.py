@@ -1,7 +1,9 @@
 #!/usr/bin/env python
 """The fast pre-commit gate: ruff over the library + the device-free perf
 contract suite (``pytest -m perf_contract``) + the fleet unit suite
-(``pytest -m fleet``: hash ring, router, warm store) in one command.
+(``pytest -m fleet``: hash ring, router, warm store) + the observability
+suite (``pytest -m obs``: tracing, exposition conformance, drift) in one
+command.
 
 No step touches an accelerator, compiles XLA, or takes more than a few
 seconds, so this is safe to run on every commit: ruff catches the syntax/
@@ -67,6 +69,14 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("fleet")
+
+    print("lint_gate: pytest -m obs")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "obs", "-q",
+         "tests/test_obs.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("obs")
 
     if failures:
         print(f"lint_gate: FAILED ({', '.join(failures)})")

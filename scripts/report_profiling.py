@@ -5,7 +5,14 @@ Parity with the reference's ``scripts/report_profiling.py:1-66`` (gflops /
 gmacs / avg ms per example over ``profiledata.jsonl`` + ``timedata.jsonl``);
 the aggregation itself lives in ``deepdfa_tpu.train.profiling.report``.
 
-Usage: python scripts/report_profiling.py RUN_DIR [RUN_DIR ...]
+``--traces`` switches to the tracing view: per-span-name duration stats
+over a run dir's ``event=trace`` exemplars (``deepdfa_tpu.obs``) — where
+a slow request actually spent its time (queue wait vs batch assembly vs
+engine dispatch), straight from the journaled traces. Use
+``deepdfa-tpu trace export --run-dir <dir>`` for the Perfetto-openable
+Chrome JSON.
+
+Usage: python scripts/report_profiling.py [--traces] RUN_DIR [RUN_DIR ...]
 """
 
 from __future__ import annotations
@@ -18,12 +25,43 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 
-def main(argv=None) -> None:
-    from deepdfa_tpu.train.profiling import report
+def trace_report(run_dir) -> dict:
+    """Per-span-name {count, mean_ms, max_ms} over the run's exemplars."""
+    from deepdfa_tpu.obs import load_trace_records
 
-    for run_dir in argv or sys.argv[1:]:
-        stats = report(run_dir)
-        print(json.dumps({"run_dir": str(run_dir), **stats}))
+    records = load_trace_records(run_dir)
+    by_name: dict[str, list[float]] = {}
+    for rec in records:
+        for span in rec.get("spans", []):
+            by_name.setdefault(span["name"], []).append(
+                float(span.get("dur_ms", 0.0)))
+    return {
+        "trace_records": len(records),
+        "spans": {
+            name: {
+                "count": len(durs),
+                "mean_ms": round(sum(durs) / len(durs), 4),
+                "max_ms": round(max(durs), 4),
+            }
+            for name, durs in sorted(by_name.items())
+        },
+    }
+
+
+def main(argv=None) -> None:
+    args = list(argv if argv is not None else sys.argv[1:])
+    traces = "--traces" in args
+    if traces:
+        args.remove("--traces")
+    for run_dir in args:
+        if traces:
+            print(json.dumps({"run_dir": str(run_dir),
+                              **trace_report(run_dir)}))
+        else:
+            from deepdfa_tpu.train.profiling import report
+
+            stats = report(run_dir)
+            print(json.dumps({"run_dir": str(run_dir), **stats}))
 
 
 if __name__ == "__main__":

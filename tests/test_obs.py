@@ -1,0 +1,546 @@
+"""The telemetry plane: W3C traceparent propagation, the bounded span
+buffer, Chrome trace-event export, the shared metrics registry (ONE
+exposition formatter for serve / router / trainer), the score-drift
+sentinel, and training-step telemetry. Everything here is device-free —
+stub engines, no XLA compiles — so ``pytest -m obs`` runs in seconds and
+is wired into scripts/lint_gate.py."""
+
+import json
+import re
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+pytestmark = pytest.mark.obs
+
+
+# ---------------------------------------------------------------------------
+# traceparent + tracer core
+
+
+def test_traceparent_roundtrip():
+    from deepdfa_tpu.obs import SpanContext, parse_traceparent
+
+    ctx = SpanContext("ab" * 16, "cd" * 8)
+    header = ctx.traceparent()
+    assert header == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    back = parse_traceparent(header)
+    assert back == ctx
+    assert parse_traceparent(SpanContext("ef" * 16, "01" * 8,
+                                         sampled=False).traceparent()
+                             ).sampled is False
+
+
+def test_traceparent_rejects_malformed():
+    from deepdfa_tpu.obs import parse_traceparent
+
+    bad = [
+        None, "", "not-a-header",
+        "00-" + "g" * 32 + "-" + "ab" * 8 + "-01",      # non-hex trace
+        "00-" + "ab" * 16 + "-" + "cd" * 8,             # missing flags
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",     # forbidden version
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",      # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",     # all-zero span id
+    ]
+    for header in bad:
+        assert parse_traceparent(header) is None, header
+    # case-insensitive per spec: uppercase hex still parses
+    up = ("00-" + "AB" * 16 + "-" + "CD" * 8 + "-01")
+    assert parse_traceparent(up).trace_id == "ab" * 16
+
+
+def test_tracer_nesting_and_bounded_buffer():
+    from deepdfa_tpu.obs import Tracer
+
+    tracer = Tracer(proc="t", max_spans=4)
+    with tracer.span("outer", root=True) as outer:
+        assert tracer.current() == outer.ctx
+        with tracer.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert tracer.current() is None
+    spans = tracer.spans(outer.trace_id)
+    assert [s.name for s in spans] == ["inner", "outer"]  # close order
+    for i in range(10):  # bounded: old traces fall off the back
+        tracer.record(f"s{i}", time.time())
+    assert len(tracer) == 4
+    assert tracer.recorded_total == 12
+
+
+# ---------------------------------------------------------------------------
+# exposition conformance — the ONE checker all three endpoints must pass
+
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|histogram)$")
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) \S")
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                        r"(\{[^{}]*\})? (\S+)$")
+
+
+def _assert_exposition(text: str) -> None:
+    """Prometheus text-format v0.0.4 conformance: HELP then TYPE exactly
+    once per family, every sample belongs to a declared family (histogram
+    suffixes allowed), values parse, no duplicate (name, labels) sample."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    declared: dict[str, str] = {}
+    helped: set[str] = set()
+    samples: set[tuple] = set()
+    for line in text.splitlines():
+        assert line == line.strip(), f"stray whitespace: {line!r}"
+        if line.startswith("# HELP "):
+            m = _HELP_RE.match(line)
+            assert m, f"malformed HELP: {line!r}"
+            assert m.group(1) not in helped, f"duplicate HELP {m.group(1)}"
+            helped.add(m.group(1))
+        elif line.startswith("# TYPE "):
+            m = _TYPE_RE.match(line)
+            assert m, f"malformed TYPE: {line!r}"
+            name, kind = m.groups()
+            assert name not in declared, f"duplicate TYPE for {name}"
+            assert name in helped, f"TYPE before HELP for {name}"
+            declared[name] = kind
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"malformed sample: {line!r}"
+            name, labels, value = m.groups()
+            family = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = name[: -len(suffix)] if name.endswith(suffix) else None
+                if base and declared.get(base) == "histogram":
+                    family = base
+            assert family in declared, f"undeclared family for {line!r}"
+            float(value)  # +Inf / integers / floats all parse
+            key = (name, labels or "")
+            assert key not in samples, f"duplicate sample {key}"
+            samples.add(key)
+    assert declared and samples
+
+
+def _populated_serve_metrics():
+    from deepdfa_tpu.obs import ScoreDriftSentinel, Tracer
+    from deepdfa_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    for code, lat in ((200, 5.0), (200, 9.0), (400, 1.0), (422, 2.0)):
+        m.inc("requests_total")
+        m.observe_response(code, lat)
+    m.observe_batch(n_real=3, capacity=4)
+    m.queue_wait.observe(0.5)
+    m.queue_wait.observe(1.5)
+    m.dispatch.observe(2.0)
+    m.tracer = Tracer(proc="test")
+    m.tracer.record("x", time.time())
+    m.drift = ScoreDriftSentinel(window=8, bins=4, min_samples=2)
+    for s in (0.1, 0.2, 0.8, 0.9):
+        m.drift.observe(s, "rev-a")
+    return m
+
+
+def test_serve_exposition_conformance_and_single_type_per_family():
+    m = _populated_serve_metrics()
+    text = m.render(cache_stats={"hits": 1, "encode_hits": 0, "misses": 3,
+                                 "evictions": 0, "entries": 3,
+                                 "hit_rate": 0.25})
+    _assert_exposition(text)
+    # the regression this PR fixes: labeled families (quantile gauges,
+    # per-code counters) must declare HELP/TYPE once, not once per sample
+    assert text.count("# TYPE deepdfa_serve_latency_ms ") == 1
+    assert text.count('deepdfa_serve_latency_ms{quantile="0.5"}') == 1
+    assert text.count('deepdfa_serve_latency_ms{quantile="0.99"}') == 1
+    assert text.count("# TYPE deepdfa_serve_responses_total ") == 1
+    assert 'deepdfa_serve_responses_total{code="200"} 2' in text
+    assert "# TYPE deepdfa_serve_queue_wait_ms gauge" in text
+    assert "# TYPE deepdfa_serve_dispatch_ms gauge" in text
+    assert 'deepdfa_serve_score_drift{model_rev="rev-a"}' in text
+    assert 'deepdfa_serve_score_bucket{model_rev="rev-a",le="+Inf"} 4' in text
+
+
+def test_router_exposition_conformance():
+    from deepdfa_tpu.obs import Tracer
+    from deepdfa_tpu.serve.router import RouterMetrics
+
+    m = RouterMetrics()
+    m.inc("requests_total")
+    m.observe_forward("127.0.0.1:1")
+    m.observe_forward("127.0.0.1:2")
+    m.latency.observe(3.0)
+    m.latency.observe(7.0)
+    m.inc("retries_total")
+    m.tracer = Tracer(proc="router")
+    text = m.render()
+    _assert_exposition(text)
+    assert text.count("# TYPE deepdfa_router_forwarded_total ") == 1
+    assert 'deepdfa_router_forwarded_total{backend="127.0.0.1:1"} 1' in text
+
+
+def test_train_exposition_conformance():
+    from deepdfa_tpu.obs import TrainTelemetry
+
+    t = TrainTelemetry(roofline_flops_per_s=1e12)
+    t.observe_epoch(0)
+    t.observe_step(0.01, 0.02, shape_key=("a",), flops=1e9)
+    t.observe_step(0.01, 0.02, shape_key=("a",), flops=1e9)
+    text = t.render()
+    _assert_exposition(text)
+    assert "deepdfa_train_steps_total 2" in text
+    assert "deepdfa_train_compiles_total 1" in text
+    assert "deepdfa_train_mfu " in text
+
+
+def test_registry_label_escaping_and_histogram_cumulation():
+    from deepdfa_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry("x_")
+    g = reg.gauge("g", "gauge with hostile labels", labels=("who",))
+    g.set(1, who='a"b\\c\nd')
+    h = reg.histogram("h", "histogram", buckets=(1.0, 5.0))
+    for v in (0.5, 3.0, 10.0):
+        h.observe(v)
+    text = reg.render()
+    _assert_exposition(text)
+    assert r'x_g{who="a\"b\\c\nd"} 1' in text
+    assert 'x_h_bucket{le="1"} 1' in text     # cumulative, not per-bucket
+    assert 'x_h_bucket{le="5"} 2' in text
+    assert 'x_h_bucket{le="+Inf"} 3' in text
+    assert "x_h_sum 13.5" in text and "x_h_count 3" in text
+    with pytest.raises(ValueError):
+        reg.counter("g", "kind mismatch on an existing family")
+
+
+# ---------------------------------------------------------------------------
+# drift sentinel
+
+
+def test_drift_sentinel_quiet_on_reference_flips_on_shift():
+    from deepdfa_tpu.obs import ScoreDriftSentinel
+
+    sent = ScoreDriftSentinel(window=64, bins=10, threshold=0.2,
+                              min_samples=32)
+    low = [((i % 40) + 1) / 100 for i in range(64)]   # scores in (0, 0.41]
+    for s in low:
+        sent.observe(s, "rev-1")                      # freezes the reference
+    for s in low:
+        sent.observe(s, "rev-1")                      # same shape again
+    snap = sent.snapshot()["rev-1"]
+    assert snap["ready"] is True
+    assert snap["alert"] is False and snap["psi"] < 0.1
+    for i in range(64):                                # distribution walks
+        sent.observe(0.6 + ((i % 40) + 1) / 100, "rev-1")
+    snap = sent.snapshot()["rev-1"]
+    assert snap["alert"] is True and snap["psi"] > 0.25
+    assert snap["n_observed"] == 192
+    # a cold rev never alerts, whatever it scores
+    sent.observe(0.99, "rev-cold")
+    assert sent.snapshot()["rev-cold"]["alert"] is False
+
+
+def test_psi_symmetric_properties():
+    from deepdfa_tpu.obs import psi
+
+    assert psi([10, 10, 10], [10, 10, 10]) == pytest.approx(0.0)
+    assert psi([30, 0, 0], [0, 0, 30]) > 1.0
+    with pytest.raises(ValueError):
+        psi([1, 2], [1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# training telemetry
+
+
+def test_train_telemetry_windows_and_server_scrape():
+    from deepdfa_tpu.obs import TelemetryServer, TrainTelemetry
+
+    t = TrainTelemetry()
+    t.observe_epoch(3)
+    t.observe_step(0.010, 0.030, shape_key=(("8",),))
+    t.observe_step(0.005, 0.015, shape_key=(("8",),))
+    epoch = t.epoch_stats()                 # drains the window...
+    assert epoch["steps"] == 2 and epoch["compiles"] == 1
+    assert epoch["data_wait_frac"] == pytest.approx(0.25, abs=0.01)
+    assert t.epoch_stats()["steps"] == 0    # ...which resets
+    snap = t.snapshot()                     # cumulative view unaffected
+    assert snap["steps"] == 2 and snap["epoch"] == 3
+    assert "mfu" not in snap                # no roofline supplied: no guess
+
+    srv = TelemetryServer(t, port=0).start()
+    try:
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        assert resp.status == 200
+        _assert_exposition(text)
+        assert "deepdfa_train_steps_total 2" in text
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["ok"] is True and health["role"] == "trainer"
+        assert health["steps"] == 2
+        conn.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a fleet request is ONE trace across router + backend
+
+
+def _chain(n, keys=("_ABS_DATAFLOW",)):
+    from deepdfa_tpu.data.graphs import Graph
+
+    feats = {k: np.zeros(n, np.int32) for k in keys}
+    return Graph(senders=np.arange(n - 1, dtype=np.int32),
+                 receivers=np.arange(1, n, dtype=np.int32),
+                 node_feats=feats).with_self_loops()
+
+
+class _StubEngine:
+    """Real ScoringEngine over a stub score_fn (same shape as
+    test_serve.py's — no XLA, no devices)."""
+
+    def __new__(cls, vocabs=(), max_batch=4, prob=0.25):
+        from deepdfa_tpu.serve import ScoringEngine, serve_buckets
+
+        def score_fn(batch):
+            return np.full(batch.max_graphs, prob, np.float32)
+
+        return ScoringEngine(score_fn, serve_buckets(max_batch),
+                             feat_keys=tuple(vocabs))
+
+
+@pytest.fixture(scope="module")
+def demo():
+    """(vocabs, sources) — real frontend + vocabularies, no training."""
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    rows = demo_corpus(4, seed=0).to_dict("records")
+    cpgs = {int(r["id"]): add_dependence_edges(parse_source(r["before"]))
+            for r in rows}
+    labels = {int(r["id"]): int(r["vul"]) for r in rows}
+    _, vocabs = CorpusBuilder(FeatureConfig()).build(
+        cpgs, list(cpgs), graph_labels=labels)
+    return vocabs, [r["before"] for r in rows]
+
+
+def _req(port, method, path, body=None, headers=None, timeout=30):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json",
+                              **(headers or {})})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _wait_spans(tracer, trace_id, n, timeout_s=5.0):
+    """Dispatcher-thread spans (host.reduce) land just after the response
+    is sent — poll instead of racing them."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        spans = tracer.spans(trace_id)
+        if len(spans) >= n:
+            return spans
+        time.sleep(0.01)
+    return tracer.spans(trace_id)
+
+
+def test_fleet_request_is_one_trace_across_router_and_backend(demo):
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.obs import chrome_trace
+    from deepdfa_tpu.serve import FleetRouter, ScoreServer
+
+    vocabs, sources = demo
+    srv = ScoreServer(_StubEngine(vocabs, max_batch=4), vocabs,
+                      ServeConfig(port=0, max_wait_ms=2.0),
+                      replica_id="r0").start()
+    srv.engine.warmup()
+    router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                         probe_interval_s=60.0)
+    router.probe_once()
+    router.start(probe=False)
+    try:
+        status, data = _req(router.port, "POST", "/score",
+                            json.dumps({"source": sources[0]}))
+        assert status == 200 and json.loads(data)["results"]
+
+        assert len(router.tracer.trace_ids()) == 1
+        trace_id = router.tracer.trace_ids()[0]
+        backend_spans = _wait_spans(srv.tracer, trace_id, 6)
+        router_spans = router.tracer.spans(trace_id)
+        names = {s.name for s in router_spans} | {s.name for s in backend_spans}
+        # the acceptance criterion: >= 5 spans, one trace id, both procs
+        assert {"router.request", "router.forward", "server.request",
+                "queue.wait", "engine.dispatch"} <= names, names
+        assert {"router.route", "cache.lookup", "batch.assembly",
+                "host.reduce"} <= names, names
+        all_spans = router_spans + backend_spans
+        assert len(all_spans) >= 5
+        assert {s.trace_id for s in all_spans} == {trace_id}
+        assert {s.proc for s in all_spans} == {"router", "serve:r0"}
+        roots = [s for s in all_spans if s.root]
+        assert [s.name for s in roots if s.proc == "router"] == [
+            "router.request"]
+        # parent chain crosses the HTTP hop: server.request's parent is
+        # the router.forward span on the other side
+        fwd = next(s for s in router_spans if s.name == "router.forward")
+        root = next(s for s in backend_spans if s.name == "server.request")
+        assert root.parent_id == fwd.span_id
+
+        doc = chrome_trace(all_spans)
+        json.dumps(doc)  # must be valid JSON
+        assert doc["displayTimeUnit"] == "ms"
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in metas} == {"router", "serve:r0"}
+        for ev in doc["traceEvents"]:
+            assert {"name", "ph", "pid"} <= set(ev)
+            if ev["ph"] == "X":
+                assert {"ts", "dur"} <= set(ev) and ev["dur"] >= 1.0
+                assert ev["args"]["trace_id"] == trace_id
+    finally:
+        router.shutdown()
+        srv.shutdown()
+
+
+def test_serve_latency_reservoirs_and_drift_feed(demo):
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, sources = demo
+    srv = ScoreServer(_StubEngine(vocabs, max_batch=4), vocabs,
+                      ServeConfig(port=0, max_wait_ms=2.0)).start()
+    try:
+        status, _ = _req(srv.port, "POST", "/score",
+                         json.dumps({"source": sources[0]}))
+        assert status == 200
+        snap = srv.metrics.snapshot()
+        assert snap["queue_wait_p50_ms"] is not None
+        assert snap["dispatch_p50_ms"] is not None
+        assert snap["queue_wait_p99_ms"] >= snap["queue_wait_p50_ms"]
+        # every scored request feeds the sentinel under the engine's rev
+        drift = srv.drift.snapshot()
+        assert sum(row["n_observed"] for row in drift.values()) >= 1
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.faults
+def test_trace_drop_fault_never_fails_the_request(demo):
+    """The obs.trace_drop chaos point: losing a span export bumps
+    dropped_total and NOTHING else — the request it annotates succeeds."""
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.resilience import faults
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, sources = demo
+    srv = ScoreServer(_StubEngine(vocabs, max_batch=4), vocabs,
+                      ServeConfig(port=0, max_wait_ms=2.0)).start()
+    try:
+        with faults.installed("obs.trace_drop@1,2"):
+            status, data = _req(srv.port, "POST", "/score",
+                                json.dumps({"source": sources[0]}))
+            assert status == 200
+            body = json.loads(data)
+            assert body["results"][0]["vulnerable_probability"] == 0.25
+        deadline = time.time() + 5.0
+        while srv.tracer.dropped_total < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert srv.tracer.dropped_total == 2
+        text = srv.metrics.render(cache_stats=srv.cache.stats())
+        assert "deepdfa_serve_trace_spans_dropped_total 2" in text
+        _assert_exposition(text)
+    finally:
+        srv.shutdown()
+
+
+def test_obs_config_validation_and_override():
+    from deepdfa_tpu.config import ObsConfig, ServeConfig, load_config
+
+    cfg = ServeConfig()
+    assert cfg.obs.trace is True and cfg.obs.train_port == -1
+    exp = load_config(overrides={"serve.obs.drift_threshold": 0.5,
+                                 "serve.obs.trace": False})
+    assert exp.serve.obs.drift_threshold == 0.5
+    assert exp.serve.obs.trace is False
+    with pytest.raises(ValueError):
+        ObsConfig(trace_buffer=0)
+    with pytest.raises(ValueError):
+        ObsConfig(drift_bins=1)
+
+
+# ---------------------------------------------------------------------------
+# exemplar journaling + export CLI
+
+
+def test_slow_request_exemplars_and_trace_export_cli(tmp_path):
+    from deepdfa_tpu.obs import Tracer, load_trace_records
+    from deepdfa_tpu.train.cli import trace_export
+
+    traces = tmp_path / "traces"
+    tracer = Tracer(proc="serve", slow_ms=0.0, exemplar_dir=traces,
+                    max_exemplars=2)
+    for i in range(4):
+        t0 = time.time()
+        with tracer.span("server.request", root=True, i=i) as sp:
+            tracer.record("queue.wait", t0, t0 + 0.001, parent=sp.ctx)
+    files = sorted(traces.glob("trace-*.json"))
+    assert len(files) == 2  # capped: oldest exemplars evicted
+    records = load_trace_records(tmp_path)  # recursive: run dir works
+    assert len(records) == 2
+    assert all(r["event"] == "trace" and r["root"] == "server.request"
+               for r in records)
+    assert all(len(r["spans"]) == 2 for r in records)
+
+    summary = trace_export(tmp_path)
+    out = Path(summary["out"])
+    assert out.exists() and summary["trace_records"] == 2
+    assert summary["spans"] == 4
+    doc = json.loads(out.read_text())
+    assert doc["displayTimeUnit"] == "ms"
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 4 and all(e["dur"] >= 1.0 for e in xs)
+
+
+def test_trace_export_via_main_entrypoint(tmp_path, capsys):
+    from deepdfa_tpu.obs import Tracer
+    from deepdfa_tpu.train.cli import main
+
+    tracer = Tracer(proc="train", slow_ms=0.0, exemplar_dir=tmp_path)
+    with tracer.span("train.epoch", root=True):
+        pass
+    out = tmp_path / "export.json"
+    summary = main(["trace", "export", "--run-dir", str(tmp_path),
+                    "--out", str(out)])
+    assert summary["trace_records"] == 1 and out.exists()
+    assert "traceEvents" in json.loads(out.read_text())
+
+
+def test_report_profiling_traces_view(tmp_path, capsys):
+    import report_profiling
+
+    from deepdfa_tpu.obs import Tracer
+
+    tracer = Tracer(proc="serve", slow_ms=0.0, exemplar_dir=tmp_path)
+    t0 = time.time()
+    with tracer.span("server.request", root=True) as sp:
+        tracer.record("engine.dispatch", t0, t0 + 0.002, parent=sp.ctx)
+    report = report_profiling.trace_report(tmp_path)
+    assert report["trace_records"] == 1
+    assert set(report["spans"]) == {"server.request", "engine.dispatch"}
+    assert report["spans"]["engine.dispatch"]["count"] == 1
+    report_profiling.main(["--traces", str(tmp_path)])
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["trace_records"] == 1

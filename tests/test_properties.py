@@ -7,6 +7,11 @@ inline)."""
 from __future__ import annotations
 
 import numpy as np
+import pytest
+
+# optional dev dependency (same policy as ruff/torch): absent hypothesis
+# skips the module cleanly instead of erroring collection
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from deepdfa_tpu.data.graphs import BucketSpec, Graph, GraphBatcher
