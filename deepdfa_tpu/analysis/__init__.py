@@ -1,0 +1,83 @@
+"""The invariant gate — AST static analysis that mechanizes the roadmap's
+standing invariants.
+
+DeepDFA's premise is that abstracted dataflow analysis finds bug classes
+pattern-matching misses; this package turns that discipline on the repo
+itself. One shared :class:`~deepdfa_tpu.analysis.model.ProjectModel`
+(module ASTs, import map, lite call graph, lock/thread/jit-entry
+indexes) feeds five passes, each emitting
+:class:`~deepdfa_tpu.analysis.findings.Finding` records:
+
+=========  ==============================================================
+atomic     durable writes must commit sideways via ``os.replace`` /
+           ``atomic_write_text`` (invariants 1, 10)
+locks      lock acquisition-order cycles + thread-written state with no
+           common lock across serve/, obs/, resilience/
+jax        host-impure constructs reachable from jit entries; donated
+           buffers reused or donated twice (the PR 6 deadlock class)
+faults     fault points declared exactly once in ``faults.KNOWN_POINTS``,
+           fired somewhere, chaos-tested, and mirrored in the generated
+           README table (invariant 5)
+metrics    ``deepdfa_*`` naming + exposition only through
+           ``obs/registry.py`` (invariant 16)
+=========  ==============================================================
+
+Run it: ``python -m deepdfa_tpu.analysis`` (human), ``--json`` (CI),
+``--stats`` (per-pass counts + wall time). ``scripts/lint_gate.py``
+runs it as step 5; unbaselined findings fail the commit.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+from . import atomic, faultpoints, locks, metrics_pass, purity
+from .baseline import Baseline, DEFAULT_BASELINE_NAME
+from .findings import INVARIANT_IDS, Finding
+from .model import ProjectModel
+
+__all__ = [
+    "Baseline", "DEFAULT_BASELINE_NAME", "Finding", "INVARIANT_IDS",
+    "PASSES", "ProjectModel", "run_passes", "repo_root",
+]
+
+# declaration order == report order
+PASSES = {
+    "atomic": atomic.run,
+    "locks": locks.run,
+    "jax": purity.run,
+    "faults": faultpoints.run,
+    "metrics": metrics_pass.run,
+}
+
+
+def repo_root() -> Path:
+    """The checkout root (parent of the installed package directory)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def run_passes(model: ProjectModel, passes=None):
+    """Run ``passes`` (default: all five) over ``model``.
+
+    Returns ``(findings, stats)`` where stats maps pass name →
+    ``{"findings": n, "seconds": wall}`` plus a ``"model"`` row with file
+    and function counts — the ``--stats`` surface.
+    """
+    names = list(passes or PASSES)
+    findings: list[Finding] = []
+    stats: dict[str, dict] = {
+        "model": {"files": len(model.modules),
+                  "functions": len(model.functions),
+                  "parse_errors": len(model.errors)},
+    }
+    for name in names:
+        if name not in PASSES:
+            raise ValueError(f"unknown pass {name!r} (have {list(PASSES)})")
+        t0 = time.perf_counter()
+        got = PASSES[name](model)
+        stats[name] = {"findings": len(got),
+                       "seconds": round(time.perf_counter() - t0, 4)}
+        findings.extend(got)
+    findings.sort(key=Finding.sort_key)
+    return findings, stats

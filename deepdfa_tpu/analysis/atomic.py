@@ -1,0 +1,146 @@
+"""Pass 1 — atomic-commit discipline (ROADMAP invariants 1, 10).
+
+A checkpoint step dir is COMMITTED iff its ``meta.json`` exists; a
+warm-store artifact iff its ``{key}.json`` meta exists; the journal and
+every extraction artifact must read as either the old record or the new
+one. The mechanism behind all three is the same: write sideways, fsync,
+``os.replace``. This pass flags any *durable* write on those paths that
+bypasses the protocol — a ``write_text``/``json.dump``/``open(.., "w")``
+whose enclosing function neither routes through
+``resilience.journal.atomic_write_text`` nor commits via ``os.replace``.
+
+Scope is the durable-artifact surface the invariants name (checkpoint,
+warm store, journal/resilience, extraction = cpg + ingest + preprocess,
+export manifests, run-dir reports, observability exemplars) — process
+logs and append-only streams (``train/tune.py`` trial stderr,
+``train/profiling.py`` jsonl) are not commit-protocol artifacts and stay
+out of scope. A torn write in scope is exactly the PR 6 lesson: it
+surfaces far from its cause, as a corpus entry or a program instead of a
+cache miss.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+from .model import FunctionInfo, ModuleInfo, ProjectModel, dotted_name
+
+PASS_NAME = "atomic"
+
+# posix-path substrings that put a module on the durable-artifact surface
+DURABLE_PATHS = (
+    "checkpoint", "warmstore", "journal", "/cpg/", "ingest", "serving",
+    "train/cli", "/obs/", "preprocess", "extraction", "quarantine",
+)
+
+# write modes that replace file content (appends are not commit-protocol)
+_DESTRUCTIVE_MODES = {"w", "wt", "wb", "w+", "wb+", "w+b"}
+
+
+def _in_scope(rel: str) -> bool:
+    return any(pat in rel for pat in DURABLE_PATHS)
+
+
+def _fn_is_exempt(model: ProjectModel, fn: FunctionInfo | None) -> bool:
+    """A function that itself lands the artifact via ``os.replace`` or
+    routes through ``atomic_write_text`` IS the protocol, not a bypass."""
+    if fn is None:
+        return False
+    for cs in fn.calls:
+        canon = fn.module.canonical(cs.name)
+        if canon in ("os.replace", "os.rename"):
+            return True
+        if canon.rpartition(".")[2] in ("atomic_write_text",
+                                        "atomic_write_bytes"):
+            return True
+    return False
+
+
+def _write_mode(call) -> str | None:
+    """Literal mode of an ``open``-style call, or None when unknown."""
+    mode_node = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return "r" if dotted_name(call.func) == "open" else None
+    if isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str):
+        return mode_node.value
+    return None
+
+
+def _own_call_nodes(model: ProjectModel, fn: FunctionInfo):
+    """Every ``ast.Call`` in ``fn``'s own body, nested defs excluded.
+
+    The model's call list only holds dotted-name call sites, which misses
+    durable writes on computed receivers — ``(run_dir / "m.json")
+    .write_text(...)`` — so this pass walks the raw AST itself.
+    """
+    nested_nodes = {id(model.functions[k].node) for k in fn.nested.values()}
+    stack = list(ast.iter_child_nodes(fn.node))
+    while stack:
+        node = stack.pop()
+        if id(node) in nested_nodes:
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _durable_write(info: ModuleInfo, call: ast.Call) -> str | None:
+    """Human label when the call node is a durable write, else None."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in ("write_text",
+                                                         "write_bytes"):
+        receiver = dotted_name(func.value)
+        return f"{receiver or '<expr>'}.{func.attr}(...)"
+    name = dotted_name(func)
+    if name is None:
+        return None
+    canon = info.canonical(name)
+    if canon == "json.dump":
+        return "json.dump(...)"
+    if name.rpartition(".")[2] == "open" or canon == "open":
+        mode = _write_mode(call)
+        if mode is not None and mode.replace("+", "") in ("w", "wt", "wb"):
+            return f"open(..., {mode!r})"
+    return None
+
+
+def run(model: ProjectModel) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel, info in model.modules.items():
+        if not _in_scope(rel):
+            continue
+        for fn in model.functions.values():
+            if fn.module is not info:
+                continue
+            exempt = _fn_is_exempt(model, fn)
+            if exempt:
+                continue
+            # exemption is per protocol unit: a nested def inside an
+            # exempt function (or vice versa) shares the commit sequence
+            parent = model.functions.get(fn.parent) if fn.parent else None
+            if _fn_is_exempt(model, parent):
+                continue
+            if any(_fn_is_exempt(model, model.functions[k])
+                   for k in fn.nested.values()):
+                continue
+            for call in _own_call_nodes(model, fn):
+                label = _durable_write(info, call)
+                if label is None:
+                    continue
+                findings.append(Finding(
+                    file=rel, line=call.lineno, invariant_id="atomic-commit",
+                    pass_name=PASS_NAME,
+                    message=(
+                        f"non-atomic durable write {label} in {fn.name}() — "
+                        "a kill here leaves a torn artifact that reads as "
+                        "data, not as a miss; route through "
+                        "resilience.journal.atomic_write_text or commit "
+                        "sideways via os.replace (invariants 1, 10)"),
+                ))
+    return findings

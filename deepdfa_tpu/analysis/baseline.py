@@ -1,0 +1,85 @@
+"""Checked-in suppression baseline for the invariant gate.
+
+The baseline exists so the gate can land green the day a new pass ships,
+then ratchet: every entry is an *individually justified* debt record,
+not a blanket ignore. Schema (``analysis_baseline.json`` at repo root)::
+
+    {
+      "schema": 1,
+      "suppressions": [
+        {"invariant": "atomic-commit",
+         "file": "deepdfa_tpu/train/tune.py",
+         "line": 146,                      # optional — omit to match any
+         "contains": "write_text",         # optional message substring
+         "reason": "trial spec is rewritten whole on retry; torn reads
+                    impossible (single writer, read after join)"}
+      ]
+    }
+
+Matching is deliberately strict — invariant AND file must match, plus
+``line``/``contains`` when present — so a *new* violation of a baselined
+kind in a baselined file still fails the gate unless it lands on the
+exact suppressed site.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .findings import Finding
+
+__all__ = ["Baseline", "DEFAULT_BASELINE_NAME"]
+
+DEFAULT_BASELINE_NAME = "analysis_baseline.json"
+
+
+@dataclass
+class Baseline:
+    suppressions: list[dict] = field(default_factory=list)
+    path: Path | None = None
+
+    @classmethod
+    def load(cls, path: str | Path | None) -> "Baseline":
+        """Load the baseline; a missing file is an empty baseline (the
+        healthy end state), a malformed one is an error the CLI surfaces."""
+        if path is None:
+            return cls()
+        path = Path(path)
+        if not path.is_file():
+            return cls(path=path)
+        data = json.loads(path.read_text())
+        if not isinstance(data, dict) or "suppressions" not in data:
+            raise ValueError(f"{path}: baseline must be an object with a "
+                             "'suppressions' list")
+        supps = data["suppressions"]
+        for i, s in enumerate(supps):
+            if not isinstance(s, dict) or "invariant" not in s or "file" not in s:
+                raise ValueError(f"{path}: suppression #{i} needs at least "
+                                 "'invariant' and 'file'")
+            if "reason" not in s:
+                raise ValueError(f"{path}: suppression #{i} has no 'reason' "
+                                 "— baseline entries must be individually "
+                                 "justified")
+        return cls(suppressions=list(supps), path=path)
+
+    def matches(self, finding: Finding) -> bool:
+        for s in self.suppressions:
+            if s["invariant"] != finding.invariant_id:
+                continue
+            if s["file"] != finding.file:
+                continue
+            if "line" in s and int(s["line"]) != finding.line:
+                continue
+            if "contains" in s and s["contains"] not in finding.message:
+                continue
+            return True
+        return False
+
+    def split(self, findings: list[Finding]) -> tuple[list[Finding], list[Finding]]:
+        """(unbaselined, baselined) — the gate fails on the first list."""
+        fresh, known = [], []
+        for f in findings:
+            (known if self.matches(f) else fresh).append(f)
+        return fresh, known

@@ -1,0 +1,128 @@
+"""``python -m deepdfa_tpu.analysis`` — the invariant gate's front door.
+
+Exit codes: 0 clean (or everything baselined), 1 unbaselined findings,
+2 usage/internal error. ``--json`` emits a machine-readable report for
+``scripts/lint_gate.py``; ``--stats`` prints per-pass finding counts and
+wall time; ``--faults-table`` prints the generated README markdown table
+and exits (see the faults pass).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from . import PASSES, repo_root, run_passes
+from .baseline import DEFAULT_BASELINE_NAME, Baseline
+from .faultpoints import render_faults_table
+from .model import ProjectModel
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deepdfa_tpu.analysis",
+        description="Static invariant gate: atomic-commit, lock-order, "
+                    "jit-purity/donation, fault-registry, and metrics "
+                    "conformance passes over the project AST.",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files or directories to scan (default: the "
+                        "package's deepdfa_tpu/ and scripts/ trees)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit a JSON report instead of human output")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-pass finding counts and wall time")
+    p.add_argument("--passes", default=None, metavar="NAMES",
+                   help=f"comma-separated subset of {','.join(PASSES)}")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"suppression file (default: {DEFAULT_BASELINE_NAME} "
+                        "at the repo root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline; report every finding")
+    p.add_argument("--faults-table", action="store_true",
+                   help="print the generated DEEPDFA_FAULTS README table "
+                        "and exit")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.faults_table:
+        print(render_faults_table())
+        return 0
+
+    root = repo_root()
+    if args.paths:
+        roots = [Path(p) for p in args.paths]
+        missing = [p for p in roots if not p.exists()]
+        if missing:
+            print(f"error: no such path: {', '.join(map(str, missing))}",
+                  file=sys.stderr)
+            return 2
+    else:
+        roots = [root / "deepdfa_tpu", root / "scripts"]
+        roots = [r for r in roots if r.exists()]
+
+    passes = None
+    if args.passes:
+        passes = [s.strip() for s in args.passes.split(",") if s.strip()]
+        unknown = [s for s in passes if s not in PASSES]
+        if unknown:
+            print(f"error: unknown pass(es) {unknown}; have {list(PASSES)}",
+                  file=sys.stderr)
+            return 2
+
+    if args.no_baseline:
+        baseline = Baseline()
+    else:
+        baseline = Baseline.load(Path(args.baseline) if args.baseline
+                                 else root / DEFAULT_BASELINE_NAME)
+
+    t0 = time.perf_counter()
+    try:
+        model = ProjectModel.build(root, roots)
+        findings, stats = run_passes(model, passes)
+    except Exception as exc:  # surfaced as exit 2, not a traceback spray
+        print(f"error: analysis failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2
+    total_s = round(time.perf_counter() - t0, 4)
+
+    fresh, known = baseline.split(findings)
+
+    if args.as_json:
+        report = {
+            "schema": 1,
+            "roots": [str(r) for r in roots],
+            "passes": list(passes or PASSES),
+            "findings": [f.to_dict() for f in fresh],
+            "baselined": [f.to_dict() for f in known],
+            "stats": {**stats, "total_seconds": total_s},
+            "ok": not fresh,
+        }
+        print(json.dumps(report, indent=2))
+    else:
+        for f in fresh:
+            print(f.render())
+        if known:
+            print(f"({len(known)} baselined finding(s) suppressed by "
+                  f"{baseline.path})")
+        if args.stats:
+            print(f"\n-- stats ({total_s}s total, "
+                  f"{stats['model']['files']} files, "
+                  f"{stats['model']['functions']} functions) --")
+            for name in (passes or PASSES):
+                row = stats[name]
+                print(f"  {name:<8} {row['findings']:>3} finding(s)  "
+                      f"{row['seconds']:.3f}s")
+        if not fresh:
+            n = len(passes or PASSES)
+            print(f"invariant gate clean: {n} pass(es), "
+                  f"{stats['model']['files']} files, {total_s}s")
+    for e in model.errors:
+        print(f"warning: {e}", file=sys.stderr)
+    return 1 if fresh else 0

@@ -1,0 +1,226 @@
+"""Pass 4 — fault-point registry conformance (ROADMAP invariant 5).
+
+``DEEPDFA_FAULTS`` schedules are pure functions of ``(seed, point, hit)``
+— which only holds if the *points* themselves are a closed, documented
+set. This pass pins four properties:
+
+- every ``faults.fire/raise_if/crash_if/active("<point>")`` call site
+  names a point declared in ``resilience.faults.KNOWN_POINTS`` — an
+  undeclared point is chaos that no schedule can arm deterministically;
+- every declared point is actually wired somewhere — a dead registry row
+  is documentation of a fault path that no longer exists;
+- every declared point is exercised by at least one ``pytest -m faults``
+  test (a point the battery never arms is an untested failure mode);
+- the ``DEEPDFA_FAULTS`` table in README.md between the
+  ``<!-- DEEPDFA_FAULTS:BEGIN -->`` / ``END`` markers matches the table
+  generated from ``faults.POINT_DOCS`` — docs and code cannot drift,
+  because the table is *generated* (``python -m deepdfa_tpu.analysis
+  --faults-table``) and this pass fails on any diff.
+
+When the scanned tree does not contain ``resilience/faults.py`` (fixture
+trees), the canonical in-package registry is used for the declared-set
+check and the registry-side checks are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .findings import Finding
+from .model import ProjectModel
+
+PASS_NAME = "faults"
+
+FAULTS_REL = "deepdfa_tpu/resilience/faults.py"
+TABLE_BEGIN = "<!-- DEEPDFA_FAULTS:BEGIN"
+TABLE_END = "<!-- DEEPDFA_FAULTS:END -->"
+
+_FIRE_TAILS = ("fire", "raise_if", "crash_if", "active")
+
+
+def _find_faults_module(model: ProjectModel):
+    for rel, info in model.modules.items():
+        if rel.endswith("resilience/faults.py"):
+            return info, True
+    return None, False
+
+
+def _canonical_faults_source() -> tuple[Path, str]:
+    import deepdfa_tpu
+
+    path = Path(deepdfa_tpu.__file__).parent / "resilience" / "faults.py"
+    return path, path.read_text()
+
+
+def _parse_registry(tree: ast.Module):
+    """(KNOWN_POINTS tuple, its line, POINT_DOCS dict, its line)."""
+    points: tuple[str, ...] = ()
+    docs: dict[str, str] = {}
+    points_line = docs_line = 1
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "KNOWN_POINTS" in names and isinstance(node.value, (ast.Tuple, ast.List)):
+            points = tuple(e.value for e in node.value.elts
+                           if isinstance(e, ast.Constant))
+            points_line = node.lineno
+        if "POINT_DOCS" in names and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(v, ast.Constant):
+                    docs[k.value] = v.value
+            docs_line = node.lineno
+    return points, points_line, docs, docs_line
+
+
+def render_faults_table(docs: dict[str, str] | None = None) -> str:
+    """The generated README markdown table — the single rendering both the
+    CLI (``--faults-table``) and the drift check use."""
+    if docs is None:
+        _, source = _canonical_faults_source()
+        _, _, docs, _ = _parse_registry(ast.parse(source))
+    width = max((len(p) for p in docs), default=5) + 2
+    lines = [
+        f"| {'point'.ljust(width)} | what firing it does |",
+        f"| {'-' * width} | ------------------- |",
+    ]
+    for point, doc in docs.items():
+        lines.append(f"| {('`' + point + '`').ljust(width)} | {doc} |")
+    return "\n".join(lines)
+
+
+def _collect_call_sites(model: ProjectModel):
+    """{point: [(rel, line)]} for every literal fault-point reference."""
+    sites: dict[str, list[tuple[str, int]]] = {}
+    for fn in model.functions.values():
+        rel = fn.module.rel
+        if (rel.endswith("resilience/faults.py")
+                or "deepdfa_tpu/analysis/" in rel):
+            continue
+        for cs in fn.calls:
+            tail = cs.name.rpartition(".")[2]
+            if tail not in _FIRE_TAILS:
+                continue
+            canon = fn.module.canonical(cs.name)
+            if "faults" not in canon:
+                continue
+            if not cs.node.args:
+                continue
+            arg = cs.node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                sites.setdefault(arg.value, []).append((rel, cs.line))
+    return sites
+
+
+def _chaos_covered_points(repo_root: Path) -> set[str]:
+    """Points referenced in at least one ``pytest -m faults`` test file."""
+    covered: set[str] = set()
+    tests = repo_root / "tests"
+    if not tests.is_dir():
+        return covered
+    for path in sorted(tests.glob("*.py")):
+        text = path.read_text()
+        if "mark.faults" not in text:
+            continue
+        # fault specs carry schedules ("step.hang@1", "joern.hang:p=.5"),
+        # so the point name may be followed by @ or : rather than the quote
+        for m in re.finditer(r'["\']([a-z_]+\.[a-z_]+)(?=[@:"\'])', text):
+            covered.add(m.group(1))
+    return covered
+
+
+def run(model: ProjectModel) -> list[Finding]:
+    findings: list[Finding] = []
+    info, in_tree = _find_faults_module(model)
+    if in_tree:
+        faults_rel = info.rel
+        tree = info.tree
+    else:
+        path, source = _canonical_faults_source()
+        faults_rel = FAULTS_REL
+        tree = ast.parse(source)
+    points, points_line, docs, docs_line = _parse_registry(tree)
+    known = set(points)
+    sites = _collect_call_sites(model)
+
+    for point, point_sites in sorted(sites.items()):
+        if point not in known:
+            rel, line = point_sites[0]
+            findings.append(Finding(
+                file=rel, line=line, invariant_id="fault-registry",
+                pass_name=PASS_NAME,
+                message=(
+                    f"fault point {point!r} is fired here but not declared "
+                    "in resilience.faults.KNOWN_POINTS — undeclared points "
+                    "cannot be armed deterministically (invariant 5); "
+                    "declare it (with a POINT_DOCS row) or remove it"),
+            ))
+
+    if not in_tree:
+        return findings  # fixture tree: registry-side checks need the repo
+
+    for point in points:
+        if point not in sites:
+            findings.append(Finding(
+                file=faults_rel, line=points_line,
+                invariant_id="fault-registry", pass_name=PASS_NAME,
+                message=(
+                    f"declared fault point {point!r} has no "
+                    "fire/raise_if/crash_if/active call site — the fault "
+                    "path it documents no longer exists; wire it or drop "
+                    "the registry row"),
+            ))
+
+    if set(docs) != known:
+        missing = sorted(known - set(docs))
+        extra = sorted(set(docs) - known)
+        findings.append(Finding(
+            file=faults_rel, line=docs_line, invariant_id="fault-registry",
+            pass_name=PASS_NAME,
+            message=(
+                f"POINT_DOCS and KNOWN_POINTS disagree (missing docs: "
+                f"{missing}, stale docs: {extra}) — the registry is the "
+                "single source of truth for the generated README table"),
+        ))
+
+    covered = _chaos_covered_points(model.repo_root)
+    for point in points:
+        if point not in covered:
+            findings.append(Finding(
+                file=faults_rel, line=points_line,
+                invariant_id="fault-registry", pass_name=PASS_NAME,
+                message=(
+                    f"fault point {point!r} is not referenced by any "
+                    "`pytest -m faults` test — an unarmed point is an "
+                    "untested failure mode; add a chaos test"),
+            ))
+
+    readme = model.repo_root / "README.md"
+    if readme.is_file():
+        text = readme.read_text()
+        begin, end = text.find(TABLE_BEGIN), text.find(TABLE_END)
+        if begin < 0 or end < 0:
+            findings.append(Finding(
+                file="README.md", line=1, invariant_id="fault-registry",
+                pass_name=PASS_NAME,
+                message=(
+                    "README.md has no DEEPDFA_FAULTS table markers "
+                    f"({TABLE_BEGIN} ... {TABLE_END}) — regenerate with "
+                    "`python -m deepdfa_tpu.analysis --faults-table`"),
+            ))
+        else:
+            current = text[text.index("\n", begin) + 1:end].strip()
+            expected = render_faults_table(docs)
+            if current != expected:
+                line = text[:begin].count("\n") + 1
+                findings.append(Finding(
+                    file="README.md", line=line,
+                    invariant_id="fault-registry", pass_name=PASS_NAME,
+                    message=(
+                        "README DEEPDFA_FAULTS table drifted from "
+                        "faults.POINT_DOCS — regenerate with "
+                        "`python -m deepdfa_tpu.analysis --faults-table`"),
+                ))
+    return findings

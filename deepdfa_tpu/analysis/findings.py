@@ -1,0 +1,68 @@
+"""The analyzer's output unit: one :class:`Finding` per invariant violation.
+
+Every pass emits findings with a stable ``invariant_id`` (the gate's
+vocabulary, mapped to the ROADMAP's standing invariants):
+
+==================  ========================================================
+``atomic-commit``   durable write outside the sideways-write + ``os.replace``
+                    protocol (invariants 1, 10)
+``lock-order``      lock acquisition-order cycle in the static lock graph
+``unguarded-state`` instance attribute written from a thread target and
+                    accessed elsewhere with no common lock
+``jit-purity``      host-impure construct reachable from a ``jax.jit`` /
+                    ``custom_vjp`` / ``shard_map`` entry
+``donation``        donated buffer reused after dispatch, or the same
+                    buffer donated twice in one call (the PR 6 deadlock)
+``fault-registry``  fault point not declared in ``faults.KNOWN_POINTS``,
+                    declared but never fired, chaos-uncovered, or drifted
+                    from the generated README table (invariant 5)
+``metrics``         metric family outside ``deepdfa_*`` naming or exposition
+                    rendered outside ``obs/registry.py`` (invariant 16)
+==================  ========================================================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["INVARIANT_IDS", "Finding"]
+
+INVARIANT_IDS = (
+    "atomic-commit",
+    "lock-order",
+    "unguarded-state",
+    "jit-purity",
+    "donation",
+    "fault-registry",
+    "metrics",
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation: ``file`` is repo-relative posix, ``line`` 1-based."""
+
+    file: str
+    line: int
+    invariant_id: str
+    message: str
+    pass_name: str = ""
+
+    def __post_init__(self):
+        if self.invariant_id not in INVARIANT_IDS:
+            raise ValueError(f"unknown invariant id {self.invariant_id!r}")
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.invariant_id}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "file": self.file,
+            "line": self.line,
+            "invariant": self.invariant_id,
+            "pass": self.pass_name,
+            "message": self.message,
+        }
+
+    def sort_key(self) -> tuple:
+        return (self.file, self.line, self.invariant_id, self.message)

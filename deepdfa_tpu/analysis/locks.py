@@ -1,0 +1,236 @@
+"""Pass 2 — lock discipline across the serving fleet (serve/, obs/,
+resilience/, cpg session layer).
+
+Two checks over the shared :class:`~deepdfa_tpu.analysis.model.ProjectModel`:
+
+**Lock-order cycles.** Every ``with self._lock:`` (and ``.acquire()``)
+site records the lock set already held; calls propagate the held set
+interprocedurally through the lite call graph, so ``A.f`` holding lock A
+while calling ``B.g`` which takes lock B yields edge A→B. A cycle in the
+resulting acquisition-order graph is a deadlock waiting for the right
+interleaving — the class of hang PR 6 shipped (and the reason the engine
+lock is an RLock). Re-acquiring the same non-reentrant lock is reported
+as a self-cycle; RLocks may self-nest.
+
+**Unguarded shared state.** An instance attribute *written* from a
+``threading.Thread`` target (or any method the target reaches through
+self-calls) and *accessed* from non-thread methods is flagged unless one
+common lock guards every one of those sites. Attributes that are
+themselves synchronization objects or known thread-safe containers
+(queues, deques, Events, Futures) are exempt, as are ``__init__``
+assignments — construction happens before the thread starts.
+
+Both checks prefer false negatives: an unresolvable receiver or dynamic
+call contributes no edges and no accesses.
+"""
+
+from __future__ import annotations
+
+from .findings import Finding
+from .model import ClassInfo, ProjectModel
+
+PASS_NAME = "locks"
+
+# modules this pass analyzes: the threaded serving/observability planes
+SCOPE = ("/serve/", "/obs/", "/resilience/", "joern_session", "prefetch",
+         "lock", "thread")
+
+_SAFE_ATTR_CTORS = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "collections.deque", "threading.Event",
+    "threading.Thread", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "concurrent.futures.Future",
+    "concurrent.futures.ThreadPoolExecutor",
+}
+
+
+def _in_scope(rel: str) -> bool:
+    return any(pat in rel for pat in SCOPE)
+
+
+# -- lock-order graph --------------------------------------------------------
+
+
+def _collect_edges(model: ProjectModel, scoped_keys: list[str]):
+    """(a, b) -> (file, line) witness: lock b acquired while a is held."""
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    self_reacquire: dict[str, tuple[str, int, str]] = {}
+    memo: set[tuple[str, tuple[str, ...]]] = set()
+
+    def visit(key: str, held: tuple[str, ...], stack: frozenset) -> None:
+        state = (key, held)
+        if state in memo or key in stack:
+            return
+        memo.add(state)
+        fn = model.functions[key]
+        rel = fn.module.rel
+        for lu in fn.lock_uses:
+            total_held = tuple(dict.fromkeys(held + lu.held))
+            for h in total_held:
+                if h == lu.lock:
+                    # Condition() wraps an RLock by default; aliased
+                    # conditions already canonicalize to the wrapped lock
+                    if lu.kind == "lock":
+                        self_reacquire.setdefault(
+                            lu.lock, (rel, lu.line, fn.name))
+                elif (h, lu.lock) not in edges:
+                    edges[(h, lu.lock)] = (rel, lu.line)
+        for cs in fn.calls:
+            callee = model.resolve_call(fn, cs.name)
+            if callee is None:
+                continue
+            carried = tuple(dict.fromkeys(held + cs.held))
+            visit(callee.key, carried, stack | {key})
+
+    for key in scoped_keys:
+        visit(key, (), frozenset())
+    return edges, self_reacquire
+
+
+def _find_cycles(edges: dict[tuple[str, str], tuple[str, int]]):
+    """Distinct simple cycles in the lock graph (each reported once,
+    rotated to its lexicographically smallest node)."""
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: dict[tuple[str, ...], list[str]] = {}
+
+    def dfs(node: str, path: list[str], on_path: set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_path:
+                cyc = path[path.index(nxt):]
+                i = cyc.index(min(cyc))
+                canon = tuple(cyc[i:] + cyc[:i])
+                cycles.setdefault(canon, cyc)
+            elif len(path) < 16:
+                dfs(nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return list(cycles)
+
+
+# -- unguarded shared state --------------------------------------------------
+
+
+def _thread_reach(model: ProjectModel, ci: ClassInfo,
+                  entry_keys: list[str]) -> set[str]:
+    """Method keys reachable from thread entries via self-calls."""
+    seen: set[str] = set()
+    work = list(entry_keys)
+    while work:
+        key = work.pop()
+        if key in seen or key not in model.functions:
+            continue
+        seen.add(key)
+        fn = model.functions[key]
+        for cs in fn.calls:
+            if cs.name.startswith("self."):
+                nxt = ci.methods.get(cs.name.split(".")[1])
+                if nxt and nxt not in seen:
+                    work.append(nxt)
+        work.extend(k for k in fn.nested.values() if k not in seen)
+    return seen
+
+
+def _internally_synced(model: ProjectModel, ci: ClassInfo, attr: str) -> bool:
+    """True when ``attr`` holds an instance of a project class that guards
+    itself — it declares a lock attribute, so mutator calls like
+    ``self.ring.add(...)`` synchronize internally (e.g. ``HashRing``)."""
+    cls_name = ci.attr_classes.get(attr)
+    if not cls_name:
+        return False
+    target = model.find_class(cls_name)
+    return bool(target is not None and target.lock_attrs)
+
+
+def _shared_state_findings(model: ProjectModel) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel, info in model.modules.items():
+        if not _in_scope(rel):
+            continue
+        for ci in info.classes.values():
+            entries = [k for m, k in ci.methods.items()
+                       if k in model.thread_targets]
+            if not entries:
+                continue
+            reach = _thread_reach(model, ci, entries)
+            skip_attrs = (set(ci.lock_attrs) | set(ci.lock_aliases)
+                          | {a for a, c in ci.attr_ctors.items()
+                             if c in _SAFE_ATTR_CTORS}
+                          | {a for a in ci.attr_classes
+                             if _internally_synced(model, ci, a)})
+            thread_sites: dict[str, list] = {}
+            other_sites: dict[str, list] = {}
+            for name, key in ci.methods.items():
+                if name == "__init__":
+                    continue
+                fn = model.functions.get(key)
+                if fn is None:
+                    continue
+                keys = [key] + list(fn.nested.values())
+                for k in keys:
+                    sub = model.functions.get(k)
+                    if sub is None:
+                        continue
+                    bucket = thread_sites if k in reach else other_sites
+                    for acc in sub.attr_accesses:
+                        if acc.attr in skip_attrs:
+                            continue
+                        if k in reach and not acc.write:
+                            continue  # thread-side reads alone are benign
+                        bucket.setdefault(acc.attr, []).append(
+                            (sub, acc))
+            for attr, t_sites in sorted(thread_sites.items()):
+                o_sites = other_sites.get(attr)
+                if not o_sites:
+                    continue
+                held_sets = [set(acc.held) for _, acc in t_sites + o_sites]
+                common = set.intersection(*held_sets) if held_sets else set()
+                if common:
+                    continue
+                fn, acc = t_sites[0]
+                others = ", ".join(sorted({f.name for f, _ in o_sites}))
+                findings.append(Finding(
+                    file=rel, line=acc.line, invariant_id="unguarded-state",
+                    pass_name=PASS_NAME,
+                    message=(
+                        f"{ci.name}.{attr} is written from thread target "
+                        f"path {fn.name}() and accessed from {others}() "
+                        "with no common lock — a torn read/lost update "
+                        "race; guard both sides with one lock"),
+                ))
+    return findings
+
+
+def run(model: ProjectModel) -> list[Finding]:
+    scoped_keys = [k for k, fn in model.functions.items()
+                   if _in_scope(fn.module.rel)]
+    edges, self_reacquire = _collect_edges(model, scoped_keys)
+    findings: list[Finding] = []
+    for lock, (rel, line, fn_name) in sorted(self_reacquire.items()):
+        findings.append(Finding(
+            file=rel, line=line, invariant_id="lock-order",
+            pass_name=PASS_NAME,
+            message=(
+                f"non-reentrant lock {lock} re-acquired while already held "
+                f"(via {fn_name}()) — self-deadlock; use an RLock or hoist "
+                "the acquisition"),
+        ))
+    for cyc in _find_cycles(edges):
+        witness = edges.get((cyc[0], cyc[1 % len(cyc)]))
+        if witness is None:
+            witness = next(v for (a, b), v in edges.items() if a == cyc[0])
+        rel, line = witness
+        order = " -> ".join([*cyc, cyc[0]])
+        findings.append(Finding(
+            file=rel, line=line, invariant_id="lock-order",
+            pass_name=PASS_NAME,
+            message=(
+                f"lock acquisition-order cycle {order} — two threads "
+                "entering from opposite ends deadlock; impose one global "
+                "acquisition order"),
+        ))
+    findings.extend(_shared_state_findings(model))
+    return findings

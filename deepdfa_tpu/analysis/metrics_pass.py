@@ -1,0 +1,127 @@
+"""Pass 5 — metrics conformance (ROADMAP invariant 16).
+
+All scrape endpoints render through ``obs/registry.py``: one
+``# HELP`` + ``# TYPE`` per family, everything under the ``deepdfa_*``
+namespace. The seed shipped exactly the bug this prevents — a
+hand-rolled formatter emitting a duplicate ``# TYPE`` line before every
+labeled sample, which strict Prometheus parsers reject. Three checks:
+
+- every ``MetricsRegistry(prefix=...)`` construction uses a literal
+  prefix starting with ``deepdfa_`` (the registry prepends it to every
+  family, so this IS the namespace check);
+- no family declaration (``.counter("name")`` / ``.gauge`` /
+  ``.histogram``) carries the prefix itself (double-prefixing) or an
+  invalid Prometheus name;
+- no module outside ``obs/registry.py`` builds exposition text by hand —
+  any non-docstring string constant containing ``# HELP`` or ``# TYPE``
+  is a formatter the conformance test cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .findings import Finding
+from .model import ProjectModel
+
+PASS_NAME = "metrics"
+
+_FAMILY_DECLS = ("counter", "gauge", "histogram")
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+# registry.py renders exposition; the analyzer itself names the needles
+# (the package path, NOT bare "/analysis/" — fixture trees live under
+# tests/fixtures/analysis/ and must stay scannable)
+_EXEMPT = ("obs/registry.py", "deepdfa_tpu/analysis/")
+
+
+def _exposition_findings(model: ProjectModel) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel, info in model.modules.items():
+        if any(pat in rel for pat in _EXEMPT):
+            continue
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if node.lineno in info.docstring_lines:
+                continue
+            if "# HELP" in node.value or "# TYPE" in node.value:
+                findings.append(Finding(
+                    file=rel, line=node.lineno, invariant_id="metrics",
+                    pass_name=PASS_NAME,
+                    message=(
+                        "hand-rolled Prometheus exposition (literal "
+                        "'# HELP'/'# TYPE') — all endpoints must render "
+                        "through obs.registry.MetricsRegistry so the "
+                        "conformance test covers them (invariant 16)"),
+                ))
+    return findings
+
+
+def _literal_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def run(model: ProjectModel) -> list[Finding]:
+    findings = _exposition_findings(model)
+    for fn in model.functions.values():
+        rel = fn.module.rel
+        if any(pat in rel for pat in _EXEMPT):
+            continue
+        for cs in fn.calls:
+            canon = fn.module.canonical(cs.name)
+            # registry constructions: the prefix IS the namespace
+            if canon.rpartition(".")[2] == "MetricsRegistry":
+                prefix = None
+                if cs.node.args:
+                    prefix = _literal_str(cs.node.args[0])
+                for kw in cs.node.keywords:
+                    if kw.arg == "prefix":
+                        prefix = _literal_str(kw.value)
+                if prefix is not None and not prefix.startswith("deepdfa_"):
+                    findings.append(Finding(
+                        file=rel, line=cs.line, invariant_id="metrics",
+                        pass_name=PASS_NAME,
+                        message=(
+                            f"MetricsRegistry prefix {prefix!r} is outside "
+                            "the deepdfa_* namespace — every exported "
+                            "family must be deepdfa_*-named "
+                            "(invariant 16)"),
+                    ))
+                continue
+            # family declarations: .counter("name", ...) etc.
+            tail = cs.name.rpartition(".")[2]
+            if tail not in _FAMILY_DECLS or "." not in cs.name:
+                continue
+            if not cs.node.args:
+                continue
+            name = _literal_str(cs.node.args[0])
+            if name is None:
+                continue
+            # require help text too, so unrelated .counter() calls on
+            # non-registry receivers don't false-positive
+            help_given = len(cs.node.args) >= 2 or any(
+                kw.arg in ("help_", "help") for kw in cs.node.keywords)
+            if not help_given:
+                continue
+            if name.startswith("deepdfa_"):
+                findings.append(Finding(
+                    file=rel, line=cs.line, invariant_id="metrics",
+                    pass_name=PASS_NAME,
+                    message=(
+                        f"family {name!r} carries the deepdfa_ prefix "
+                        "itself — the registry prepends its prefix, so "
+                        "this renders double-prefixed"),
+                ))
+            elif not _NAME_RE.match(name):
+                findings.append(Finding(
+                    file=rel, line=cs.line, invariant_id="metrics",
+                    pass_name=PASS_NAME,
+                    message=(
+                        f"family {name!r} is not a valid Prometheus "
+                        "metric name ([a-z][a-z0-9_]*)"),
+                ))
+    return findings

@@ -1,0 +1,523 @@
+"""Shared AST project model for the invariant-gate passes.
+
+One parse of the tree, five passes over the result. The model is
+deliberately *lite* — the same posture as the CPG toolchain's monotone
+framework (``cpg/analyses.py``): sound enough to mechanize the roadmap's
+standing invariants on THIS codebase, not a general-purpose Python
+analyzer. Concretely it indexes, per module:
+
+- every function/method (including nested defs) with its call sites,
+  ``self.<attr>`` reads/writes, lock acquisitions and the lock set held
+  lexically at each of those program points;
+- every class with its ``__init__``-assigned attribute constructors
+  (``self._lock = threading.Lock()`` → a lock attribute; ``Condition(x)``
+  aliases the lock it wraps) and parameter-annotation-derived attribute
+  types (``registry: "MetricsRegistry"`` → ``self.registry`` resolves
+  cross-class lock paths like ``self.registry._lock``);
+- an import map so dotted names canonicalize (``jnp.dot`` →
+  ``jax.numpy.dot``, ``faults.fire`` →
+  ``deepdfa_tpu.resilience.faults.fire``);
+- thread entry points (``threading.Thread(target=self._run)``).
+
+Call resolution walks nested scope → module scope → imported project
+modules; unresolved calls (third-party, dynamic) resolve to ``None`` and
+the passes treat them as opaque — false negatives over false positives,
+the right polarity for a commit gate.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+__all__ = [
+    "AttrAccess", "CallSite", "ClassInfo", "FunctionInfo", "LockUse",
+    "ModuleInfo", "ProjectModel", "dotted_name",
+]
+
+# threading constructors that make an instance attribute a lock
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+}
+
+# attribute types that are safe to share across threads without a lock
+_THREADSAFE_CTORS = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "collections.deque", "threading.Event",
+    "threading.Thread", "threading.Lock", "threading.RLock",
+    "threading.Condition", "threading.Semaphore", "threading.Barrier",
+    "concurrent.futures.Future", "Future",
+}
+
+# method calls that mutate their receiver — `self.x.append(...)` is a write
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popleft", "remove", "clear",
+    "add", "discard", "update", "setdefault", "sort",
+}
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class CallSite:
+    name: str                     # dotted name as written
+    node: ast.Call
+    line: int
+    held: tuple[str, ...]         # lock ids held lexically at the call
+
+
+@dataclass
+class LockUse:
+    lock: str                     # canonical id, e.g. "MicroBatcher._lock"
+    line: int
+    held: tuple[str, ...]         # held BEFORE this acquisition
+    kind: str                     # lock | rlock | condition | unknown
+
+
+@dataclass
+class AttrAccess:
+    attr: str
+    line: int
+    held: tuple[str, ...]
+    write: bool
+
+
+@dataclass
+class FunctionInfo:
+    key: str                      # "<rel path>::<Class.>name[.<locals>...]"
+    name: str
+    module: "ModuleInfo"
+    node: ast.AST
+    class_name: str | None = None
+    parent: str | None = None     # enclosing function key for nested defs
+    decorators: list[str] = field(default_factory=list)
+    calls: list[CallSite] = field(default_factory=list)
+    lock_uses: list[LockUse] = field(default_factory=list)
+    attr_accesses: list[AttrAccess] = field(default_factory=list)
+    globals_written: list[tuple[str, int]] = field(default_factory=list)
+    nested: dict[str, str] = field(default_factory=dict)  # name -> key
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    line: int
+    methods: dict[str, str] = field(default_factory=dict)   # name -> fn key
+    attr_ctors: dict[str, str] = field(default_factory=dict)  # attr -> ctor
+    attr_classes: dict[str, str] = field(default_factory=dict)  # attr -> cls
+    lock_attrs: dict[str, str] = field(default_factory=dict)  # attr -> kind
+    lock_aliases: dict[str, str] = field(default_factory=dict)  # cond -> lock
+
+    def canonical_lock(self, attr: str) -> str | None:
+        """Canonical lock attr for ``attr`` (Condition(x) aliases x's
+        lock), or None when ``attr`` is not a lock of this class."""
+        attr = self.lock_aliases.get(attr, attr)
+        return attr if attr in self.lock_attrs else None
+
+
+@dataclass
+class ModuleInfo:
+    path: Path
+    rel: str                      # repo-relative posix path
+    name: str                     # dotted module name
+    tree: ast.Module
+    source: str
+    imports: dict[str, str] = field(default_factory=dict)  # alias -> dotted
+    functions: dict[str, str] = field(default_factory=dict)  # bare -> key
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    docstring_lines: set[int] = field(default_factory=set)
+
+    def canonical(self, name: str) -> str:
+        """Expand the leading segment of ``name`` through the import map."""
+        head, _, rest = name.partition(".")
+        target = self.imports.get(head)
+        if target is None:
+            return name
+        return f"{target}.{rest}" if rest else target
+
+
+class ProjectModel:
+    """Parsed modules + indexes; built once, shared by every pass."""
+
+    def __init__(self, repo_root: Path):
+        self.repo_root = Path(repo_root)
+        self.modules: dict[str, ModuleInfo] = {}      # rel path -> info
+        self.by_name: dict[str, ModuleInfo] = {}      # dotted -> info
+        self.functions: dict[str, FunctionInfo] = {}  # key -> info
+        self.thread_targets: set[str] = set()         # function keys
+        self.errors: list[tuple[str, str]] = []       # (rel, message)
+        # Thread(target=...) sites, resolved only after every function is
+        # indexed — __init__ usually precedes the target method in the body
+        self._pending_thread_targets: list[tuple["FunctionInfo", str]] = []
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def build(cls, repo_root: Path, roots: list[Path]) -> "ProjectModel":
+        model = cls(repo_root)
+        files: list[Path] = []
+        for root in roots:
+            root = Path(root)
+            if root.is_file():
+                files.append(root)
+            else:
+                files.extend(p for p in sorted(root.rglob("*.py"))
+                             if "__pycache__" not in p.parts)
+        for path in files:
+            model._parse(path)
+        for info in model.modules.values():
+            model._index_classes(info)
+        for info in model.modules.values():
+            _FunctionVisitor(model, info).visit(info.tree)
+        for fn, name in model._pending_thread_targets:
+            callee = model.resolve_call(fn, name)
+            if callee is not None:
+                model.thread_targets.add(callee.key)
+        return model
+
+    def _parse(self, path: Path) -> None:
+        try:
+            rel = path.resolve().relative_to(self.repo_root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError) as exc:
+            self.errors.append((rel, str(exc)))
+            return
+        name = rel[:-3].replace("/", ".")
+        info = ModuleInfo(path=path, rel=rel, name=name, tree=tree,
+                          source=source)
+        self._collect_imports(info)
+        self._collect_docstrings(info)
+        self.modules[rel] = info
+        self.by_name[name] = info
+
+    def _collect_imports(self, info: ModuleInfo) -> None:
+        package = info.name.rpartition(".")[0]
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    info.imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0])
+                    if alias.asname:
+                        info.imports[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    parts = package.split(".") if package else []
+                    parts = parts[: len(parts) - (node.level - 1)]
+                    base = ".".join(parts + ([node.module] if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    info.imports[alias.asname or alias.name] = (
+                        f"{base}.{alias.name}" if base else alias.name)
+
+    def _collect_docstrings(self, info: ModuleInfo) -> None:
+        """Line ranges of docstring constants — the metrics pass must not
+        mistake prose mentioning ``# TYPE`` for hand-rolled exposition."""
+        nodes = [info.tree] + [
+            n for n in ast.walk(info.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+        ]
+        for n in nodes:
+            body = getattr(n, "body", [])
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                c = body[0].value
+                for ln in range(c.lineno, (c.end_lineno or c.lineno) + 1):
+                    info.docstring_lines.add(ln)
+
+    def _index_classes(self, info: ModuleInfo) -> None:
+        for node in info.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = ClassInfo(name=node.name, module=info, line=node.lineno)
+            info.classes[node.name] = ci
+            init = next((m for m in node.body
+                         if isinstance(m, ast.FunctionDef)
+                         and m.name == "__init__"), None)
+            ann: dict[str, str] = {}
+            if init is not None:
+                for arg in init.args.args + init.args.kwonlyargs:
+                    if arg.annotation is not None:
+                        label = _annotation_name(arg.annotation)
+                        if label:
+                            ann[arg.arg] = label
+                for stmt in ast.walk(init):
+                    if not isinstance(stmt, ast.Assign):
+                        continue
+                    for target in stmt.targets:
+                        if (isinstance(target, ast.Attribute)
+                                and isinstance(target.value, ast.Name)
+                                and target.value.id == "self"):
+                            self._record_attr_init(info, ci, target.attr,
+                                                   stmt.value, ann)
+
+    def _record_attr_init(self, info: ModuleInfo, ci: ClassInfo, attr: str,
+                          value: ast.AST, ann: dict[str, str]) -> None:
+        if isinstance(value, ast.Call):
+            ctor = dotted_name(value.func)
+            if ctor is None:
+                return
+            canon = info.canonical(ctor)
+            ci.attr_ctors[attr] = canon
+            kind = _LOCK_CTORS.get(canon)
+            if kind is not None:
+                ci.lock_attrs[attr] = kind
+                if kind == "condition" and value.args:
+                    inner = dotted_name(value.args[0])
+                    if inner and inner.startswith("self."):
+                        ci.lock_aliases[attr] = inner[5:]
+                        ci.lock_attrs.pop(attr, None)
+            else:
+                ci.attr_classes[attr] = canon.rpartition(".")[2]
+        elif isinstance(value, ast.Name) and value.id in ann:
+            ci.attr_classes[attr] = ann[value.id]
+
+    # -- queries ------------------------------------------------------------
+
+    def find_class(self, name: str) -> ClassInfo | None:
+        for info in self.modules.values():
+            if name in info.classes:
+                return info.classes[name]
+        return None
+
+    def resolve_call(self, fn: FunctionInfo, name: str) -> FunctionInfo | None:
+        """Resolve a call site's dotted name to a project function, walking
+        ``self.<method>``, nested scopes, module scope, then imports."""
+        if name.startswith("self.") and fn.class_name:
+            ci = fn.module.classes.get(fn.class_name)
+            if ci is not None:
+                key = ci.methods.get(name[5:])
+                return self.functions.get(key) if key else None
+            return None
+        # nested scope chain
+        cur: FunctionInfo | None = fn
+        while cur is not None:
+            key = cur.nested.get(name)
+            if key:
+                return self.functions.get(key)
+            cur = self.functions.get(cur.parent) if cur.parent else None
+        key = fn.module.functions.get(name)
+        if key:
+            return self.functions.get(key)
+        canon = fn.module.canonical(name)
+        mod_name, _, func = canon.rpartition(".")
+        target = self.by_name.get(mod_name)
+        if target is not None:
+            key = target.functions.get(func)
+            if key:
+                return self.functions.get(key)
+        return None
+
+    def reachable(self, entry_keys: list[str]) -> dict[str, str]:
+        """Transitive closure over resolvable calls: ``{key: via}`` where
+        ``via`` is the entry key the function was first reached from."""
+        seen: dict[str, str] = {}
+        work = [(k, k) for k in entry_keys if k in self.functions]
+        while work:
+            key, via = work.pop()
+            if key in seen:
+                continue
+            seen[key] = via
+            fn = self.functions[key]
+            for cs in fn.calls:
+                callee = self.resolve_call(fn, cs.name)
+                if callee is not None and callee.key not in seen:
+                    work.append((callee.key, via))
+        return seen
+
+
+def _annotation_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.rpartition(".")[2]
+    name = dotted_name(node)
+    return name.rpartition(".")[2] if name else None
+
+
+class _FunctionVisitor(ast.NodeVisitor):
+    """Phase-2 walk: fills FunctionInfo records with calls, attr accesses,
+    lock acquisitions (with held-set tracking) and thread targets."""
+
+    def __init__(self, model: ProjectModel, info: ModuleInfo):
+        self.model = model
+        self.info = info
+        self.class_stack: list[str] = []
+        self.fn_stack: list[FunctionInfo] = []
+        self.held: tuple[str, ...] = ()
+
+    # -- scope bookkeeping --------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _enter_function(self, node) -> None:
+        cls = self.class_stack[-1] if self.class_stack else None
+        parent = self.fn_stack[-1] if self.fn_stack else None
+        if parent is not None:
+            qual = f"{parent.key.split('::', 1)[1]}.<locals>.{node.name}"
+            cls = parent.class_name  # closures keep `self` of the method
+        else:
+            qual = f"{cls}.{node.name}" if cls else node.name
+        key = f"{self.info.rel}::{qual}"
+        fn = FunctionInfo(
+            key=key, name=node.name, module=self.info, node=node,
+            class_name=cls, parent=parent.key if parent else None,
+            decorators=[d for d in
+                        (dotted_name(dec.func if isinstance(dec, ast.Call)
+                                     else dec)
+                         for dec in node.decorator_list) if d],
+        )
+        self.model.functions[key] = fn
+        if parent is not None:
+            parent.nested[node.name] = key
+        elif self.class_stack:
+            ci = self.info.classes.get(cls)
+            if ci is not None:
+                ci.methods[node.name] = key
+        else:
+            self.info.functions[node.name] = key
+        outer_held, self.held = self.held, ()
+        self.fn_stack.append(fn)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.fn_stack.pop()
+        self.held = outer_held
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_function(node)
+
+    # -- locks --------------------------------------------------------------
+
+    def _lock_id(self, expr: ast.AST) -> tuple[str, str] | None:
+        """(canonical id, kind) when ``expr`` denotes a project lock."""
+        name = dotted_name(expr)
+        if name is None or not name.startswith("self."):
+            return None
+        parts = name.split(".")[1:]
+        cls = self.fn_stack[-1].class_name if self.fn_stack else None
+        if cls is None:
+            return None
+        ci = self.info.classes.get(cls)
+        if ci is None:
+            return None
+        if len(parts) == 1:
+            canon = ci.canonical_lock(parts[0])
+            if canon is None:
+                return None
+            return f"{ci.name}.{canon}", ci.lock_attrs[canon]
+        if len(parts) == 2:
+            # self.<attr>.<lock> — resolve <attr>'s class project-wide
+            owner_name = ci.attr_classes.get(parts[0])
+            owner = (self.model.find_class(owner_name)
+                     if owner_name else None)
+            if owner is not None:
+                canon = owner.canonical_lock(parts[1])
+                if canon is not None:
+                    return f"{owner.name}.{canon}", owner.lock_attrs[canon]
+            if parts[1].lstrip("_").startswith(("lock", "cond", "wake", "mutex")):
+                return f"{cls}.{'.'.join(parts)}", "unknown"
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        fn = self.fn_stack[-1] if self.fn_stack else None
+        acquired: list[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            lock = self._lock_id(item.context_expr)
+            if lock is not None and fn is not None:
+                lock_id, kind = lock
+                fn.lock_uses.append(LockUse(lock=lock_id, line=item.context_expr.lineno,
+                                            held=self.held, kind=kind))
+                acquired.append(lock_id)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        outer = self.held
+        for lock_id in acquired:
+            if lock_id not in self.held:
+                self.held = self.held + (lock_id,)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = outer
+
+    # -- calls / attributes / globals ---------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = self.fn_stack[-1] if self.fn_stack else None
+        name = dotted_name(node.func)
+        if fn is not None and name is not None:
+            fn.calls.append(CallSite(name=name, node=node, line=node.lineno,
+                                     held=self.held))
+            # `self.x.append(...)` mutates self.x
+            if (name.startswith("self.") and name.count(".") == 2
+                    and name.rpartition(".")[2] in _MUTATORS):
+                fn.attr_accesses.append(AttrAccess(
+                    attr=name.split(".")[1], line=node.lineno,
+                    held=self.held, write=True))
+            # `self._lock.acquire()` is an acquisition site too
+            if name.startswith("self.") and name.endswith(".acquire"):
+                lock = self._lock_id(node.func.value)
+                if lock is not None:
+                    fn.lock_uses.append(LockUse(lock=lock[0], line=node.lineno,
+                                                held=self.held, kind=lock[1]))
+            if name in ("threading.Thread", "Thread") or (
+                    self.info.canonical(name) == "threading.Thread"):
+                self._record_thread_target(node)
+        self.generic_visit(node)
+
+    def _record_thread_target(self, node: ast.Call) -> None:
+        target = next((kw.value for kw in node.keywords
+                       if kw.arg == "target"), None)
+        if target is None:
+            return
+        name = dotted_name(target)
+        if name is None:
+            return
+        fn = self.fn_stack[-1] if self.fn_stack else None
+        if fn is None:
+            return
+        self.model._pending_thread_targets.append((fn, name))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        fn = self.fn_stack[-1] if self.fn_stack else None
+        if (fn is not None and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            fn.attr_accesses.append(AttrAccess(
+                attr=node.attr, line=node.lineno, held=self.held,
+                write=isinstance(node.ctx, (ast.Store, ast.Del))))
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        fn = self.fn_stack[-1] if self.fn_stack else None
+        if fn is not None:
+            for name in node.names:
+                fn.globals_written.append((name, node.lineno))

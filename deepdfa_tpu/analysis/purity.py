@@ -1,0 +1,293 @@
+"""Pass 3 — JAX purity and donation safety (the PR 6 deadlock class).
+
+**Purity.** Functions reachable from a ``jax.jit`` / ``jax.custom_vjp`` /
+``shard_map`` entry are traced, not executed: a ``time.time()`` or
+``random.random()`` call freezes its trace-time value into the compiled
+program, a ``print`` fires once per compile, and global mutation
+desynchronizes host and device state. Entries are found syntactically —
+``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorators,
+``jax.jit(f)`` / ``custom_vjp(f)`` / ``shard_map(f, ...)`` call forms and
+``f.defvjp(fwd, bwd)`` registrations — then the lite call graph is walked
+transitively; unresolvable callees (jnp, flax, closures over params) are
+opaque, so the check under-reports rather than false-alarms.
+
+**Donation.** ``donate_argnums`` marks an input buffer as consumed by the
+dispatch: the XLA runtime may alias it into the output, and the host-side
+array is dead the moment the call is issued. Two static violations:
+
+- the same variable passed in two donated positions of one call — XLA
+  deadlocks or miscompiles on the aliased buffer (PR 6 shipped exactly
+  this via ``ConfusionState.zeros()`` handing four views of one buffer);
+- a donated variable read again after the donating call without being
+  rebound — a use of a deleted buffer that surfaces as
+  ``RuntimeError: Array has been deleted`` (or a hang) far from the
+  dispatch. The canonical ``state = step(state, ...)`` rebinding pattern
+  is recognized: a store at or after the call line clears the taint.
+
+Donation info propagates through factory functions that *return* a
+donating jit (``make_dp_train_step`` → its callers' call sites are
+checked too).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding
+from .model import FunctionInfo, ProjectModel, dotted_name
+
+PASS_NAME = "jax"
+
+_IMPURE_CALLS = {
+    "time.time": "wall-clock read",
+    "time.monotonic": "wall-clock read",
+    "time.perf_counter": "wall-clock read",
+    "time.process_time": "wall-clock read",
+    "time.sleep": "host sleep",
+    "print": "host I/O",
+    "input": "host I/O",
+    "open": "host I/O",
+}
+_IMPURE_PREFIXES = {
+    "random.": "host RNG (stdlib random)",
+    "numpy.random.": "host RNG (numpy)",
+    "os.environ": "environment access",
+}
+# jax's own host-callback escape hatches are designed for impurity
+_CALLBACK_SAFE = ("jax.debug.", "jax.experimental.io_callback",
+                  "jax.pure_callback", "jax.experimental.checkify")
+
+
+def _canon(fn: FunctionInfo, name: str) -> str:
+    canon = fn.module.canonical(name)
+    # normalize the numpy alias family ("np.random.x" -> "numpy.random.x")
+    if canon.startswith("np."):
+        canon = "numpy." + canon[3:]
+    return canon
+
+
+# -- entry detection ---------------------------------------------------------
+
+
+def _is_jit_ctor(fn: FunctionInfo, call: ast.Call) -> tuple[bool, tuple[int, ...]]:
+    """(is jax.jit/custom_vjp/shard_map call, donate_argnums literal)."""
+    name = dotted_name(call.func)
+    if name is None:
+        return False, ()
+    canon = _canon(fn, name)
+    if canon == "functools.partial" and call.args:
+        inner = dotted_name(call.args[0])
+        if inner and _canon(fn, inner) in ("jax.jit", "jax.custom_vjp"):
+            return True, _donate_argnums(call)
+        return False, ()
+    if canon in ("jax.jit", "jax.custom_vjp") or canon.endswith("shard_map"):
+        return True, _donate_argnums(call)
+    return False, ()
+
+
+def _donate_argnums(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        out.append(elt.value)
+                return tuple(out)
+    return ()
+
+
+def _collect_entries(model: ProjectModel):
+    """Jit-entry function keys + donation sites.
+
+    Returns ``(entries, donating_names, donating_factories)`` where
+    ``entries`` maps function key -> description of how it became an
+    entry; ``donating_names`` maps (scope key, bound name) -> argnums for
+    ``f = jax.jit(g, donate_argnums=...)`` bindings; and
+    ``donating_factories`` maps factory function key -> argnums for
+    functions returning a donating jit.
+    """
+    entries: dict[str, str] = {}
+    donating_names: dict[tuple[str, str], tuple[int, ...]] = {}
+    donating_factories: dict[str, tuple[int, ...]] = {}
+
+    for fn in model.functions.values():
+        # decorator forms on the def itself
+        for dec in fn.node.decorator_list:
+            call = dec if isinstance(dec, ast.Call) else None
+            name = dotted_name(dec.func if call else dec)
+            if name is None:
+                continue
+            canon = _canon(fn, name)
+            is_entry = canon in ("jax.jit", "jax.custom_vjp")
+            donate: tuple[int, ...] = ()
+            if call is not None:
+                is_entry, donate = _is_jit_ctor(fn, call)
+            if is_entry:
+                entries.setdefault(fn.key, f"@{name}")
+                if donate:
+                    scope = fn.parent or fn.module.rel
+                    donating_names[(scope, fn.name)] = donate
+        # call forms inside the body
+        for cs in fn.calls:
+            is_ctor, donate = _is_jit_ctor(fn, cs.node)
+            if is_ctor and cs.node.args:
+                target = dotted_name(cs.node.args[0])
+                if target:
+                    callee = model.resolve_call(fn, target)
+                    if callee is not None:
+                        entries.setdefault(
+                            callee.key, f"{cs.name}(...) at {fn.module.rel}:{cs.line}")
+            # f.defvjp(fwd, bwd) registers more traced functions
+            if cs.name.endswith(".defvjp"):
+                for arg in cs.node.args:
+                    target = dotted_name(arg)
+                    callee = model.resolve_call(fn, target) if target else None
+                    if callee is not None:
+                        entries.setdefault(callee.key, f"defvjp at {fn.module.rel}:{cs.line}")
+
+    # bindings and factories need assignment context: walk each function body
+    for fn in model.functions.values():
+        for stmt in ast.walk(fn.node):
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                is_ctor, donate = _is_jit_ctor(fn, stmt.value)
+                if is_ctor and donate:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            donating_names[(fn.key, t.id)] = donate
+            if isinstance(stmt, ast.Return) and isinstance(stmt.value, ast.Call):
+                is_ctor, donate = _is_jit_ctor(fn, stmt.value)
+                if is_ctor and donate:
+                    donating_factories[fn.key] = donate
+    return entries, donating_names, donating_factories
+
+
+# -- purity ------------------------------------------------------------------
+
+
+def _purity_findings(model: ProjectModel, entries: dict[str, str]) -> list[Finding]:
+    findings: list[Finding] = []
+    reached = model.reachable(list(entries))
+    for key, via in sorted(reached.items()):
+        fn = model.functions[key]
+        entry_desc = entries.get(via, via)
+        for cs in fn.calls:
+            canon = _canon(fn, cs.name)
+            if any(canon.startswith(p) for p in _CALLBACK_SAFE):
+                continue
+            why = _IMPURE_CALLS.get(canon)
+            if why is None:
+                why = next((w for p, w in _IMPURE_PREFIXES.items()
+                            if canon.startswith(p)), None)
+            if why is None:
+                continue
+            findings.append(Finding(
+                file=fn.module.rel, line=cs.line, invariant_id="jit-purity",
+                pass_name=PASS_NAME,
+                message=(
+                    f"{cs.name}(...) in {fn.name}() is {why}, but "
+                    f"{fn.name}() is traced under a jit entry "
+                    f"({entry_desc}) — the value freezes at trace time; "
+                    "hoist it to the host or use a jax-native construct"),
+            ))
+        for gname, line in fn.globals_written:
+            findings.append(Finding(
+                file=fn.module.rel, line=line, invariant_id="jit-purity",
+                pass_name=PASS_NAME,
+                message=(
+                    f"global {gname} mutated in {fn.name}(), which is "
+                    f"traced under a jit entry ({entry_desc}) — global "
+                    "mutation under trace desynchronizes host and device"),
+            ))
+    return findings
+
+
+# -- donation ----------------------------------------------------------------
+
+
+def _name_loads_stores(node: ast.AST):
+    loads, stores = [], []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            (stores if isinstance(sub.ctx, (ast.Store, ast.Del))
+             else loads).append((sub.id, sub.lineno))
+    return loads, stores
+
+
+def _donation_findings(model: ProjectModel, donating_names, donating_factories):
+    findings: list[Finding] = []
+    for fn in model.functions.values():
+        # names bound in THIS scope to donating callables: direct jit
+        # bindings plus factory results (`step = make_dp_train_step(...)`)
+        local: dict[str, tuple[int, ...]] = {}
+        for (scope, name), argnums in donating_names.items():
+            if scope == fn.key or scope == fn.module.rel:
+                local[name] = argnums
+        for stmt in ast.walk(fn.node):
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                cname = dotted_name(stmt.value.func)
+                callee = model.resolve_call(fn, cname) if cname else None
+                if callee is not None and callee.key in donating_factories:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            local[t.id] = donating_factories[callee.key]
+        if not local:
+            continue
+        loads, stores = _name_loads_stores(fn.node)
+        for cs in fn.calls:
+            argnums = local.get(cs.name)
+            if argnums is None:
+                continue
+            donated: list[tuple[str, int]] = []
+            for idx in argnums:
+                if idx < len(cs.node.args):
+                    name = dotted_name(cs.node.args[idx])
+                    if name and "." not in name:
+                        donated.append((name, idx))
+            # (a) one buffer donated twice in a single dispatch
+            seen: dict[str, int] = {}
+            for name, idx in donated:
+                if name in seen:
+                    findings.append(Finding(
+                        file=fn.module.rel, line=cs.line,
+                        invariant_id="donation", pass_name=PASS_NAME,
+                        message=(
+                            f"{cs.name}(...) donates {name!r} at argnums "
+                            f"{seen[name]} and {idx} — the same buffer "
+                            "donated twice aliases XLA's output buffers "
+                            "(the PR 6 deadlock); pass distinct buffers"),
+                    ))
+                else:
+                    seen[name] = idx
+            # (b) donated buffer read after the dispatch without rebinding
+            for name, idx in donated:
+                rebind = min((ln for n, ln in stores
+                              if n == name and ln >= cs.line),
+                             default=None)
+                for lname, lline in loads:
+                    if lname != name or lline <= cs.line:
+                        continue
+                    if rebind is not None and rebind <= lline:
+                        break
+                    findings.append(Finding(
+                        file=fn.module.rel, line=lline,
+                        invariant_id="donation", pass_name=PASS_NAME,
+                        message=(
+                            f"{name!r} is read after being donated to "
+                            f"{cs.name}(...) at line {cs.line} — the launch "
+                            "consumed its buffer; read the result instead, "
+                            "or drop donate_argnums for this argument"),
+                    ))
+                    break
+    return findings
+
+
+def run(model: ProjectModel) -> list[Finding]:
+    entries, donating_names, donating_factories = _collect_entries(model)
+    findings = _purity_findings(model, entries)
+    findings.extend(_donation_findings(model, donating_names,
+                                       donating_factories))
+    return findings

@@ -23,6 +23,7 @@ from typing import Any, Callable, Iterable, Mapping, Sequence
 
 from deepdfa_tpu.cpg.schema import CPG
 from deepdfa_tpu.data.tokenise import tokenise
+from deepdfa_tpu.resilience.journal import atomic_write_bytes
 
 __all__ = [
     "line_dependency_context",
@@ -175,8 +176,7 @@ def feature_extraction(
 
     if cachefp is not None:
         Path(cache_dir).mkdir(parents=True, exist_ok=True)
-        with open(cachefp, "wb") as f:
-            pickle.dump(result, f)
+        atomic_write_bytes(cachefp, pickle.dumps(result))
     return result
 
 
@@ -220,6 +220,5 @@ def statement_labels(
 
     if cache_path is not None:
         cache_path.parent.mkdir(parents=True, exist_ok=True)
-        with open(cache_path, "wb") as f:
-            pickle.dump(out, f)
+        atomic_write_bytes(cache_path, pickle.dumps(out))
     return out

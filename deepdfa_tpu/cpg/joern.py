@@ -30,6 +30,7 @@ from pathlib import Path
 import pandas as pd
 
 from deepdfa_tpu.cpg.schema import CPG
+from deepdfa_tpu.resilience.journal import atomic_write_text
 
 __all__ = [
     "load_tables", "load_cpg", "load_dataflow", "reexport_dataflow",
@@ -172,8 +173,8 @@ def reexport_dataflow(stem: str | Path, cache: bool = True) -> Path:
             "solution.in": node_sets(in_sets, keep),
             "solution.out": node_sets(out_sets, keep),
         }
-    out_path.write_text(json.dumps(per_method))
-    summary_path.write_text(json.dumps({
+    atomic_write_text(out_path, json.dumps(per_method))
+    atomic_write_text(summary_path, json.dumps({
         "methods": len(per_method),
         "solved_nodes": {k: len(v["solution.in"]) for k, v in per_method.items()},
         "domain_size": len(rd.domain),

@@ -14,6 +14,7 @@ from __future__ import annotations
 from pathlib import Path
 
 from deepdfa_tpu.cpg.schema import CPG, RDG_ETYPES, rdg
+from deepdfa_tpu.resilience.journal import atomic_write_text
 
 __all__ = ["to_dot", "write_dot"]
 
@@ -86,5 +87,5 @@ def to_dot(
 
 def write_dot(cpg: CPG, path: str | Path, **kwargs) -> Path:
     path = Path(path)
-    path.write_text(to_dot(cpg, **kwargs), encoding="utf-8")
+    atomic_write_text(path, to_dot(cpg, **kwargs), encoding="utf-8")
     return path

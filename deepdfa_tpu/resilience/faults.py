@@ -16,43 +16,13 @@ Spec grammar (env var or :func:`install` argument), entries ``;``-separated::
     joern.hang:p=0.25:seed=7:max=2             # Bernoulli(0.25) per hit, cap 2
     prefetch.producer_raises                   # fire on every hit
 
-Known points (grep for ``faults.fire(`` / ``crash_if`` / ``raise_if``):
-
-=======================================  ====================================
-``ckpt.crash_between_state_and_meta``    hard-exit between the checkpoint
-                                         state write and its ``meta.json``
-                                         commit (train/checkpoint.py)
-``step.nan_grads``                       poison one train step's loss scale
-                                         so its gradients go NaN (train/loop)
-``prefetch.producer_raises``             raise inside the prefetch producer
-                                         thread (data/prefetch.py)
-``joern.hang``                           swallow one REPL command so the
-                                         prompt never returns (cpg)
-``joern.die``                            kill the joern subprocess before a
-                                         command (cpg)
-``serve.drop_request``                   drop one ``/score`` request at
-                                         admission — the client gets a 503,
-                                         the server keeps serving (serve)
-``serve.engine_raises``                  raise inside the scoring engine —
-                                         that batch's requests get 500s,
-                                         the dispatcher survives (serve)
-``preempt.sigterm``                      flag a preemption notice at a train
-                                         step boundary, as if SIGTERM had
-                                         arrived — drives the emergency-
-                                         checkpoint path (train/loop)
-``mesh.device_lost``                     halve the device list handed to
-                                         ``build_mesh`` — a lost host; the
-                                         surviving slice builds a smaller
-                                         mesh (parallel/mesh)
-``step.hang``                            wedge one train step: a cancel-
-                                         aware sleep the HangWatchdog must
-                                         convert into a bounded, journaled
-                                         timeout abort (train/loop)
-``obs.trace_drop``                       lose one span at export — counted
-                                         in ``dropped_total``; the request
-                                         it annotates must still succeed
-                                         (obs/tracing.py)
-=======================================  ====================================
+The known points live in :data:`KNOWN_POINTS`, each documented by one
+:data:`POINT_DOCS` line. Those two tables are the single source of truth:
+the static-analysis faults pass (``python -m deepdfa_tpu.analysis``)
+verifies every fire site names a declared point, every declared point is
+fired and chaos-tested, and the ``DEEPDFA_FAULTS`` table in README.md is
+exactly the one generated from :data:`POINT_DOCS`
+(``python -m deepdfa_tpu.analysis --faults-table``).
 """
 
 from __future__ import annotations
@@ -66,6 +36,7 @@ from dataclasses import dataclass
 __all__ = [
     "ENV_VAR",
     "KNOWN_POINTS",
+    "POINT_DOCS",
     "FaultSpec",
     "InjectedFault",
     "parse_spec",
@@ -95,6 +66,43 @@ KNOWN_POINTS = (
     "step.hang",
     "obs.trace_drop",
 )
+
+# One line per point; keys must equal KNOWN_POINTS (the analysis faults
+# pass enforces it) and the README DEEPDFA_FAULTS table is generated from
+# this dict — edit here, then `python -m deepdfa_tpu.analysis --faults-table`.
+POINT_DOCS = {
+    "ckpt.crash_between_state_and_meta": (
+        "hard-exit between the checkpoint state write and its meta.json "
+        "commit (train/checkpoint.py)"),
+    "step.nan_grads": (
+        "poison one train step's loss scale so its gradients go NaN "
+        "(train/loop.py)"),
+    "prefetch.producer_raises": (
+        "raise inside the prefetch producer thread (data/prefetch.py)"),
+    "joern.hang": (
+        "swallow one REPL command so the prompt never returns "
+        "(cpg/joern_session.py)"),
+    "joern.die": (
+        "kill the joern subprocess before a command (cpg/joern_session.py)"),
+    "serve.drop_request": (
+        "drop one /score request at admission — the client gets a 503, the "
+        "server keeps serving (serve/server.py)"),
+    "serve.engine_raises": (
+        "raise inside the scoring engine — that batch's requests get 500s, "
+        "the dispatcher survives (serve/server.py)"),
+    "preempt.sigterm": (
+        "flag a preemption notice at a train step boundary, as if SIGTERM "
+        "had arrived — drives the emergency-checkpoint path (train/loop.py)"),
+    "mesh.device_lost": (
+        "halve the device list handed to build_mesh — a lost host; the "
+        "surviving slice builds a smaller mesh (parallel/mesh.py)"),
+    "step.hang": (
+        "wedge one train step: a cancel-aware sleep the HangWatchdog must "
+        "convert into a bounded, journaled timeout abort (train/loop.py)"),
+    "obs.trace_drop": (
+        "lose one span at export — counted in dropped_total; the request it "
+        "annotates must still succeed (obs/tracing.py)"),
+}
 
 
 class InjectedFault(RuntimeError):

@@ -20,7 +20,7 @@ import os
 from pathlib import Path
 from typing import Any
 
-__all__ = ["RunJournal", "atomic_write_text", "fsync_dir"]
+__all__ = ["RunJournal", "atomic_write_bytes", "atomic_write_text", "fsync_dir"]
 
 
 def fsync_dir(path: str | Path) -> None:
@@ -38,12 +38,18 @@ def fsync_dir(path: str | Path) -> None:
         os.close(fd)
 
 
-def atomic_write_text(path: str | Path, text: str) -> Path:
+def atomic_write_text(path: str | Path, text: str,
+                      encoding: str = "utf-8") -> Path:
     """Crash-safe text write: sideways file + fsync + ``os.replace``."""
+    return atomic_write_bytes(path, text.encode(encoding))
+
+
+def atomic_write_bytes(path: str | Path, data: bytes) -> Path:
+    """Crash-safe byte write: sideways file + fsync + ``os.replace``."""
     path = Path(path)
     tmp = path.with_name(path.name + ".tmp")
-    with open(tmp, "w") as f:
-        f.write(text)
+    with open(tmp, "wb") as f:
+        f.write(data)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)

@@ -34,6 +34,7 @@ import numpy as np
 
 from deepdfa_tpu.config import ExperimentConfig, to_json
 from deepdfa_tpu.data.graphs import BatchedGraphs, Graph, batch_np
+from deepdfa_tpu.resilience.journal import atomic_write_bytes, atomic_write_text
 
 __all__ = ["export_ggnn", "load_exported", "example_batch"]
 
@@ -109,7 +110,7 @@ def export_ggnn(cfg: ExperimentConfig, params, out_dir: str | Path,
 
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
-    (out_dir / "model.stablehlo").write_bytes(exported.serialize())
+    atomic_write_bytes(out_dir / "model.stablehlo", exported.serialize())
     leaves, treedef = jax.tree.flatten(ex)
     manifest = {
         "format": "jax.export stablehlo",
@@ -129,7 +130,9 @@ def export_ggnn(cfg: ExperimentConfig, params, out_dir: str | Path,
         "package_version": _package_version(),
         "vocab_hash": vocab_hash,
     }
-    (out_dir / "manifest.json").write_text(json.dumps(manifest, indent=2))
+    # manifest last: it is the export's commit marker — a crash before this
+    # line leaves no manifest, and loaders treat that as "no export here"
+    atomic_write_text(out_dir / "manifest.json", json.dumps(manifest, indent=2))
     return out_dir
 
 

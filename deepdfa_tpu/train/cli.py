@@ -48,6 +48,7 @@ from deepdfa_tpu.data.graphs import BucketSpec, Graph, GraphBatcher, load_shards
 from deepdfa_tpu.data.sampler import epoch_indices, positive_weight
 from deepdfa_tpu.models import make_model
 from deepdfa_tpu.train import metrics as M
+from deepdfa_tpu.resilience.journal import atomic_write_text
 from deepdfa_tpu.train.checkpoint import CheckpointManager
 from deepdfa_tpu.train.loop import Trainer, _weighted_mean
 
@@ -680,7 +681,7 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
         resharded=resharded,
         completed=True,
     )
-    (run_dir / "final_metrics.json").write_text(json.dumps(last_val, indent=2))
+    atomic_write_text(run_dir / "final_metrics.json", json.dumps(last_val, indent=2))
     if tb is not None:
         tb.close()
     return last_val
@@ -826,7 +827,7 @@ def test(
         results |= {f"profile_{k}": v for k, v in prof.items()}
         logger.info("profiling: %s", prof)
 
-    (run_dir / "test_metrics.json").write_text(json.dumps(results, indent=2))
+    atomic_write_text(run_dir / "test_metrics.json", json.dumps(results, indent=2))
     return results
 
 
@@ -1028,7 +1029,7 @@ def predict(
 
     report = predict_paths(sources, cfg=cfg, model=model, params=params,
                            vocabs=vocabs, top_k=top_k, saliency=saliency)
-    (run_dir / "predictions.json").write_text(json.dumps(report, indent=2))
+    atomic_write_text(run_dir / "predictions.json", json.dumps(report, indent=2))
     print(json.dumps(report))
     return report
 
@@ -1130,7 +1131,7 @@ def analyze(cfg: ExperimentConfig, run_dir: Path) -> dict:
         logger.info("no hashes.parquet under %s — variant grid skipped "
                     "(re-run scripts/preprocess.py to persist it)", shard_dir)
 
-    (run_dir / "coverage.json").write_text(json.dumps(out, indent=2))
+    atomic_write_text(run_dir / "coverage.json", json.dumps(out, indent=2))
     return out
 
 
@@ -1161,7 +1162,7 @@ def trace_export(src: Path, out: Path | None = None) -> dict:
     if out is None:
         out = (src / "trace_events.json" if src.is_dir()
                else src.with_suffix(".chrome.json"))
-    Path(out).write_text(json.dumps(trace, indent=2))
+    atomic_write_text(Path(out), json.dumps(trace, indent=2))
     summary = {"trace_records": len(records), "spans": len(spans),
                "out": str(out)}
     print(json.dumps(summary), flush=True)
@@ -1247,7 +1248,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         # no-clobber for predict: it is routinely pointed AT a fit run dir
         # (README usage) and must not overwrite the trained run's recorded
         # config — but a FRESH predict run dir still gets provenance
-        (run_dir / "config.json").write_text(to_json(cfg))
+        atomic_write_text(run_dir / "config.json", to_json(cfg))
     logger.info("run %s: %s devices=%s", run_id, args.command, jax.device_count())
 
     try:

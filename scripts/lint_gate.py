@@ -2,8 +2,10 @@
 """The fast pre-commit gate: ruff over the library + the device-free perf
 contract suite (``pytest -m perf_contract``) + the fleet unit suite
 (``pytest -m fleet``: hash ring, router, warm store) + the observability
-suite (``pytest -m obs``: tracing, exposition conformance, drift) in one
-command.
+suite (``pytest -m obs``: tracing, exposition conformance, drift) + the
+invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
+lock-order, jit-purity/donation, fault-registry, metrics conformance
+static passes) in one command.
 
 No step touches an accelerator, compiles XLA, or takes more than a few
 seconds, so this is safe to run on every commit: ruff catches the syntax/
@@ -77,6 +79,20 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("obs")
+
+    # step 5: the invariant gate — AST passes for atomic-commit,
+    # lock-order, jit-purity/donation, fault-registry and metrics
+    # conformance; nonzero on any finding not in analysis_baseline.json
+    print("lint_gate: python -m deepdfa_tpu.analysis --json "
+          "deepdfa_tpu/ scripts/")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepdfa_tpu.analysis", "--json",
+         "deepdfa_tpu/", "scripts/"],
+        cwd=REPO, capture_output=True, text=True)
+    if proc.returncode != 0:
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        failures.append("analysis")
 
     if failures:
         print(f"lint_gate: FAILED ({', '.join(failures)})")

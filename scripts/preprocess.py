@@ -35,6 +35,8 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+from deepdfa_tpu.resilience.journal import atomic_write_text  # noqa: E402
+
 
 def _extract_one(item: dict) -> tuple[int, object, str | None]:
     """(id, CPG|None, error) — module-level so process pools can pickle it.
@@ -70,7 +72,9 @@ def _extract_one(item: dict) -> tuple[int, object, str | None]:
         tmp = cache_path.with_suffix(f".tmp{os.getpid()}")
         with open(tmp, "wb") as f:
             pickle.dump(cpg, f)
-        tmp.rename(cache_path)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, cache_path)
     return fid, cpg, None
 
 
@@ -116,7 +120,7 @@ def _extract_with_joern(records: list[dict], dataset: str):
             digest = hashlib.sha1(str(row["before"]).encode()).hexdigest()[:16]
             c_path = src_dir / f"{fid}_{digest}.c"
             if not c_path.exists():
-                c_path.write_text(str(row["before"]))
+                atomic_write_text(c_path, str(row["before"]))
             try:
                 cpgs[fid] = supervisor.run(
                     fid, lambda s, p=c_path: _export_and_load(s, p)
@@ -133,7 +137,7 @@ def _extract_with_joern(records: list[dict], dataset: str):
         digest = hashlib.sha1(source.encode()).hexdigest()[:16]
         c_path = after_dir / f"{digest}.c"
         if not c_path.exists():
-            c_path.write_text(source)
+            atomic_write_text(c_path, source)
         return supervisor.run(
             f"after:{digest}", lambda s: _export_and_load(s, c_path)
         )
@@ -247,7 +251,7 @@ def main(argv=None) -> dict:
     out_dir.mkdir(parents=True, exist_ok=True)
     failed_rate = len(failures) / max(len(records), 1)
     if failures:
-        (out_dir / "failed_frontend.txt").write_text("\n".join(failures) + "\n")
+        atomic_write_text(out_dir / "failed_frontend.txt", "\n".join(failures) + "\n")
         print(
             f"frontend failures: {len(failures)}/{len(records)} "
             f"({failed_rate:.1%}) — see {out_dir / 'failed_frontend.txt'}",
@@ -337,13 +341,14 @@ def main(argv=None) -> dict:
         dataflow_labels=args.dataflow_labels,
     )
     n_shards = save_shards(graphs, out_dir)
-    (out_dir / "splits.json").write_text(json.dumps(splits))
-    (out_dir / "split.txt").write_text(args.split)
+    atomic_write_text(out_dir / "splits.json", json.dumps(splits))
+    atomic_write_text(out_dir / "split.txt", args.split)
     # full form (cfg + subkey_vocabs + all_vocab): `predict` re-encodes NEW
     # source against the training vocab, which needs the subkey vocabs for
     # UNKNOWN substitution — all_vocab alone cannot do that
-    (out_dir / "vocab.json").write_text(
-        json.dumps({name: voc.to_dict() for name, voc in vocabs.items()})
+    atomic_write_text(
+        out_dir / "vocab.json",
+        json.dumps({name: voc.to_dict() for name, voc in vocabs.items()}),
     )
     # stage-2 hash table: the coverage analyzer's input for the per-variant
     # limit_all x subkey grid (train/cli.py variant_coverage)

@@ -1,0 +1,13 @@
+"""Seeded violation: atomic-commit (invariants 1, 10).
+
+A durable checkpoint-path write that commits in place — no sideways tmp,
+no ``os.replace`` — so a kill mid-write leaves a torn ``meta.json`` that
+reads as data. The atomic pass must flag line 13.
+"""
+
+import json
+from pathlib import Path
+
+
+def save_meta(step_dir: Path, meta: dict) -> None:
+    (step_dir / "meta.json").write_text(json.dumps(meta))

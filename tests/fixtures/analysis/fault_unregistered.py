@@ -1,0 +1,14 @@
+"""Seeded violation: fault-registry (invariant 5).
+
+Fires a fault point that ``resilience.faults.KNOWN_POINTS`` does not
+declare — chaos no ``DEEPDFA_FAULTS`` schedule can arm deterministically.
+The faults pass must flag the call site.
+"""
+
+from deepdfa_tpu.resilience import faults
+
+
+def risky_stage():
+    if faults.fire("ghost.not_in_registry"):
+        raise RuntimeError("boom")
+    return "ok"

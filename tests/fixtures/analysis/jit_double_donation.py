@@ -1,0 +1,18 @@
+"""Seeded violation: donation (the PR 6 deadlock class).
+
+``state`` is passed at BOTH donated positions of one dispatch — XLA
+aliases a single buffer into two outputs and deadlocks or miscompiles.
+The jax pass must flag the double donation at the call site.
+"""
+
+import jax
+
+
+def _update(state, metrics):
+    return state, metrics
+
+
+def train_once(state):
+    step = jax.jit(_update, donate_argnums=(0, 1))
+    new_state, metrics = step(state, state)
+    return new_state, metrics

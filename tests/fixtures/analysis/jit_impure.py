@@ -1,0 +1,20 @@
+"""Seeded violation: jit-purity.
+
+``scale_by_wallclock`` is reachable from a ``jax.jit`` entry but reads the
+host wall clock — the value freezes at trace time, so every execution of
+the compiled program reuses the timestamp of the first. The jax pass must
+flag the ``time.time()`` call.
+"""
+
+import time
+
+import jax
+
+
+def scale_by_wallclock(x):
+    return x * time.time()
+
+
+@jax.jit
+def step(x):
+    return scale_by_wallclock(x) + 1.0

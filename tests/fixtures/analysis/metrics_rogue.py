@@ -1,0 +1,20 @@
+"""Seeded violations: metrics conformance (invariant 16).
+
+A hand-rolled Prometheus exposition formatter (literal ``# TYPE`` lines —
+the exact seed bug the registry replaced) plus a registry constructed
+outside the ``deepdfa_*`` namespace. The metrics pass must flag both.
+"""
+
+from deepdfa_tpu.obs.registry import MetricsRegistry
+
+
+def render(samples: dict) -> str:
+    lines = []
+    for name, value in samples.items():
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def rogue_registry() -> MetricsRegistry:
+    return MetricsRegistry(prefix="acme_")

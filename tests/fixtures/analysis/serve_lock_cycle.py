@@ -1,0 +1,36 @@
+"""Seeded violations: lock-order cycle + unguarded shared state.
+
+``enqueue`` takes A then B while the worker thread's ``drain`` takes B
+then A — the classic ABBA deadlock the locks pass must flag as a cycle.
+``self.backlog`` is written from the spawned worker thread and read on
+the caller side with no common lock — the unguarded-state check must
+flag it too.
+"""
+
+import threading
+
+
+class Dispatcher:
+    def __init__(self):
+        self._admit_lock = threading.Lock()
+        self._batch_lock = threading.Lock()
+        self.backlog = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def enqueue(self, item):
+        with self._admit_lock:
+            with self._batch_lock:
+                return item
+
+    def _run(self):
+        while True:
+            self.drain()
+            self.backlog = self.backlog + 1
+
+    def drain(self):
+        with self._batch_lock:
+            with self._admit_lock:
+                return None
+
+    def depth(self) -> int:
+        return self.backlog

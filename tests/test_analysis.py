@@ -1,0 +1,241 @@
+"""The invariant gate's own battery (`pytest -m analysis`, lint_gate step 5).
+
+Three layers:
+
+- **fixtures fire** — every seeded violation under
+  ``tests/fixtures/analysis/`` is flagged with the expected invariant id;
+  a gate that stays green because its passes are blind is worse than no
+  gate, so the detection path itself is pinned;
+- **repo is clean** — the analyzer over ``deepdfa_tpu/`` + ``scripts/``
+  with the checked-in baseline yields zero unbaselined findings (HEAD
+  must always gate green);
+- **drift fails closed** — the README fault table matches the one
+  generated from ``faults.POINT_DOCS``, ``POINT_DOCS`` covers exactly
+  ``KNOWN_POINTS``, and introducing a violation with the baseline
+  unchanged turns the CLI exit code nonzero (what lint_gate step 5
+  enforces on every commit).
+"""
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from deepdfa_tpu.analysis import (
+    PASSES,
+    Baseline,
+    ProjectModel,
+    repo_root,
+    run_passes,
+)
+from deepdfa_tpu.analysis.cli import main as cli_main
+from deepdfa_tpu.analysis.faultpoints import (
+    TABLE_BEGIN,
+    TABLE_END,
+    render_faults_table,
+)
+from deepdfa_tpu.resilience import faults
+
+pytestmark = pytest.mark.analysis
+
+REPO = repo_root()
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+
+# one seeded violation per pass: fixture file -> invariant ids it must trip
+EXPECTED = {
+    "checkpoint_torn_write.py": {"atomic-commit"},
+    "serve_lock_cycle.py": {"lock-order", "unguarded-state"},
+    "jit_impure.py": {"jit-purity"},
+    "jit_double_donation.py": {"donation"},
+    "fault_unregistered.py": {"fault-registry"},
+    "metrics_rogue.py": {"metrics"},
+}
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    model = ProjectModel.build(REPO, [FIXTURES])
+    findings, _ = run_passes(model)
+    return findings
+
+
+@pytest.fixture(scope="module")
+def repo_findings():
+    model = ProjectModel.build(
+        REPO, [REPO / "deepdfa_tpu", REPO / "scripts"])
+    findings, stats = run_passes(model)
+    return findings, stats
+
+
+# -- every pass fires on its seeded fixture ----------------------------------
+
+
+@pytest.mark.parametrize("fname,invariants", sorted(EXPECTED.items()))
+def test_fixture_is_flagged(fixture_findings, fname, invariants):
+    rel = f"tests/fixtures/analysis/{fname}"
+    got = {f.invariant_id for f in fixture_findings if f.file == rel}
+    missing = invariants - got
+    assert not missing, (
+        f"{rel}: expected invariant(s) {sorted(missing)} not flagged "
+        f"(got {sorted(got)}) — the pass is blind to its seeded violation")
+
+
+def test_no_spurious_fixture_findings(fixture_findings):
+    """Findings land only on fixture files, each with an expected id —
+    over-firing here would mean the passes flag compliant code."""
+    for f in fixture_findings:
+        name = Path(f.file).name
+        assert name in EXPECTED, f"unexpected file flagged: {f.render()}"
+        assert f.invariant_id in EXPECTED[name], f.render()
+
+
+# -- the repo itself gates green ---------------------------------------------
+
+
+def test_repo_has_no_unbaselined_findings(repo_findings):
+    findings, _ = repo_findings
+    baseline = Baseline.load(REPO / "analysis_baseline.json")
+    fresh, _ = baseline.split(findings)
+    assert not fresh, (
+        "unbaselined invariant-gate findings at HEAD:\n"
+        + "\n".join(f.render() for f in fresh))
+
+
+def test_analysis_is_fast_and_device_free(repo_findings):
+    """< 5 s over the whole tree, pure-AST (stats carry per-pass wall
+    time; nothing touches jax devices — the model never imports targets)."""
+    _, stats = repo_findings
+    total = sum(v["seconds"] for k, v in stats.items() if k != "model")
+    assert total < 5.0, f"analysis took {total:.2f}s (budget 5s)"
+    assert stats["model"]["parse_errors"] == 0
+    assert set(PASSES).issubset(stats)
+
+
+# -- registry / README cannot drift ------------------------------------------
+
+
+def test_point_docs_cover_known_points():
+    assert set(faults.POINT_DOCS) == set(faults.KNOWN_POINTS)
+
+
+def test_readme_faults_table_is_generated():
+    text = (REPO / "README.md").read_text()
+    begin, end = text.find(TABLE_BEGIN), text.find(TABLE_END)
+    assert begin >= 0 and end > begin, "README lost the DEEPDFA_FAULTS markers"
+    current = text[text.index("\n", begin) + 1:end].strip()
+    assert current == render_faults_table(), (
+        "README DEEPDFA_FAULTS table drifted from faults.POINT_DOCS — "
+        "regenerate with `python -m deepdfa_tpu.analysis --faults-table`")
+
+
+def test_every_known_point_documented_in_table():
+    table = render_faults_table()
+    for point in faults.KNOWN_POINTS:
+        assert f"`{point}`" in table
+
+
+# -- CLI contract (what lint_gate step 5 actually runs) ----------------------
+
+
+def test_cli_json_clean_exit_zero(capsys):
+    rc = cli_main(["--json", str(REPO / "deepdfa_tpu"),
+                   str(REPO / "scripts")])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["ok"] is True
+    assert report["findings"] == []
+    assert set(report["passes"]) == set(PASSES)
+
+
+def test_cli_violation_with_unchanged_baseline_fails(capsys):
+    """The gate property: a tree containing a violation + the checked-in
+    (empty) baseline = nonzero exit. This is exactly how lint_gate step 5
+    fails a commit that introduces one."""
+    rc = cli_main(["--json", str(FIXTURES)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["ok"] is False
+    assert len(report["findings"]) >= len(EXPECTED)
+
+
+def test_cli_pass_subset_and_stats(capsys):
+    rc = cli_main(["--passes", "faults,metrics", "--stats",
+                   str(REPO / "deepdfa_tpu")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "faults" in out and "metrics" in out
+    assert "atomic" not in out  # unselected pass does not run
+
+
+def test_cli_unknown_pass_is_usage_error():
+    assert cli_main(["--passes", "nope"]) == 2
+
+
+def test_cli_missing_path_is_usage_error():
+    assert cli_main([str(REPO / "no_such_dir_xyz")]) == 2
+
+
+def test_cli_faults_table_prints_registry(capsys):
+    rc = cli_main(["--faults-table"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.strip() == render_faults_table()
+
+
+# -- baseline semantics -------------------------------------------------------
+
+
+def test_baseline_requires_reason(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"suppressions": [
+        {"invariant": "atomic-commit", "file": "x.py"}]}))
+    with pytest.raises(ValueError, match="reason"):
+        Baseline.load(p)
+
+
+def test_baseline_matches_exactly(tmp_path, fixture_findings):
+    torn = [f for f in fixture_findings
+            if f.file.endswith("checkpoint_torn_write.py")]
+    assert torn
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"suppressions": [{
+        "invariant": "atomic-commit",
+        "file": torn[0].file,
+        "line": torn[0].line,
+        "reason": "seeded fixture",
+    }]}))
+    baseline = Baseline.load(p)
+    fresh, known = baseline.split(fixture_findings)
+    assert known == torn
+    # same invariant in a different file is NOT suppressed
+    assert all(not f.file.endswith("checkpoint_torn_write.py")
+               for f in fresh)
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    b = Baseline.load(tmp_path / "absent.json")
+    assert b.suppressions == []
+
+
+# -- end to end: a fresh violation in a clean tree trips the gate ------------
+
+
+def test_new_violation_turns_gate_red(tmp_path, capsys):
+    clean = tmp_path / "warmstore_util.py"
+    clean.write_text(
+        "import json\n\n\n"
+        "def load(path):\n"
+        "    return json.loads(path.read_text())\n")
+    assert cli_main(["--json", str(tmp_path)]) == 0
+    capsys.readouterr()
+    clean.write_text(
+        "import json\n\n\n"
+        "def save(path, obj):\n"
+        "    path.write_text(json.dumps(obj))\n")
+    start = time.perf_counter()
+    rc = cli_main(["--json", str(tmp_path)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["findings"][0]["invariant"] == "atomic-commit"
+    assert time.perf_counter() - start < 5.0

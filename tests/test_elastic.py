@@ -396,3 +396,22 @@ def test_dp_elastic_accum_matches_full_mesh():
         np.testing.assert_allclose(a, b, atol=1e-5)
     for a, b in zip(jax.tree.leaves(m4), jax.tree.leaves(m2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_device_lost_builds_surviving_mesh():
+    """Armed mesh.device_lost: build_mesh sees half the devices, a dp=-1
+    config absorbs the shrink, and mesh_changed flags the new topology so
+    resume knows to reshard."""
+    from deepdfa_tpu.config import MeshConfig
+    from deepdfa_tpu.parallel.mesh import build_mesh
+
+    full = build_mesh(MeshConfig())
+    before = mesh_block(full)
+    with faults.installed("mesh.device_lost@1"):
+        shrunk = build_mesh(MeshConfig())
+    assert len(shrunk.devices.flatten()) == len(full.devices.flatten()) // 2
+    assert shrunk.axis_names == full.axis_names
+    assert mesh_changed(before, mesh_block(shrunk))
+    # the fault fires once: the next build sees the full slice again
+    assert len(build_mesh(MeshConfig()).devices.flatten()) == \
+        len(full.devices.flatten())
