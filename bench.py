@@ -1116,9 +1116,13 @@ def _provenance_fields() -> dict:
     result: full commit hash + dirty flag (``git_dirty`` None = unknown,
     e.g. hash recovered from ``.git/HEAD`` without a git binary) and the
     emission wall clock (file mtimes reset on checkout/clone, so the replay
-    freshness window reads this embedded stamp instead)."""
+    freshness window reads this embedded stamp instead). ``schema_version``
+    stamps the artifact shape so downstream readers (the perf-regression
+    ledger) can evolve their parsers without guessing; the ledger also
+    tolerates the pre-versioned artifacts already in the repo root."""
     rev, dirty = _git_provenance()
     return {
+        "schema_version": 1,
         "git_rev": rev,
         "git_dirty": dirty,
         "emitted_at_unix": int(time.time()),

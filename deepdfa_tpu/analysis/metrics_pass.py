@@ -14,7 +14,13 @@ labeled sample, which strict Prometheus parsers reject. Three checks:
   invalid Prometheus name;
 - no module outside ``obs/registry.py`` builds exposition text by hand —
   any non-docstring string constant containing ``# HELP`` or ``# TYPE``
-  is a formatter the conformance test cannot see.
+  is a formatter the conformance test cannot see;
+- every ``render``/``render_*`` function in the obs/serve exposition
+  modules routes through the registry: it must construct a
+  ``MetricsRegistry`` or delegate to another ``.render(...)`` — a render
+  method that assembles its body any other way (string joins, f-strings)
+  is a scrape endpoint the conformance test cannot see (the /slo and
+  flight-recorder additions made this worth mechanizing).
 """
 
 from __future__ import annotations
@@ -65,8 +71,47 @@ def _literal_str(node: ast.AST) -> str | None:
     return None
 
 
+# exposition modules: every render/render_* defined here must route
+# through the registry (directly, or by delegating to another .render())
+_RENDER_SCOPES = ("deepdfa_tpu/obs/", "deepdfa_tpu/serve/")
+
+
+def _render_conformance_findings(model: ProjectModel) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in model.functions.values():
+        rel = fn.module.rel
+        if any(pat in rel for pat in _EXEMPT):
+            continue
+        if not any(scope in rel for scope in _RENDER_SCOPES):
+            continue
+        if fn.name != "render" and not fn.name.startswith("render_"):
+            continue
+        conformant = False
+        for cs in fn.calls:
+            canon = fn.module.canonical(cs.name)
+            if canon.rpartition(".")[2] == "MetricsRegistry":
+                conformant = True
+                break
+            if "." in cs.name and cs.name.rpartition(".")[2] == "render":
+                conformant = True  # delegates to a registry-backed render
+                break
+        if not conformant:
+            findings.append(Finding(
+                file=rel, line=fn.line, invariant_id="metrics",
+                pass_name=PASS_NAME,
+                message=(
+                    f"{fn.name}() builds its exposition without a "
+                    "MetricsRegistry (and without delegating to another "
+                    ".render()) — every obs/serve scrape body must go "
+                    "through obs.registry so the conformance test covers "
+                    "it (invariant 16)"),
+            ))
+    return findings
+
+
 def run(model: ProjectModel) -> list[Finding]:
     findings = _exposition_findings(model)
+    findings += _render_conformance_findings(model)
     for fn in model.functions.values():
         rel = fn.module.rel
         if any(pat in rel for pat in _EXEMPT):

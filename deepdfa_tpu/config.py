@@ -282,8 +282,27 @@ class ObsConfig:
     drift_bins: int = 10
     drift_threshold: float = 0.2  # PSI above this flips deepdfa_serve_score_drift_alert
     drift_min_samples: int = 64  # both windows need this many scores to judge
+    # LRU cap on tracked model_revs: a long-lived server scraping many
+    # checkpoint revisions must not grow /metrics or memory without bound
+    drift_max_revs: int = 64
     # trainer telemetry HTTP endpoint: -1 disables, 0 binds an ephemeral port
     train_port: int = -1
+    # crash flight recorder: bounded ring of last-N structured events,
+    # dumped atomically as flight-<ts>.json on crash or SIGUSR2
+    flight_events: int = 256
+    flight_dir: str | None = None  # dump directory; None = cwd
+    # SLO burn-rate engine (/slo endpoints): multi-window alerting over
+    # the metrics snapshots; transitions journal + refresh alerts.json
+    slo_availability: float = 0.99  # serve/router non-5xx floor
+    slo_error_rate: float = 0.95  # serve non-error (2xx) floor
+    slo_p99_ms: float = 2000.0  # serve/router p99 latency ceiling
+    slo_step_ms: float = 0.0  # train mean-step ceiling (0 disables)
+    slo_mfu_floor: float = 0.0  # train MFU floor (0 disables)
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    slo_burn_threshold: float = 2.0  # ratio SLOs page above this burn
+    # alert transitions rewrite this promotion-veto artifact (None = off)
+    alerts_path: str | None = None
 
     def __post_init__(self):
         if self.trace_buffer < 1:
@@ -298,8 +317,27 @@ class ObsConfig:
             raise ValueError("drift_threshold must be > 0")
         if self.drift_min_samples < 1:
             raise ValueError("drift_min_samples must be >= 1")
+        if self.drift_max_revs < 1:
+            raise ValueError("drift_max_revs must be >= 1")
         if self.train_port < -1:
             raise ValueError("train_port must be >= -1 (-1 disables)")
+        if self.flight_events < 1:
+            raise ValueError("flight_events must be >= 1")
+        if not 0.0 < self.slo_availability < 1.0:
+            raise ValueError("slo_availability must be in (0, 1)")
+        if not 0.0 < self.slo_error_rate < 1.0:
+            raise ValueError("slo_error_rate must be in (0, 1)")
+        if self.slo_p99_ms <= 0:
+            raise ValueError("slo_p99_ms must be > 0")
+        if self.slo_step_ms < 0:
+            raise ValueError("slo_step_ms must be >= 0 (0 disables)")
+        if self.slo_mfu_floor < 0:
+            raise ValueError("slo_mfu_floor must be >= 0 (0 disables)")
+        if not 0 < self.slo_fast_window_s <= self.slo_slow_window_s:
+            raise ValueError(
+                "need 0 < slo_fast_window_s <= slo_slow_window_s")
+        if self.slo_burn_threshold <= 0:
+            raise ValueError("slo_burn_threshold must be > 0")
 
 
 @dataclass(frozen=True)

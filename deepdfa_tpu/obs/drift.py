@@ -68,17 +68,29 @@ class ScoreDriftSentinel:
     ``stage(registry-families)`` at scrape time. The drift gauge for a
     rev is 0.0 until both windows hold at least ``min_samples`` scores —
     a cold rev never alerts.
+
+    ``max_revs`` bounds the tracked revs LRU-style: a long-lived server
+    scoring across many checkpoint promotions evicts its coldest rev's
+    windows instead of growing ``/metrics`` and memory without bound
+    (``evicted_revs_total`` counts them; a re-observed evicted rev starts
+    cold, so it re-freezes a fresh reference window).
     """
 
     def __init__(self, window: int = 512, bins: int = 10,
-                 threshold: float = 0.2, min_samples: int = 64):
+                 threshold: float = 0.2, min_samples: int = 64,
+                 max_revs: int = 64):
         if window < 2 or bins < 2:
             raise ValueError("drift window and bins must each be >= 2")
+        if max_revs < 1:
+            raise ValueError("drift max_revs must be >= 1")
         self.window = int(window)
         self.bins = int(bins)
         self.threshold = float(threshold)
         self.min_samples = max(1, int(min_samples))
+        self.max_revs = int(max_revs)
+        self.evicted_revs_total = 0
         self._lock = threading.Lock()
+        # insertion order IS the LRU order: observe() re-inserts its rev
         self._revs: dict[str, _RevWindow] = {}
 
     # -- request path -------------------------------------------------------
@@ -86,9 +98,13 @@ class ScoreDriftSentinel:
     def observe(self, score: float, model_rev: str = "unknown") -> None:
         score = min(1.0, max(0.0, float(score)))
         with self._lock:
-            rw = self._revs.get(model_rev)
+            rw = self._revs.pop(model_rev, None)
             if rw is None:
-                rw = self._revs[model_rev] = _RevWindow(self.window)
+                rw = _RevWindow(self.window)
+                while len(self._revs) >= self.max_revs:
+                    self._revs.pop(next(iter(self._revs)))
+                    self.evicted_revs_total += 1
+            self._revs[model_rev] = rw  # (re-)insert at the hot end
             rw.n_observed += 1
             if isinstance(rw.reference, list):
                 rw.reference.append(score)

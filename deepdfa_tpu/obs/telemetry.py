@@ -40,9 +40,14 @@ class TrainTelemetry:
     steps from a worker thread)."""
 
     def __init__(self, tracer: Tracer | None = None,
-                 roofline_flops_per_s: float | None = None):
+                 roofline_flops_per_s: float | None = None,
+                 slo=None, flight=None):
         self.tracer = tracer if tracer is not None else Tracer(proc="train")
         self.roofline_flops_per_s = roofline_flops_per_s
+        # verdict-layer attachments (both optional): the SLO engine backs
+        # the /slo endpoint; the flight recorder takes step/fault events
+        self.slo = slo
+        self.flight = flight
         self._lock = threading.Lock()
         self._shapes: set = set()
         self._started_s = time.time()
@@ -164,6 +169,26 @@ class TrainTelemetry:
         snap = self.snapshot()
         return {"ok": True, "role": "trainer", **snap}
 
+    def record_event(self, kind: str, **fields) -> None:
+        """Forward one structured event to the flight recorder (a no-op
+        without one; never raises — invariant 14/17: telemetry must not
+        perturb the step it annotates)."""
+        if self.flight is not None:
+            self.flight.record(kind, **fields)
+
+    def render_slo(self) -> str:
+        """The trainer's ``/slo`` body. With no engine attached an empty
+        one is built on the fly so the endpoint still renders the
+        conformant counter families (and the obs_dropped_total account)."""
+        if self.slo is None:
+            from deepdfa_tpu.obs.slo import SLOEngine
+
+            self.slo = SLOEngine((), flight=self.flight)
+        snap = self.snapshot()
+        self.slo.observe({"mean_step_ms": snap.get("mean_step_ms"),
+                          "mfu": snap.get("mfu")})
+        return self.slo.render("deepdfa_train_")
+
 
 class _TelemetryHandler(BaseHTTPRequestHandler):
     server: "TelemetryServer"
@@ -182,6 +207,9 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
         telemetry = self.server.telemetry
         if self.path.startswith("/metrics"):
             self._send(200, telemetry.render().encode(),
+                       "text/plain; version=0.0.4")
+        elif self.path.startswith("/slo"):
+            self._send(200, telemetry.render_slo().encode(),
                        "text/plain; version=0.0.4")
         elif self.path.startswith("/healthz"):
             self._send(200, json.dumps(telemetry.healthz()).encode(),

@@ -65,6 +65,7 @@ KNOWN_POINTS = (
     "mesh.device_lost",
     "step.hang",
     "obs.trace_drop",
+    "obs.flight_drop",
 )
 
 # One line per point; keys must equal KNOWN_POINTS (the analysis faults
@@ -102,6 +103,10 @@ POINT_DOCS = {
     "obs.trace_drop": (
         "lose one span at export — counted in dropped_total; the request it "
         "annotates must still succeed (obs/tracing.py)"),
+    "obs.flight_drop": (
+        "lose one flight-recorder event at record — counted in "
+        "obs_dropped_total; the request/step it annotates must still "
+        "succeed (obs/flightrec.py)"),
 }
 
 

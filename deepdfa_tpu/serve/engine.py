@@ -213,6 +213,15 @@ class ScoringEngine:
         self.last_warmup_report: dict | None = None
         self._bucket_fns: dict[ServeBucket, object] = {}
         self._lock = threading.RLock()
+        # attachment point set by the server: every dispatch records its
+        # bucket + real-graph count into the crash flight recorder
+        self.flight = None
+
+    def _record_dispatch(self, kind: str, bucket, n_graphs: int) -> None:
+        if self.flight is not None:  # record() never raises (invariant 14)
+            self.flight.record(kind, bucket=bucket.graph_nodes,
+                               n_graphs=n_graphs,
+                               dispatch=self.n_dispatches)
 
     # -- routing ------------------------------------------------------------
 
@@ -254,6 +263,7 @@ class ScoringEngine:
             fn = self._bucket_fns.get(bucket, self._score_fn)
             probs = np.asarray(fn(batch), np.float32)
             self.n_dispatches += 1
+        self._record_dispatch("engine.dispatch", bucket, len(graphs))
         return probs[: len(graphs)]
 
     def score_groups(self, groups, bucket: ServeBucket) -> list[np.ndarray]:
@@ -281,6 +291,8 @@ class ScoringEngine:
             stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *batches)
             probs = np.asarray(self._stacked_fn(stacked), np.float32)
             self.n_dispatches += 1
+        self._record_dispatch("engine.dispatch_stacked", bucket,
+                              sum(len(g) for g in groups))
         return [probs[i, : len(g)] for i, g in enumerate(groups)]
 
     def submit(self, graphs, bucket: ServeBucket) -> PendingScore:
@@ -306,6 +318,7 @@ class ScoringEngine:
             batch = self._padded_batch(graphs, bucket, feat_only=True)
             dev = self._device_fn(jax.tree.map(jnp.asarray, batch))
             self.n_dispatches += 1
+        self._record_dispatch("engine.submit", bucket, len(graphs))
         return PendingScore(dev, len(graphs))
 
     # -- warmup + warm store ------------------------------------------------

@@ -84,9 +84,11 @@ class ServeMetrics:
         self.dispatch = LatencyReservoir(latency_window)
         self.warmup: dict | None = None  # last engine warmup report
         # attachment points set by the server: the request tracer and the
-        # score-drift sentinel both render through /metrics when present
+        # score-drift sentinel both render through /metrics when present;
+        # the flight recorder gets every assembled batch's shape
         self.tracer = None
         self.drift = None
+        self.flight = None
 
     def set_warmup(self, report: dict) -> None:
         """Publish an engine warmup report (per-bucket compile seconds +
@@ -114,6 +116,8 @@ class ServeMetrics:
             self.batches_total += 1
             self.batch_graphs_total += n_real
             self.occupancy_sum += n_real / max(capacity, 1)
+        if self.flight is not None:  # record() never raises (invariant 14)
+            self.flight.record("batch", n_real=n_real, capacity=capacity)
 
     def mean_batch_occupancy(self) -> float | None:
         with self._lock:
@@ -245,4 +249,15 @@ class ServeMetrics:
                 alert_g.set(int(row["alert"]), model_rev=rev)
                 hist.set_histogram(row["current_counts"], row["current_sum"],
                                    row["current_n"], model_rev=rev)
+            reg.counter("score_drift_evicted_revs_total",
+                        "model_revs LRU-evicted from the drift sentinel "
+                        "(bounded /metrics cardinality)").set(
+                drift.evicted_revs_total)
+        flight = self.flight
+        if flight is not None:
+            reg.counter(
+                "obs_dropped_total",
+                "Flight-recorder events dropped instead of failing the "
+                "request they annotate (invariant 14)").set(
+                flight.dropped_total)
         return reg.render()

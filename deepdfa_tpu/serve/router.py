@@ -49,7 +49,13 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from deepdfa_tpu.config import ObsConfig
-from deepdfa_tpu.obs import MetricsRegistry, Tracer, parse_traceparent
+from deepdfa_tpu.obs import (
+    MetricsRegistry,
+    SLOEngine,
+    Tracer,
+    parse_traceparent,
+    router_specs,
+)
 from deepdfa_tpu.pipeline import source_key
 
 from .metrics import LatencyReservoir
@@ -244,6 +250,15 @@ class FleetRouter:
             exemplar_dir=obs.trace_dir, max_exemplars=obs.max_exemplars,
         ) if obs.trace else None
         self.metrics.tracer = self.tracer
+        # the router's verdict layer: availability + p99 SLOs judged from
+        # its own snapshot at /slo scrape time (invariant 16: same
+        # registry renderer as every other endpoint)
+        self.slo = SLOEngine(
+            router_specs(availability=obs.slo_availability,
+                         p99_ms=obs.slo_p99_ms),
+            fast_window_s=obs.slo_fast_window_s,
+            slow_window_s=obs.slo_slow_window_s,
+            burn_threshold=obs.slo_burn_threshold)
         self.probe_interval_s = float(probe_interval_s)
         self._draining = threading.Event()
         self._stop_requested = threading.Event()
@@ -293,6 +308,13 @@ class FleetRouter:
         self.httpd.shutdown()
         self.httpd.server_close()
         return self.metrics.snapshot()
+
+    def render_slo(self) -> str:
+        """The ``/slo`` body: the router's snapshot is already flat
+        (errors_total / requests_total / latency_p99_ms), so it feeds
+        the engine directly. Never fails the scrape (invariant 14)."""
+        self.slo.observe(self.metrics.snapshot())
+        return self.slo.render("deepdfa_router_")
 
     # -- backend health -----------------------------------------------------
 
@@ -456,6 +478,9 @@ def _make_handler(router: FleetRouter):
                 self._send(code, body)
             elif self.path == "/metrics":
                 self._send(200, router.metrics.render(),
+                           content_type="text/plain; version=0.0.4")
+            elif self.path == "/slo":
+                self._send(200, router.render_slo(),
                            content_type="text/plain; version=0.0.4")
             else:
                 self._send(404, {"error": f"no route {self.path}"})

@@ -39,7 +39,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
 from deepdfa_tpu.config import ExperimentConfig, ServeConfig
-from deepdfa_tpu.obs import ScoreDriftSentinel, Tracer, parse_traceparent
+from deepdfa_tpu.obs import (
+    FlightRecorder,
+    ScoreDriftSentinel,
+    SLOEngine,
+    Tracer,
+    parse_traceparent,
+    serve_specs,
+    write_alerts_artifact,
+)
+from deepdfa_tpu.obs.flightrec import install_sigusr2
 from deepdfa_tpu.pipeline import encode_source, load_vocabs, source_key
 from deepdfa_tpu.resilience import faults
 
@@ -83,9 +92,25 @@ class ScoreServer:
         self.drift = ScoreDriftSentinel(
             window=obs.drift_window, bins=obs.drift_bins,
             threshold=obs.drift_threshold,
-            min_samples=obs.drift_min_samples)
+            min_samples=obs.drift_min_samples,
+            max_revs=obs.drift_max_revs)
+        self.flight = FlightRecorder(
+            capacity=obs.flight_events, proc="serve",
+            dump_dir=obs.flight_dir)
+        self.slo = SLOEngine(
+            serve_specs(availability=obs.slo_availability,
+                        error_rate=obs.slo_error_rate,
+                        p99_ms=obs.slo_p99_ms),
+            fast_window_s=obs.slo_fast_window_s,
+            slow_window_s=obs.slo_slow_window_s,
+            burn_threshold=obs.slo_burn_threshold,
+            flight=self.flight)
+        self.alerts_path = Path(obs.alerts_path) if obs.alerts_path else None
         self.metrics.tracer = self.tracer
         self.metrics.drift = self.drift
+        self.metrics.flight = self.flight
+        if hasattr(engine, "flight"):
+            engine.flight = self.flight
         self.batcher = MicroBatcher(
             engine, max_batch=self.cfg.max_batch,
             max_wait_ms=self.cfg.max_wait_ms, max_queue=self.cfg.max_queue,
@@ -135,9 +160,11 @@ class ScoreServer:
     def install_signal_handlers(self) -> None:
         """SIGTERM/SIGINT → request a graceful drain. The handler only
         sets a flag; the actual drain runs in :meth:`wait` (signal
-        handlers must not join threads)."""
+        handlers must not join threads). SIGUSR2 → dump the flight
+        recorder (the live-incident probe)."""
         for sig in (signal.SIGTERM, signal.SIGINT):
             signal.signal(sig, lambda *_: self._stop_requested.set())
+        install_sigusr2(self.flight)
 
     def wait(self) -> dict:
         """Block until a shutdown is requested, then drain and stop.
@@ -168,6 +195,54 @@ class ScoreServer:
         snap["cache"] = self.cache.stats()
         return snap
 
+    # -- verdict layer (/slo) ----------------------------------------------
+
+    def _slo_snapshot(self) -> dict:
+        """The flat snapshot the SLO specs read: response counters split
+        by badness, the p99 gauge, and the drift sentinel's alert count
+        (the PR 8 PSI alert, wired into action here)."""
+        snap = self.metrics.snapshot()
+        responses = snap.get("responses_total") or {}
+        total = sum(responses.values())
+        bad_5xx = sum(n for code, n in responses.items() if int(code) >= 500)
+        errors = sum(n for code, n in responses.items() if int(code) >= 400)
+        drift_alerting = sum(
+            1 for row in self.drift.snapshot().values() if row["alert"])
+        return {
+            "responses_total": total,
+            "responses_5xx_total": bad_5xx,
+            "responses_error_total": errors,
+            "latency_p99_ms": snap.get("latency_p99_ms"),
+            "drift_alerting": drift_alerting,
+        }
+
+    def render_slo(self) -> str:
+        """The ``/slo`` body: evaluate the specs against the live
+        snapshot, journal any alert transitions as events, refresh the
+        ``alerts.json`` promotion veto, render through the shared
+        registry (invariant 16). None of the side effects can fail the
+        scrape (invariant 14 — drops count in ``obs_dropped_total``)."""
+        events = self.slo.observe(self._slo_snapshot())
+        if events:
+            for evt in events:
+                logger.warning("slo %s -> %s (burn fast=%s slow=%s)",
+                               evt["slo"], evt["state"], evt["burn_fast"],
+                               evt["burn_slow"])
+                if self.journal is not None:
+                    try:
+                        self.journal.write(
+                            event="slo_transition", slo=evt["slo"],
+                            state=evt["state"], t_unix=evt["t_unix"],
+                            burn_fast=evt["burn_fast"],
+                            burn_slow=evt["burn_slow"])
+                    except Exception:  # noqa: BLE001 — invariant 14
+                        self.slo.dropped_total += 1
+            if self.alerts_path is not None:
+                if write_alerts_artifact(self.alerts_path,
+                                         self.slo.statuses()) is None:
+                    self.slo.dropped_total += 1
+        return self.slo.render("deepdfa_serve_")
+
     # -- request handling ---------------------------------------------------
 
     def _span(self, name: str, parent=None, root: bool = False, **attrs):
@@ -185,6 +260,7 @@ class ScoreServer:
             return 503, {"error": "server is draining"}
         if faults.fire("serve.drop_request"):
             self.metrics.inc("dropped_total")
+            self.flight.record("fault.fired", point="serve.drop_request")
             return 503, {"error": "request dropped (injected fault "
                                   "serve.drop_request)"}
 
@@ -233,8 +309,14 @@ class ScoreServer:
             try:
                 prob = fut.result(timeout=max(0.0, deadline - time.monotonic()))
             except (TimeoutError, _FutureTimeout):
+                self.flight.record("request.timeout", function=row["function"])
                 return 504, {"error": "scoring timed out"}
             except Exception as exc:  # noqa: BLE001 — engine fault = 500
+                # the crash question "what was it doing?" gets a file:
+                # record the failure, then dump the whole ring atomically
+                self.flight.record("engine.error", function=row["function"],
+                                   error=f"{type(exc).__name__}: {exc}")
+                self.flight.dump("engine_error")
                 return 500, {"error": f"{type(exc).__name__}: {exc}"}
             row["vulnerable_probability"] = round(prob, 6)
             self.drift.observe(
@@ -284,6 +366,9 @@ def _make_handler(server: ScoreServer):
             elif self.path == "/metrics":
                 self._send(200, server.metrics.render(server.cache.stats()),
                            content_type="text/plain; version=0.0.4")
+            elif self.path == "/slo":
+                self._send(200, server.render_slo(),
+                           content_type="text/plain; version=0.0.4")
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -314,11 +399,15 @@ def _make_handler(server: ScoreServer):
                             sp.attrs["code"] = code
             except Exception as exc:  # noqa: BLE001 — request dies, server not
                 code, body = 500, {"error": f"{type(exc).__name__}: {exc}"}
+                server.flight.record("handler.crash",
+                                     error=f"{type(exc).__name__}: {exc}")
+                server.flight.dump("handler_crash")
             finally:
                 server.metrics.inc("inflight", -1)
             self._send(code, body)
-            server.metrics.observe_response(
-                code, (time.perf_counter() - t0) * 1000.0)
+            ms = (time.perf_counter() - t0) * 1000.0
+            server.metrics.observe_response(code, ms)
+            server.flight.record("request", code=code, ms=round(ms, 3))
 
     return Handler
 

@@ -392,18 +392,38 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
     telemetry = None
     telemetry_server = None
     if obs.trace:
-        from deepdfa_tpu.obs import TelemetryServer, Tracer, TrainTelemetry
+        from deepdfa_tpu.obs import (
+            FlightRecorder,
+            SLOEngine,
+            TelemetryServer,
+            Tracer,
+            TrainTelemetry,
+            train_specs,
+        )
+        from deepdfa_tpu.obs.flightrec import install_sigusr2
 
+        flight = FlightRecorder(
+            capacity=obs.flight_events, proc="train",
+            dump_dir=Path(obs.flight_dir) if obs.flight_dir else run_dir)
+        slo = SLOEngine(
+            train_specs(step_ms=obs.slo_step_ms,
+                        mfu_floor=obs.slo_mfu_floor),
+            fast_window_s=obs.slo_fast_window_s,
+            slow_window_s=obs.slo_slow_window_s,
+            burn_threshold=obs.slo_burn_threshold,
+            flight=flight)
         telemetry = TrainTelemetry(tracer=Tracer(
             proc="train", max_spans=obs.trace_buffer,
             slow_ms=0.0,  # journal every epoch root, capped by max_exemplars
             exemplar_dir=(Path(obs.trace_dir) if obs.trace_dir
                           else run_dir / "traces"),
-            max_exemplars=obs.max_exemplars))
+            max_exemplars=obs.max_exemplars),
+            slo=slo, flight=flight)
+        install_sigusr2(flight)  # no-op off the main thread
         if obs.train_port >= 0:
             telemetry_server = TelemetryServer(
                 telemetry, port=obs.train_port).start()
-            logger.info("trainer telemetry on :%d (/metrics, /healthz)",
+            logger.info("trainer telemetry on :%d (/metrics, /healthz, /slo)",
                         telemetry_server.port)
 
     def _aux(s: TrainState) -> dict:
@@ -542,7 +562,17 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
                 raise PreemptedExit(p.reason)
             except WatchdogTimeout as wt:
                 # a wedged device call: journal the timeout and abort —
-                # bounded and diagnosable instead of an eternal hang
+                # bounded and diagnosable instead of an eternal hang. The
+                # flight recorder dumps its ring first: the last-N events
+                # (steps, faults, ckpt commits) around the wedge are the
+                # post-mortem an aborted process can't reconstruct.
+                if telemetry is not None:
+                    telemetry.record_event(
+                        "watchdog.timeout", point=wt.point,
+                        deadline_s=wt.deadline_s, epoch=epoch,
+                        step=int(state.step))
+                    if telemetry.flight is not None:
+                        telemetry.flight.dump("watchdog_timeout")
                 journal.write(
                     epoch=epoch,
                     global_step=int(state.step),
@@ -573,6 +603,10 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
                     "rollback %d/%d: lr_scale=%.3g, retrying epoch %d",
                     n_rollbacks, res.max_rollbacks, trainer.lr_scale, epoch,
                 )
+                if telemetry is not None:
+                    telemetry.record_event(
+                        "sentinel.rollback", rollback=n_rollbacks,
+                        epoch=epoch, lr_scale=trainer.lr_scale)
                 continue
             route = _oversize_stats(batcher, "_train")
             val_m, val_loss = trainer.evaluate(state.params, _batch_stream(batcher, val))
@@ -600,6 +634,8 @@ def fit(cfg: ExperimentConfig, run_dir: Path, resume: bool = False) -> dict[str,
             if telemetry is not None:
                 telemetry.tracer.record("ckpt.commit", t_ckpt,
                                         step=int(state.step), epoch=epoch)
+                telemetry.record_event("ckpt.commit", step=int(state.step),
+                                       epoch=epoch)
             journal.write(
                 epoch=epoch,
                 global_step=int(state.step),
@@ -1173,10 +1209,12 @@ def main(argv: Sequence[str] | None = None) -> dict:
     parser = argparse.ArgumentParser(prog="deepdfa-tpu")
     parser.add_argument("command",
                         choices=["fit", "test", "analyze", "predict",
-                                 "export", "serve", "trace"])
+                                 "export", "serve", "trace", "bench"])
     parser.add_argument("subcommand", nargs="?", default=None,
                         help="trace: 'export' (the default) — merge a run "
-                        "dir's trace exemplars into Chrome trace-event JSON")
+                        "dir's trace exemplars into Chrome trace-event JSON; "
+                        "bench: 'ledger' (the default) — perf-regression "
+                        "verdicts over the repo's bench artifacts")
     parser.add_argument("--out", default=None,
                         help="trace export: output path (default: "
                         "<run-dir>/trace_events.json)")
@@ -1197,6 +1235,14 @@ def main(argv: Sequence[str] | None = None) -> dict:
     parser.add_argument("--artifact", default=None,
                         help="serve: pre-exported StableHLO artifact dir "
                         "(deepdfa-tpu export) instead of a checkpoint")
+    parser.add_argument("--check", action="store_true",
+                        help="bench ledger: exit non-zero when the latest "
+                        "entry of any series regressed past its band")
+    parser.add_argument("--trend", action="store_true",
+                        help="bench ledger: print per-series sparkline trends")
+    parser.add_argument("--ledger-dir", action="append", default=[],
+                        help="bench ledger: artifact file or directory to "
+                        "ingest (repeatable; default: CWD)")
     parser.add_argument("--saliency", choices=("occlusion", "gate"),
                         default="occlusion",
                         help="predict statement ranking: occlusion = per-"
@@ -1215,6 +1261,23 @@ def main(argv: Sequence[str] | None = None) -> dict:
             parser.error("trace export requires --run-dir")
         return trace_export(Path(args.run_dir),
                             Path(args.out) if args.out else None)
+    if args.command == "bench":
+        # like trace: a reporting path — no config load, no run-dir
+        # creation, no logging re-init. Works from any checkout with
+        # bench artifacts lying around (CI gates call it headless).
+        if (args.subcommand or "ledger") != "ledger":
+            parser.error(f"unknown bench subcommand {args.subcommand!r}")
+        from deepdfa_tpu.obs import ledger
+
+        ledger_argv = list(args.ledger_dir)
+        if args.check:
+            ledger_argv.append("--check")
+        if args.trend:
+            ledger_argv.append("--trend")
+        rc = ledger.main(ledger_argv)
+        if rc:
+            raise SystemExit(rc)
+        return {"command": "bench", "subcommand": "ledger", "rc": rc}
 
     layers = list(args.config)
     if args.command in ("predict", "export", "serve") and args.run_dir:

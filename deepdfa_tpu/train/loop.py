@@ -448,6 +448,10 @@ class Trainer:
                         consumed += 1
                         continue
                     if pre_armed and faults.fire("preempt.sigterm"):
+                        if telemetry is not None:
+                            telemetry.record_event(
+                                "fault.fired", point="preempt.sigterm",
+                                step=consumed)
                         preemption.trigger("injected fault preempt.sigterm")
                     if preemption is not None and preemption.triggered:
                         from deepdfa_tpu.resilience.preemption import Preempted
@@ -461,14 +465,23 @@ class Trainer:
                         # simulated wedged dispatch: parks until the
                         # watchdog's deadline cancels it → WatchdogTimeout,
                         # thread unwinds
+                        if telemetry is not None:
+                            telemetry.record_event(
+                                "fault.fired", point="step.hang",
+                                step=consumed)
                         watchdog.call(
                             "train_step",
                             lambda cancel: cancel.wait(),
                             cancel_aware=True,
                         )
+                    nan_fired = nan_armed and faults.fire("step.nan_grads")
+                    if nan_fired and telemetry is not None:
+                        telemetry.record_event(
+                            "fault.fired", point="step.nan_grads",
+                            step=consumed)
                     args = (
                         (state, batch, metrics, float("nan"))
-                        if nan_armed and faults.fire("step.nan_grads")
+                        if nan_fired
                         else (state, batch, metrics)
                     )
                     t_disp = time.time()

@@ -211,6 +211,31 @@ def _trace_overhead(p50_ms, spans_per_request: int = 6, n: int = 2000):
     }
 
 
+def _flight_overhead(p50_ms, events_per_request: int = 2, n: int = 2000):
+    """Same budget probe as :func:`_trace_overhead`, for the crash flight
+    recorder: time ``n`` raw ``record()`` calls on a throwaway ring, scale
+    by the events a scoring request emits (the per-request record plus its
+    share of batch/dispatch records), compare against the measured p50.
+    Shares the trace plane's < 2% invariant-15 budget; reported, not
+    gated."""
+    from deepdfa_tpu.obs import FlightRecorder
+
+    rec = FlightRecorder(capacity=256, proc="bench-overhead")
+    t0 = time.perf_counter()
+    for i in range(n):
+        rec.record("overhead.probe", i=i, code=200, ms=1.0)
+    per_event_ms = (time.perf_counter() - t0) / n * 1e3
+    per_request_ms = per_event_ms * events_per_request
+    frac = (per_request_ms / p50_ms) if p50_ms else None
+    return {
+        "per_event_us": round(per_event_ms * 1e3, 3),
+        "events_per_request": events_per_request,
+        "per_request_ms": round(per_request_ms, 4),
+        "fraction_of_p50": round(frac, 5) if frac is not None else None,
+        "under_2pct": (frac < 0.02) if frac is not None else None,
+    }
+
+
 def _run_phase(port: int, bodies: list[str], concurrency: int):
     """Closed loop: ``concurrency`` workers share one request list; each
     worker loops request → wait for response → next. Returns elapsed
@@ -451,6 +476,7 @@ def main(argv=None) -> dict:
             "dispatch_ms": {"p50": snap.get("dispatch_p50_ms"),
                             "p99": snap.get("dispatch_p99_ms")},
             "trace_overhead": _trace_overhead(snap.get("latency_p50_ms")),
+            "flight_overhead": _flight_overhead(snap.get("latency_p50_ms")),
             "precision_tiers": tiers,
             "tier_precision_served": tier_precision,
             "int8_refused_reason": tier_refusal,

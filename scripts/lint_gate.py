@@ -5,7 +5,9 @@ contract suite (``pytest -m perf_contract``) + the fleet unit suite
 suite (``pytest -m obs``: tracing, exposition conformance, drift) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
 lock-order, jit-purity/donation, fault-registry, metrics conformance
-static passes) in one command.
+static passes) + the perf-regression ledger (``python -m
+deepdfa_tpu.obs.ledger --check .``: the committed bench artifacts judged
+against their own per-device-kind history) in one command.
 
 No step touches an accelerator, compiles XLA, or takes more than a few
 seconds, so this is safe to run on every commit: ruff catches the syntax/
@@ -93,6 +95,19 @@ def main() -> int:
         sys.stdout.write(proc.stdout)
         sys.stderr.write(proc.stderr)
         failures.append("analysis")
+
+    # step 6: the perf-regression ledger — ingest every bench artifact in
+    # the repo root and fail when the latest entry of any (stage, metric,
+    # device_kind) series sits past its median±MAD band. Device-free and
+    # jax-free (the ledger module imports no accelerator code), so it
+    # belongs in the pre-commit gate: a committed artifact that regressed
+    # a tracked series fails HERE, not at the next device run.
+    print("lint_gate: python -m deepdfa_tpu.obs.ledger --check .")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepdfa_tpu.obs.ledger", "--check", "."],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("ledger")
 
     if failures:
         print(f"lint_gate: FAILED ({', '.join(failures)})")

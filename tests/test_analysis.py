@@ -90,6 +90,24 @@ def test_no_spurious_fixture_findings(fixture_findings):
         assert f.invariant_id in EXPECTED[name], f.render()
 
 
+def test_render_without_registry_is_flagged(tmp_path):
+    """The render-conformance rule (invariant 16, /slo extension): a
+    render_* function inside the obs/serve exposition scope that builds
+    its body by hand — no MetricsRegistry, no delegation to another
+    .render() — must be flagged. Fixtures can't pin this one (the rule is
+    path-scoped to deepdfa_tpu/obs|serve), so it gets a synthetic tree."""
+    mod = tmp_path / "deepdfa_tpu" / "obs" / "rogue_slo.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "def render_slo(statuses):\n"
+        "    return ''.join(f'{k} {v}' for k, v in statuses.items())\n")
+    model = ProjectModel.build(tmp_path, [tmp_path])
+    findings, _ = run_passes(model)
+    assert any(f.invariant_id == "metrics"
+               and "render_slo" in f.message for f in findings), (
+        "hand-rolled render_slo in deepdfa_tpu/obs/ was not flagged")
+
+
 # -- the repo itself gates green ---------------------------------------------
 
 

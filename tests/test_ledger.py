@@ -1,0 +1,224 @@
+"""The perf-regression ledger (`pytest -m obs` family, device-free).
+
+Golden properties pinned here:
+
+- a synthetic 20% regression on a lower-is-better series turns the
+  verdict red and ``--check`` nonzero (what lint_gate step 6 enforces);
+- a within-band wobble stays green;
+- every historical artifact shape ingests without crashing — the
+  ``{n, cmd, rc, tail, parsed}`` runner wrapper (``parsed`` may be
+  null), the pre-``schema_version`` artifacts, and the multichip smoke
+  shape;
+- device kinds never mix: CPU noise cannot gate TPU numbers;
+- the append-only store backfills idempotently;
+- the repo at HEAD gates green (the committed artifacts are the gate's
+  own seed history).
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from deepdfa_tpu.obs import Ledger, LedgerStore
+from deepdfa_tpu.obs.ledger import (
+    discover_artifacts,
+    iter_entries,
+    lower_is_better,
+)
+from deepdfa_tpu.obs.ledger import main as ledger_main
+
+pytestmark = pytest.mark.obs
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _art(dirpath: Path, name: str, emitted: int, device="cpu", **metrics):
+    doc = {"schema_version": 1, "git_rev": "ab" * 20, "git_dirty": False,
+           "emitted_at_unix": emitted, "device_kind": device, **metrics}
+    (dirpath / name).write_text(json.dumps(doc))
+    return dirpath / name
+
+
+def _history(dirpath: Path, values, metric="step_ms", device="cpu"):
+    for i, v in enumerate(values):
+        _art(dirpath, f"BENCH_t{i:02d}.json", emitted=1000 + i,
+             device=device, **{metric: v})
+
+
+# ---------------------------------------------------------------- direction
+
+
+def test_lower_is_better_heuristic():
+    for m in ("step_ms", "latency_p99_ms", "queue_wait_p50_ms", "wall_s",
+              "trace_overhead", "int8_score_delta", "psi"):
+        assert lower_is_better(m), m
+    for m in ("graphs_per_sec", "requests_per_sec", "mfu", "ok",
+              "cache_hit_rate", "speedup_vs_single"):
+        assert not lower_is_better(m), m
+
+
+# ----------------------------------------------------------------- verdicts
+
+
+def test_synthetic_20pct_regression_flips_red(tmp_path):
+    """The acceptance pin: a 20% step-time regression over a flat
+    baseline MUST go red (rel_tol 0.15 < 0.20 guarantees it)."""
+    _history(tmp_path, [100.0, 101.0, 99.0, 100.0, 120.0])
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    assert ok is False
+    (row,) = [r for r in rows if r["metric"] == "step_ms"]
+    assert row["verdict"] == "regression"
+    assert row["n_history"] == 4 and row["baseline"] == 100.0
+    assert row["lower_is_better"] is True
+    assert ledger_main(["--check", str(tmp_path)]) == 1
+
+
+def test_within_band_wobble_stays_green(tmp_path):
+    _history(tmp_path, [100.0, 101.0, 99.0, 100.0, 105.0])
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    assert ok is True
+    (row,) = [r for r in rows if r["metric"] == "step_ms"]
+    assert row["verdict"] == "ok"
+    assert ledger_main(["--check", str(tmp_path)]) == 0
+
+
+def test_higher_is_better_drop_is_regression(tmp_path):
+    _history(tmp_path, [300.0, 305.0, 295.0, 300.0, 240.0],
+             metric="graphs_per_sec")
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    (row,) = [r for r in rows if r["metric"] == "graphs_per_sec"]
+    assert row["lower_is_better"] is False
+    assert row["verdict"] == "regression" and ok is False
+    # ...and a 20% jump UP on the same series reads improved, not red
+    _history(tmp_path, [300.0, 305.0, 295.0, 300.0], metric="g2")
+    _art(tmp_path, "BENCH_t99.json", emitted=2000, g2=380.0)
+    _, rows = Ledger.from_paths([tmp_path]).check()
+    (row,) = [r for r in rows if r["metric"] == "g2"]
+    assert row["verdict"] == "improved"
+
+
+def test_young_series_never_red(tmp_path):
+    """min_history guards against verdicts on 1-2 samples: a wild second
+    entry is no_baseline, not a page."""
+    _history(tmp_path, [100.0, 900.0])
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    assert ok is True
+    assert {r["verdict"] for r in rows} == {"no_baseline"}
+
+
+def test_device_kinds_never_mix(tmp_path):
+    """CPU noise cannot gate TPU numbers: the same metric under two
+    device kinds is two series, and a slow CPU run after fast TPU
+    history stays green."""
+    _history(tmp_path, [10.0, 10.0, 10.0, 10.0], device="TPU v5e")
+    _art(tmp_path, "BENCH_cpu.json", emitted=5000, device="cpu",
+         step_ms=900.0)
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    assert ok is True
+    by_dev = {r["device_kind"]: r for r in rows if r["metric"] == "step_ms"}
+    assert set(by_dev) == {"TPU v5e", "cpu"}
+    assert by_dev["TPU v5e"]["verdict"] == "ok"
+    assert by_dev["cpu"]["verdict"] == "no_baseline"
+
+
+# ------------------------------------------------------- historical shapes
+
+
+def test_runner_wrapper_and_null_parsed_tolerated():
+    wrapped = {"n": 3, "cmd": "python bench.py", "rc": 0, "tail": "...",
+               "parsed": {"backend": "tpu", "git_rev": "cd" * 20,
+                          "step_ms": 12.5,
+                          "serving": {"p99_ms": 40.0, "ok": True}}}
+    rows = iter_entries(wrapped, source="BENCH_r02.json")
+    by_metric = {(r.stage, r.metric): r for r in rows}
+    assert by_metric[("headline", "step_ms")].value == 12.5
+    assert by_metric[("serving", "p99_ms")].value == 40.0
+    assert by_metric[("serving", "ok")].value == 1.0
+    # pre-versioned: device_kind falls back to backend
+    assert by_metric[("headline", "step_ms")].device_kind == "tpu"
+    # r05 shape: the run died before emitting — zero rows, zero crashes
+    assert iter_entries({"n": 5, "cmd": "x", "rc": 1, "tail": "boom",
+                         "parsed": None}) == []
+    assert iter_entries("not a dict") == []
+    assert iter_entries({"parsed": 7, "cmd": "x"}) == []
+
+
+def test_multichip_shape_becomes_ok_series():
+    rows = iter_entries({"n_devices": 8, "rc": 0, "ok": True,
+                         "skipped": False, "tail": "..."},
+                        source="MULTICHIP_r05.json")
+    assert len(rows) == 1
+    assert (rows[0].stage, rows[0].metric, rows[0].value) == (
+        "multichip", "ok", 1.0)
+
+
+def test_unreadable_artifact_is_zero_rows_not_a_crash(tmp_path):
+    bad = tmp_path / "BENCH_bad.json"
+    bad.write_text("{torn json")
+    led = Ledger()
+    assert led.ingest_path(bad) == 0
+    assert led.ingest_path(tmp_path / "BENCH_missing.json") == 0
+
+
+def test_discover_artifacts_globs_and_dedups(tmp_path):
+    a = _art(tmp_path, "BENCH_a.json", 1, step_ms=1.0)
+    b = _art(tmp_path, "MULTICHIP_a.json", 1, step_ms=1.0)
+    (tmp_path / "unrelated.json").write_text("{}")
+    found = discover_artifacts([tmp_path, a, str(b)])
+    assert [p.name for p in found] == ["BENCH_a.json", "MULTICHIP_a.json"]
+
+
+# ----------------------------------------------------------------- store
+
+
+def test_store_backfill_is_idempotent(tmp_path):
+    _history(tmp_path, [100.0, 101.0])
+    store = LedgerStore(tmp_path / "ledger.jsonl")
+    entries = Ledger.from_paths([tmp_path]).entries
+    assert store.ingest(entries) == len(entries) > 0
+    assert store.ingest(entries) == 0          # same sources: nothing new
+    assert len(store.load()) == len(entries)
+    _art(tmp_path, "BENCH_t09.json", emitted=1100, step_ms=99.0)
+    fresh = Ledger.from_paths([tmp_path]).entries
+    assert store.ingest(fresh) == 1            # only the new source lands
+    # a torn append tail is skipped, not fatal
+    with store.path.open("a") as fh:
+        fh.write('{"stage": "torn"')
+    assert len(store.load()) == len(entries) + 1
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def test_trend_lines_have_sparklines(tmp_path, capsys):
+    _history(tmp_path, [100.0, 101.0, 99.0, 100.0, 120.0])
+    assert ledger_main(["--trend", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    line = next(ln for ln in out.splitlines() if "step_ms" in ln)
+    assert "[cpu]" in line and "n=5" in line
+    assert any(ch in line for ch in Ledger._SPARK)
+    assert "regression" in line and "+20.0% vs median" in line
+
+
+def test_bench_ledger_reachable_from_main_entrypoint(tmp_path, capsys):
+    from deepdfa_tpu.train.cli import main
+
+    _history(tmp_path, [100.0, 101.0, 99.0, 100.0, 105.0])
+    summary = main(["bench", "ledger", "--ledger-dir", str(tmp_path),
+                    "--check"])
+    assert summary == {"command": "bench", "subcommand": "ledger", "rc": 0}
+    _art(tmp_path, "BENCH_t99.json", emitted=2000, step_ms=150.0)
+    with pytest.raises(SystemExit) as exc:
+        main(["bench", "ledger", "--ledger-dir", str(tmp_path), "--check"])
+    assert exc.value.code == 1
+
+
+def test_repo_head_gates_green():
+    """The committed artifacts ARE the seed history: the gate lint_gate
+    step 6 runs must pass at HEAD (a red HEAD would block every commit)."""
+    ledger = Ledger.from_paths([REPO])
+    assert len(ledger.entries) > 50            # r01..r05 really ingested
+    ok, rows = ledger.check()
+    assert ok is True, [r for r in rows if r["verdict"] == "regression"]
+    assert ledger_main(["--check", str(REPO)]) == 0

@@ -528,6 +528,378 @@ def test_trace_export_via_main_entrypoint(tmp_path, capsys):
     assert "traceEvents" in json.loads(out.read_text())
 
 
+# ---------------------------------------------------------------------------
+# crash flight recorder
+
+
+def test_flight_recorder_ring_bound_and_atomic_dump(tmp_path):
+    from deepdfa_tpu.obs import FlightRecorder
+
+    rec = FlightRecorder(capacity=4, proc="test", dump_dir=tmp_path)
+    for i in range(10):
+        assert rec.record("request", code=200, i=i) is True
+    events = rec.snapshot()
+    assert len(events) == 4                      # bounded: oldest fell off
+    assert [e["i"] for e in events] == [6, 7, 8, 9]
+    assert [e["seq"] for e in events] == [7, 8, 9, 10]
+    assert rec.recorded_total == 10 and rec.dropped_total == 0
+
+    path = rec.dump("unit_test")
+    assert path is not None and path.name.startswith("flight-")
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == 1 and doc["proc"] == "test"
+    assert doc["reason"] == "unit_test"
+    assert doc["recorded_total"] == 10
+    assert [e["i"] for e in doc["events"]] == [6, 7, 8, 9]
+    # no torn temp file left behind (atomic_write_text protocol)
+    assert [p.name for p in tmp_path.iterdir()] == [path.name]
+    # same-instant second dump gets a distinct name, not an overwrite
+    path2 = rec.dump("unit_test")
+    assert path2 is not None and path2 != path
+    assert rec.dumps_total == 2
+
+    # unserializable field values degrade via repr, never raise
+    rec.record("weird", obj=object())
+    assert rec.dump("weird") is not None
+
+
+def test_flight_recorder_dump_failure_never_raises(tmp_path):
+    from deepdfa_tpu.obs import FlightRecorder
+
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where the dump dir should be")
+    rec = FlightRecorder(capacity=2, proc="test", dump_dir=blocked)
+    rec.record("request")
+    assert rec.dump("crash") is None             # swallowed, counted
+    assert rec.dropped_total == 1
+
+
+def test_flight_recorder_unconfigured_dump_avoids_cwd(tmp_path, monkeypatch):
+    """Regression: with no dump dir configured, a dump must land in the
+    system temp dir, never the process CWD (a fault-injection test once
+    littered the repo root with flight-*.json)."""
+    import tempfile
+
+    from deepdfa_tpu.obs import FlightRecorder
+
+    monkeypatch.chdir(tmp_path)
+    rec = FlightRecorder(capacity=2, proc="test")
+    rec.record("request")
+    path = rec.dump("crash")
+    assert path is not None
+    assert path.parent == Path(tempfile.gettempdir())
+    assert not list(tmp_path.glob("flight-*.json"))
+    path.unlink()
+
+
+def test_flight_recorder_sigusr2_dumps(tmp_path):
+    import os
+    import signal as _signal
+
+    from deepdfa_tpu.obs import FlightRecorder, install_sigusr2
+
+    rec = FlightRecorder(capacity=8, proc="test", dump_dir=tmp_path)
+    rec.record("request", code=200)
+    prev = install_sigusr2(rec)
+    try:
+        os.kill(os.getpid(), _signal.SIGUSR2)
+        deadline = time.time() + 5.0
+        while rec.dumps_total < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert rec.dumps_total == 1
+        dumps = list(tmp_path.glob("flight-*.json"))
+        assert len(dumps) == 1
+        assert json.loads(dumps[0].read_text())["reason"] == "sigusr2"
+    finally:
+        if prev is not None:
+            _signal.signal(_signal.SIGUSR2, prev)
+
+
+@pytest.mark.faults
+def test_flight_drop_fault_never_fails_the_request(demo):
+    """The obs.flight_drop chaos point: losing a flight-recorder event
+    bumps the dropped counter and NOTHING else — the request it annotates
+    succeeds and both scrape endpoints export the drop."""
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.resilience import faults
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, sources = demo
+    srv = ScoreServer(_StubEngine(vocabs, max_batch=4), vocabs,
+                      ServeConfig(port=0, max_wait_ms=2.0)).start()
+    try:
+        with faults.installed("obs.flight_drop@1"):
+            status, data = _req(srv.port, "POST", "/score",
+                                json.dumps({"source": sources[0]}))
+            assert status == 200
+            assert json.loads(data)["results"]
+        deadline = time.time() + 5.0
+        while srv.flight.dropped_total < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert srv.flight.dropped_total == 1
+        assert srv.flight.recorded_total >= 1  # later events still land
+        text = srv.metrics.render(cache_stats=srv.cache.stats())
+        _assert_exposition(text)
+        assert "deepdfa_serve_obs_dropped_total 1" in text
+        status, body = _req(srv.port, "GET", "/slo")
+        assert status == 200
+        assert "deepdfa_serve_obs_dropped_total 1" in body.decode()
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.faults
+def test_engine_fault_dumps_flight_record(demo, tmp_path):
+    """A serve.engine_raises 500 must leave a flight-<ts>.json post-mortem
+    in the configured dump dir, with the failed request's events in the
+    ring."""
+    from deepdfa_tpu.config import ObsConfig, ServeConfig
+    from deepdfa_tpu.resilience import faults
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, sources = demo
+    cfg = ServeConfig(port=0, max_wait_ms=2.0,
+                      obs=ObsConfig(flight_dir=str(tmp_path)))
+    srv = ScoreServer(_StubEngine(vocabs, max_batch=4), vocabs, cfg).start()
+    try:
+        srv.engine.warmup()  # arm AFTER warmup (invariant 13)
+        with faults.installed("serve.engine_raises@1"):
+            status, data = _req(srv.port, "POST", "/score",
+                                json.dumps({"source": sources[0]}))
+        assert status == 500
+        assert "serve.engine_raises" in json.loads(data)["error"]
+        dumps = sorted(tmp_path.glob("flight-*.json"))
+        assert dumps, "engine fault did not dump a flight record"
+        doc = json.loads(dumps[-1].read_text())
+        assert doc["schema"] == 1 and doc["proc"] == "serve"
+        assert doc["reason"] == "engine_error"
+        kinds = {e["kind"] for e in doc["events"]}
+        assert "engine.error" in kinds
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+
+
+def test_slo_engine_multi_window_burn_and_transitions():
+    from deepdfa_tpu.obs import FlightRecorder, SLOEngine, SLOSpec
+
+    t = [1000.0]
+    flight = FlightRecorder(capacity=16, proc="test", clock=lambda: t[0])
+    eng = SLOEngine(
+        (SLOSpec("availability", "ratio", 0.99,
+                 bad="bad_total", total="requests_total"),
+         SLOSpec("latency_p99", "max", 100.0, value="p99_ms")),
+        fast_window_s=10.0, slow_window_s=60.0, burn_threshold=2.0,
+        clock=lambda: t[0], flight=flight)
+
+    assert eng.observe({"bad_total": 0, "requests_total": 100,
+                        "p99_ms": 50.0}) == []
+    t[0] += 5.0  # 5% of traffic failing = 5x the 1% budget: both windows
+    events = eng.observe({"bad_total": 5, "requests_total": 200,
+                          "p99_ms": 50.0})
+    assert [ (e["slo"], e["state"]) for e in events] == [
+        ("availability", "firing")]
+    assert events[0]["burn_fast"] > 2.0 and events[0]["burn_slow"] > 2.0
+    by_name = {s["slo"]: s for s in eng.statuses()}
+    assert by_name["availability"]["alert"] is True
+    assert by_name["latency_p99"]["alert"] is False  # 50 < 100: burn 0.5
+
+    # the incident ages out of the fast window -> resolved (multi-window:
+    # a long-dead burst must not page forever)
+    t[0] += 30.0
+    events = eng.observe({"bad_total": 5, "requests_total": 400,
+                          "p99_ms": 50.0})
+    assert [(e["slo"], e["state"]) for e in events] == [
+        ("availability", "resolved")]
+    assert eng.transitions_total == 2
+    # every transition was mirrored into the flight recorder
+    kinds = [e["kind"] for e in flight.snapshot()]
+    assert kinds.count("slo.transition") == 2
+
+    text = eng.render("deepdfa_serve_")
+    _assert_exposition(text)
+    assert 'deepdfa_serve_slo_alert{slo="availability"} 0' in text
+    assert 'deepdfa_serve_slo_burn_rate{slo="latency_p99",window="fast"}' \
+        in text
+    assert "deepdfa_serve_slo_evaluations_total 3" in text
+    assert "deepdfa_serve_obs_dropped_total 0" in text
+
+
+def test_slo_engine_gauge_floor_and_never_raises():
+    from deepdfa_tpu.obs import SLOEngine, train_specs
+
+    t = [0.0]
+    eng = SLOEngine(train_specs(step_ms=100.0, mfu_floor=0.4),
+                    fast_window_s=10.0, slow_window_s=10.0,
+                    clock=lambda: t[0])
+    for _ in range(3):
+        t[0] += 1.0
+        eng.observe({"mean_step_ms": 250.0, "mfu": 0.1})
+    by_name = {s["slo"]: s for s in eng.statuses()}
+    assert by_name["step_time"]["alert"] is True       # 250/100 = 2.5 > 1
+    assert by_name["mfu_floor"]["alert"] is True       # 0.4/0.1 = 4 > 1
+    # a hostile snapshot cannot fail the scrape (invariant 14)
+    assert eng.observe(None) == []
+    assert eng.observe({"mean_step_ms": "not-a-number"}) == []
+    assert eng.dropped_total == 2
+    _assert_exposition(eng.render("deepdfa_train_"))
+
+
+def test_write_alerts_artifact_promotion_veto(tmp_path):
+    from deepdfa_tpu.obs import write_alerts_artifact
+
+    path = tmp_path / "alerts.json"
+    out = write_alerts_artifact(
+        path,
+        [{"slo": "latency_p99", "alert": True, "burn_fast": 3.0},
+         {"slo": "availability", "alert": False}],
+        extra_alerts=[{"slo": "score_drift", "alert": True,
+                       "model_rev": "rev-a"}],
+        clock=lambda: 1234.0)
+    assert out == path
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == 1
+    assert doc["generated_at_unix"] == 1234
+    assert doc["firing"] == ["latency_p99", "score_drift"]
+    assert doc["promotion_vetoed"] is True
+
+    quiet = write_alerts_artifact(path, [{"slo": "availability",
+                                          "alert": False}])
+    assert quiet == path
+    assert json.loads(path.read_text())["promotion_vetoed"] is False
+    # unserializable statuses -> None, never an exception
+    assert write_alerts_artifact(path, [{"slo": object()}]) is None
+
+
+def test_slo_endpoint_on_all_three_processes(demo):
+    """The acceptance criterion: /slo exists on the serve server, the
+    router, and the trainer telemetry server, and all three bodies pass
+    the SAME exposition conformance checker under their own prefixes."""
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.obs import (
+        SLOEngine,
+        TelemetryServer,
+        TrainTelemetry,
+        train_specs,
+    )
+    from deepdfa_tpu.serve import FleetRouter, ScoreServer
+
+    vocabs, sources = demo
+    srv = ScoreServer(_StubEngine(vocabs, max_batch=4), vocabs,
+                      ServeConfig(port=0, max_wait_ms=2.0)).start()
+    router = FleetRouter([f"127.0.0.1:{srv.port}"], port=0,
+                         probe_interval_s=60.0)
+    router.probe_once()
+    router.start(probe=False)
+    telemetry = TrainTelemetry(
+        slo=SLOEngine(train_specs(step_ms=100.0), fast_window_s=10.0,
+                      slow_window_s=10.0))
+    telemetry.observe_step(0.01, 0.02, shape_key=("a",))
+    tsrv = TelemetryServer(telemetry, port=0).start()
+    try:
+        _req(router.port, "POST", "/score",
+             json.dumps({"source": sources[0]}))
+        for port, prefix in ((srv.port, "deepdfa_serve_"),
+                             (router.port, "deepdfa_router_"),
+                             (tsrv.port, "deepdfa_train_")):
+            status, body = _req(port, "GET", "/slo")
+            assert status == 200, prefix
+            text = body.decode()
+            _assert_exposition(text)
+            assert f"{prefix}slo_evaluations_total" in text, prefix
+            assert f"{prefix}obs_dropped_total 0" in text, prefix
+        # serve + router declare their default objectives
+        _, body = _req(srv.port, "GET", "/slo")
+        assert 'slo_objective{slo="availability"} 0.99' in body.decode()
+        _, body = _req(router.port, "GET", "/slo")
+        assert 'slo_objective{slo="latency_p99"}' in body.decode()
+        # trainer: the configured step-time spec is being evaluated
+        _, body = _req(tsrv.port, "GET", "/slo")
+        assert 'deepdfa_train_slo_objective{slo="step_time"} 100' \
+            in body.decode()
+    finally:
+        tsrv.stop()
+        router.shutdown()
+        srv.shutdown()
+
+
+def test_serve_slo_transition_journals_and_writes_alerts(demo, tmp_path):
+    """End to end on the serve server: an unmeetable p99 objective fires
+    on the first /slo scrape after traffic -> the transition is journaled
+    as an event AND alerts.json flips promotion_vetoed (the ROADMAP 5(b)
+    alert-ACTION)."""
+    from deepdfa_tpu.config import ObsConfig, ServeConfig
+    from deepdfa_tpu.resilience.journal import RunJournal
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, sources = demo
+    alerts = tmp_path / "alerts.json"
+    cfg = ServeConfig(port=0, max_wait_ms=2.0,
+                      obs=ObsConfig(slo_p99_ms=0.001,  # unmeetable ceiling
+                                    slo_fast_window_s=5.0,
+                                    slo_slow_window_s=5.0,
+                                    alerts_path=str(alerts)))
+    srv = ScoreServer(_StubEngine(vocabs, max_batch=4), vocabs, cfg,
+                      journal=RunJournal(tmp_path / "journal.json")).start()
+    try:
+        status, _ = _req(srv.port, "POST", "/score",
+                         json.dumps({"source": sources[0]}))
+        assert status == 200
+        status, body = _req(srv.port, "GET", "/slo")
+        assert status == 200
+        text = body.decode()
+        _assert_exposition(text)
+        assert 'deepdfa_serve_slo_alert{slo="latency_p99"} 1' in text
+
+        rec = srv.journal.read()
+        assert rec is not None and rec["event"] == "slo_transition"
+        assert rec["slo"] == "latency_p99" and rec["state"] == "firing"
+        assert rec["burn_fast"] > 1.0
+
+        doc = json.loads(alerts.read_text())
+        assert doc["promotion_vetoed"] is True
+        assert "latency_p99" in doc["firing"]
+        # the engine's transition ring kept the event too
+        assert [e["slo"] for e in srv.slo.transitions] == ["latency_p99"]
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# drift sentinel rev bound (LRU)
+
+
+def test_drift_sentinel_bounds_model_revs():
+    from deepdfa_tpu.obs import ScoreDriftSentinel
+
+    sent = ScoreDriftSentinel(window=8, bins=4, min_samples=2, max_revs=3)
+    for i in range(5):
+        for s in (0.1, 0.9):
+            sent.observe(s, f"rev-{i}")
+    snap = sent.snapshot()
+    assert len(snap) == 3                       # bounded, not 5
+    assert set(snap) == {"rev-2", "rev-3", "rev-4"}  # LRU: oldest evicted
+    assert sent.evicted_revs_total == 2
+    # re-observing a surviving rev refreshes it instead of re-evicting
+    sent.observe(0.5, "rev-2")
+    assert set(sent.snapshot()) == {"rev-2", "rev-3", "rev-4"}
+    with pytest.raises(ValueError):
+        ScoreDriftSentinel(max_revs=0)
+
+
+def test_drift_eviction_counter_rendered_in_serve_metrics():
+    m = _populated_serve_metrics()
+    m.drift.max_revs = 1
+    m.drift.observe(0.5, "rev-b")               # evicts rev-a
+    text = m.render()
+    _assert_exposition(text)
+    assert "deepdfa_serve_score_drift_evicted_revs_total 1" in text
+    assert 'model_rev="rev-a"' not in text      # bounded cardinality
+
+
 def test_report_profiling_traces_view(tmp_path, capsys):
     import report_profiling
 

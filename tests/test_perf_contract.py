@@ -13,7 +13,8 @@ import bench
 
 pytestmark = pytest.mark.perf_contract
 
-PROVENANCE_KEYS = {"git_rev", "git_dirty", "emitted_at_unix"}
+PROVENANCE_KEYS = {"schema_version", "git_rev", "git_dirty",
+                   "emitted_at_unix"}
 
 
 def _run(step_ms, graphs_per_sec=100.0):
@@ -31,6 +32,7 @@ def test_provenance_fields_real_hash_and_dirty_flag():
     assert p["git_rev"] is None or re.fullmatch(r"[0-9a-f]{40}", p["git_rev"])
     assert p["git_dirty"] in (True, False, None)
     assert isinstance(p["emitted_at_unix"], int)
+    assert p["schema_version"] == 1
 
 
 def test_every_new_assembler_carries_provenance():
