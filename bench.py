@@ -625,6 +625,9 @@ FUSED_TRAIN_BATCH_GRAPHS = 64
 FUSED_TRAIN_MAX_RATIO = 0.8      # gate: fused train step_ms <= 0.8x segment
 STRICT_LATENCY_MAX_RATIO = 0.25  # gate: latency-mode step_ms <= 0.25x strict
 R05_STRICT_STEP_MS = 71.0        # the r05 strict-dispatch anchor (TPU)
+R05_CHAINED_MFU = 0.0358         # r05 chained headline: 3.6% of the roofline
+MEGABATCH_MFU_TARGET_RATIO = 2.0  # gate: megabatch MFU >= 2x the r05 anchor
+MEGABATCH_EFFICIENCY_FLOOR = 0.95  # graphs-axis packing efficiency target
 LATENCY_WINDOW_DEPTH = 8         # in-flight submits in the latency-mode loop
 
 
@@ -763,6 +766,195 @@ def bench_fused_train(corpus, n_batches: int, k: int,
     segment = bench_chained(batches, k, train=True, dtype=dtype,
                             trials=trials, layout="segment")
     return fused, segment, bg
+
+
+def _megabatch_flops_per_step(plan) -> float:
+    """Kernel-math FLOPs of ONE whole-model launch at the plan's PADDED
+    shapes. XLA's cost analysis cannot see inside a Pallas custom call, so
+    the megabatch stage counts the matmul work the kernel actually issues:
+    ``n_steps`` message rounds (edge projection + both fused 3-gate GRU
+    projections), the pooling gate, the one-hot softmax/readout matmuls,
+    and the classifier head."""
+    from deepdfa_tpu.ops.fused_ggnn import _round_up
+
+    np_ = _round_up(max(plan.max_nodes, 8), 8)
+    dp = _round_up(max(plan.width, 1), 128)
+    gp = _round_up(max(plan.max_graphs, 1), 128)
+    rounds = plan.n_steps * (2 * np_ * dp * dp + 2 * 2 * np_ * dp * 3 * dp)
+    gate = 2 * np_ * 2 * dp * 128
+    # softmax max/denominator gathers + the [np, gp] x [gp, 2dp] readout
+    pool = 3 * 2 * np_ * gp + 2 * np_ * gp * 2 * dp
+    layers = max(plan.n_head_layers, 1)
+    head = ((layers - 1) * 2 * gp * 2 * dp * 2 * dp
+            + 2 * gp * 2 * dp * 128)
+    return float(rounds + gate + pool + head)
+
+
+def bench_megabatch(corpus, n_graphs: int, k: int, dtype: str = "bfloat16",
+                    trials: int = 3, int8_steps: int = 4):
+    """The ``ggnn_megabatch`` stage: cross-bucket packed megabatches through
+    the whole-model fused layout, chained-protocol timing, plus the frozen-
+    int8-conv training experiment on the SAME packed batches.
+
+    Returns ``(run, pack, ladder_dispatches, int8_train)`` where ``run`` is
+    the chained measurement (graphs/sec over REAL graphs, analytic kernel
+    FLOPs), ``pack`` the :class:`~deepdfa_tpu.ops.megabatch.PackResult`
+    (uniform-shape mode, so the scan chain compiles once), and
+    ``ladder_dispatches`` the number of batches the per-bucket
+    ``GraphBatcher`` ladder would dispatch for the same graphs at the
+    largest bucket budget the whole-model VMEM plan admits — the
+    ``bench_fused_train`` sizing idiom. Comparing against an unadmitted
+    bucket would let the ladder "win" with batches only the slow segment
+    path could actually launch."""
+    from deepdfa_tpu.config import ALL_SUBKEYS, ExperimentConfig
+    from deepdfa_tpu.data.graphs import GraphBatcher, derive_buckets
+    from deepdfa_tpu.ops.megabatch import (
+        fits_vmem_megabatch,
+        pack_megabatches,
+    )
+    from deepdfa_tpu.train.int8_train import run_int8_train
+
+    cfg = ExperimentConfig()
+    mcfg = cfg.model
+    graphs = list(corpus[:n_graphs])
+    bg = cfg.data.batch.batch_graphs
+    while bg >= 8:
+        buckets = derive_buckets(graphs, bg)
+        big = buckets[-1]
+        if fits_vmem_megabatch(
+                big.max_nodes, big.max_edges,
+                mcfg.hidden_dim * len(ALL_SUBKEYS), big.max_graphs,
+                table_rows=cfg.input_dim * len(ALL_SUBKEYS),
+                embed_width=mcfg.hidden_dim,
+                n_head_layers=mcfg.num_output_layers):
+            break
+        bg //= 2
+    else:
+        raise RuntimeError(
+            "no per-bucket ladder budget fits the whole-model VMEM plan — "
+            "even 8-graph buckets exceed fits_vmem_megabatch")
+    ladder_dispatches = len(list(GraphBatcher(buckets).batches(graphs)))
+    pack = pack_megabatches(
+        graphs,
+        width=mcfg.hidden_dim * len(ALL_SUBKEYS),
+        n_steps=mcfg.n_steps,
+        table_rows=cfg.input_dim * len(ALL_SUBKEYS),
+        embed_width=mcfg.hidden_dim,
+        n_head_layers=mcfg.num_output_layers,
+        max_batch_graphs=cfg.data.batch.batch_graphs,
+        uniform=True,
+    )
+    if not pack.batches:
+        raise RuntimeError(
+            f"packer produced no megabatches from {len(graphs)} graphs "
+            f"({len(pack.oversize)} oversize)")
+    run = bench_chained(pack.batches, k, train=False, dtype=dtype,
+                        trials=trials, layout="megabatch")
+    run["flops_per_step"] = _megabatch_flops_per_step(pack.plans[0])
+    int8_train = run_int8_train(pack.batches[:2], cfg=cfg,
+                                steps=int8_steps)
+    return run, pack, ladder_dispatches, int8_train
+
+
+def assemble_megabatch_result(backend, device_kind, run, pack,
+                              ladder_dispatches, roofline, nominal_tflops,
+                              int8_train=None, error=None):
+    """ONE-line block for the ``ggnn_megabatch`` stage.
+
+    The acceptance contract: on-device the chained MFU must reach
+    ``MEGABATCH_MFU_TARGET_RATIO`` × the r05 chained anchor (0.0358) OR
+    ``ceiling`` must record exactly which limit was hit — ``vmem_plan_
+    refusal`` (the uniform packed shape exceeded the whole-model VMEM
+    plan), ``packer_efficiency_floor`` (graphs-axis packing efficiency
+    under ``MEGABATCH_EFFICIENCY_FLOOR``), or ``memory_bandwidth_bound``
+    (plan fit and packing was efficient, so the hidden-width matmuls'
+    arithmetic intensity is the remaining limit). Off-device the gate is
+    structural: plan admitted, packing at or above the floor, and
+    megabatch dispatches strictly below the per-bucket ladder's.
+    FLOPs are kernel-math over the padded shapes (``flops_source``) —
+    cost analysis cannot see inside the Pallas call."""
+    eff = pack.efficiency if pack is not None else None
+    plan = pack.plans[0] if (pack is not None and pack.plans) else None
+    dispatches = (len(pack.batches) + len(pack.oversize)
+                  if pack is not None else None)
+    gps = run["graphs_per_sec"] if run else None
+    graphs_per_step = (gps * run["step_ms"] / 1e3
+                       if run and run.get("step_ms") else None)
+    fpg = (run["flops_per_step"] / graphs_per_step
+           if (run and run.get("flops_per_step") and graphs_per_step)
+           else None)
+    derived = _derived_columns(gps, fpg, roofline / 1e12 if roofline else None,
+                               nominal_tflops, None, None)
+    mfu = derived["mfu"]
+    plan_fits = bool(plan.fits) if plan is not None else None
+    dispatch_ok = (dispatches is not None
+                   and dispatches < ladder_dispatches
+                   if ladder_dispatches else None)
+    eff_ok = (eff is not None
+              and eff["graphs"] >= MEGABATCH_EFFICIENCY_FLOOR)
+    mfu_ok = None
+    ceiling = ceiling_note = None
+    if error is None and backend == "tpu":
+        mfu_ok = (mfu is not None
+                  and mfu >= MEGABATCH_MFU_TARGET_RATIO * R05_CHAINED_MFU)
+        if plan_fits is False:
+            ceiling = "vmem_plan_refusal"
+            ceiling_note = (
+                f"uniform packed shape needs {plan.working_set} bytes "
+                "> the whole-model VMEM plan cap")
+        elif not eff_ok:
+            ceiling = "packer_efficiency_floor"
+            ceiling_note = (
+                f"graphs-axis packing efficiency "
+                f"{eff['graphs']:.3f} < {MEGABATCH_EFFICIENCY_FLOOR}")
+        elif not mfu_ok:
+            ceiling = "memory_bandwidth_bound"
+            ceiling_note = (
+                "plan admitted and packing efficient: the remaining limit "
+                "is the conv matmuls' arithmetic intensity (~dp/4 "
+                "FLOPs/byte at the padded hidden width, far under the "
+                "MXU ridge point)")
+    if error is not None:
+        ok = False
+    elif backend == "tpu":
+        ok = bool(dispatch_ok) and (bool(mfu_ok) or ceiling is not None)
+    else:
+        ok = bool(dispatch_ok) and bool(eff_ok) and plan_fits is True
+    return {
+        "metric": "ggnn_megabatch_graphs_per_sec",
+        "value": round(gps, 1) if gps is not None else None,
+        "unit": "graphs/sec",
+        "backend": backend,
+        "device_kind": device_kind,
+        "step_ms": round(run["step_ms"], 3) if run else None,
+        "graphs_per_step": (round(graphs_per_step, 1)
+                            if graphs_per_step else None),
+        "flops_per_step": run.get("flops_per_step") if run else None,
+        "flops_source": "kernel-math (padded shapes)",
+        "implied_tflops": derived["implied_tflops"],
+        "mfu": mfu,
+        "mfu_nominal": derived["mfu_nominal"],
+        "anchor_chained_mfu": R05_CHAINED_MFU,
+        "mfu_target_ratio": MEGABATCH_MFU_TARGET_RATIO,
+        "mfu_ok": mfu_ok,
+        "packing_efficiency": eff,
+        "packing_efficiency_floor": MEGABATCH_EFFICIENCY_FLOOR,
+        "dispatches_per_step": dispatches,
+        "ladder_dispatches_per_step": ladder_dispatches,
+        "oversize_graphs": len(pack.oversize) if pack is not None else None,
+        "megabatch_shape": (
+            {"max_graphs": plan.max_graphs, "max_nodes": plan.max_nodes,
+             "max_edges": plan.max_edges} if plan is not None else None),
+        "working_set_bytes": plan.working_set if plan is not None else None,
+        "plan_fits": plan_fits,
+        "ceiling": ceiling,
+        "ceiling_note": ceiling_note,
+        "int8_train": int8_train,
+        "config": GOLDEN_CONFIG,
+        "error": error,
+        "ok": ok,
+        **_provenance_fields(),
+    }
 
 
 def _serve_engine_fixture(corpus, precision: str = "f32",
@@ -1617,6 +1809,13 @@ def replay_banked(reason: str) -> bool:
     result["replayed_from_banked"] = sources
     result["tpu_unavailable_at_emit"] = reason
     result.pop("partial_through_stage", None)
+    # Re-stamp provenance at MERGE time: the banked donors each carry
+    # their own (possibly pre-versioned, git_rev: null) attribution, and
+    # dict(base) would ship whichever the base happened to record. The
+    # merged artifact is emitted by THIS checkout now, so the three-tier
+    # block (git_rev / git_dirty / emitted_at_unix) must describe this
+    # emission — the donors' identities live in replayed_from_banked.
+    result.update(_provenance_fields())
     print(json.dumps(result))
     return True
 
@@ -1880,6 +2079,7 @@ def main():
     fused = fused_real = fused_error = None
     chained_train = strict = sentinel_stats = emergency_stats = None
     fused_train_stats = int8_serving_stats = strict_latency_stats = None
+    megabatch_stats = None
     peak_runs: dict[str, tuple] = {}
     peak_errors: dict[str, str] = {}
     base_gps = None
@@ -1911,6 +2111,8 @@ def main():
             r["int8_serving"] = int8_serving_stats
         if strict_latency_stats is not None:
             r["strict_latency"] = strict_latency_stats
+        if megabatch_stats is not None:
+            r["ggnn_megabatch"] = megabatch_stats
         tmp = partial_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(r, f)
@@ -2036,6 +2238,35 @@ def main():
             _progress(f"fused train failed: {fused_train_stats['error']}")
         bank("ggnn_fused_train")
 
+        # Megabatch packing + whole-model fusion: many buckets' graphs in
+        # ONE launch per packed megabatch (embed through label head), vs
+        # the per-bucket ladder's dispatch count on the same graphs. The
+        # frozen-int8-conv training experiment rides on the same packed
+        # batches and nests under this block (ledger series
+        # ggnn_megabatch.int8_train).
+        _progress("megabatch whole-model chained (ggnn_megabatch)")
+        try:
+            mb_graphs = (args.batches * 256 if backend == "tpu"
+                         else 2 * FUSED_BATCH_GRAPHS)
+            mb_k = args.chain if backend == "tpu" else min(args.chain, 4)
+            mb_run, mb_pack, mb_ladder, mb_int8 = bench_megabatch(
+                corpus, mb_graphs, mb_k,
+                int8_steps=4 if backend == "tpu" else 2)
+            megabatch_stats = assemble_megabatch_result(
+                backend, device_kind, mb_run, mb_pack, mb_ladder,
+                roofline, _nominal_peak_tflops(), int8_train=mb_int8)
+            _progress(
+                f"megabatch: {mb_run['graphs_per_sec']:.0f} g/s, "
+                f"{megabatch_stats['dispatches_per_step']} dispatches vs "
+                f"ladder {mb_ladder}, mfu={megabatch_stats['mfu']}, "
+                f"ceiling={megabatch_stats['ceiling']}")
+        except Exception as e:  # recorded verbatim, never swallowed
+            megabatch_stats = assemble_megabatch_result(
+                backend, device_kind, None, None, None, roofline,
+                None, error=f"{type(e).__name__}: {e}")
+            _progress(f"megabatch failed: {megabatch_stats['error']}")
+        bank("ggnn_megabatch")
+
     if args.layout == "both":
         # Serving-precision gate: int8 conv matmuls vs f32, tier p50/p99
         # both ways; refusal-with-fallback counts as the gate WORKING.
@@ -2115,6 +2346,8 @@ def main():
         result["int8_serving"] = int8_serving_stats
     if strict_latency_stats is not None:
         result["strict_latency"] = strict_latency_stats
+    if megabatch_stats is not None:
+        result["ggnn_megabatch"] = megabatch_stats
     print(json.dumps(result))
 
 

@@ -149,6 +149,26 @@ def _collect_entries(model: ProjectModel):
                     if callee is not None:
                         entries.setdefault(callee.key, f"defvjp at {fn.module.rel}:{cs.line}")
 
+    # module-level registrations: the ops kernels register their recompute
+    # backward at import time (`_model.defvjp(fwd, bwd)` at module scope,
+    # e.g. ops/fused_ggnn.py and ops/megabatch.py) — outside any
+    # FunctionInfo, so walk each module's top-level statements too
+    for info in model.modules.values():
+        for stmt in info.tree.body:
+            if not (isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            call = stmt.value
+            name = dotted_name(call.func)
+            if name is None or not name.endswith(".defvjp"):
+                continue
+            for arg in call.args:
+                target = dotted_name(arg)
+                key = info.functions.get(target) if target else None
+                if key is not None:
+                    entries.setdefault(
+                        key, f"defvjp at {info.rel}:{call.lineno}")
+
     # bindings and factories need assignment context: walk each function body
     for fn in model.functions.values():
         for stmt in ast.walk(fn.node):

@@ -121,8 +121,11 @@ class GGNNConfig:
     # the TPU fast path; models/ggnn_dense.py) | fused (segment batches fed
     # to ONE Pallas kernel holding node states VMEM-resident across all
     # n_steps rounds; models/ggnn_fused.py + ops/fused_ggnn.py — the
-    # scatter-bound rescue path). Same parameter tree in every layout:
-    # checkpoints interchange between them.
+    # scatter-bound rescue path) | megabatch (whole-model fusion: embed →
+    # messages → GRU → pool → head in ONE launch over cross-bucket packed
+    # megabatches, models/ggnn_megabatch.py + ops/megabatch.py; over-plan
+    # shapes route bit-identically to the segment twin). Same parameter
+    # tree in every layout: checkpoints interchange between them.
     layout: str = "segment"
     # widen the input with the static-analysis families (DFA_FAMILIES): one
     # hidden_dim-sized embedding table per family, concatenated after the

@@ -17,9 +17,14 @@ def make_model(cfg: GGNNConfig, input_dim: int):
         from deepdfa_tpu.models.ggnn_fused import GGNNFused
 
         return GGNNFused(cfg=cfg, input_dim=input_dim)
+    if cfg.layout == "megabatch":
+        from deepdfa_tpu.models.ggnn_megabatch import GGNNMegabatch
+
+        return GGNNMegabatch(cfg=cfg, input_dim=input_dim)
     if cfg.layout != "segment":
         raise ValueError(
-            f"unknown layout {cfg.layout!r} (segment | dense | fused)"
+            f"unknown layout {cfg.layout!r} (segment | dense | fused | "
+            "megabatch)"
         )
     from deepdfa_tpu.models.ggnn import GGNN
 

@@ -42,6 +42,7 @@ from pathlib import Path
 from statistics import median
 
 __all__ = [
+    "EXPLICIT_SERIES",
     "LedgerEntry",
     "Ledger",
     "LedgerStore",
@@ -69,8 +70,24 @@ _LOWER_TOKENS = ("latency", "wait", "overhead", "seconds", "wall",
                  "dropped", "errors", "delta", "psi")
 _LOWER_SUFFIXES = ("_ms", "_s", "_us")
 
+# Series whose direction is DECLARED rather than inferred. The name
+# heuristic already gets these right today, but the megabatch stage's
+# headline metrics are load-bearing gates (the whole-model-fusion PR is
+# judged on them), so their direction must not silently flip if the
+# token lists above ever grow a colliding substring. (stage, metric) →
+# lower_is_better.
+EXPLICIT_SERIES: dict[tuple[str, str], bool] = {
+    ("ggnn_megabatch", "mfu"): False,
+    ("ggnn_megabatch", "mfu_nominal"): False,
+    ("ggnn_megabatch", "graphs_per_sec"): False,
+    ("ggnn_megabatch", "packing_efficiency"): False,
+    ("ggnn_megabatch", "dispatches_per_step"): True,
+}
 
-def lower_is_better(metric: str) -> bool:
+
+def lower_is_better(metric: str, stage: str | None = None) -> bool:
+    if stage is not None and (stage, metric) in EXPLICIT_SERIES:
+        return EXPLICIT_SERIES[(stage, metric)]
     m = metric.lower()
     return m.endswith(_LOWER_SUFFIXES) or any(t in m for t in _LOWER_TOKENS)
 
@@ -245,7 +262,7 @@ class Ledger:
                 "stage": stage, "metric": metric, "device_kind": device,
                 "value": latest.value, "git_rev": latest.git_rev,
                 "source": latest.source, "n_history": len(prior),
-                "lower_is_better": lower_is_better(metric),
+                "lower_is_better": lower_is_better(metric, stage),
             }
             if len(prior) < min_history:
                 row.update(verdict="no_baseline", baseline=None, band=None)

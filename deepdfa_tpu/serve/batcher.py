@@ -220,6 +220,14 @@ class MicroBatcher:
         for b, probs in zip(batches, results):
             if self.metrics is not None:
                 self.metrics.observe_batch(len(b), bucket.capacity)
+                self.metrics.observe_padding(
+                    bucket.graph_nodes,
+                    real={"nodes": sum(i.graph.n_nodes for i in b),
+                          "edges": sum(i.graph.n_edges for i in b),
+                          "graphs": len(b)},
+                    padded={"nodes": bucket.spec.max_nodes,
+                            "edges": bucket.spec.max_edges,
+                            "graphs": bucket.spec.max_graphs})
             for item, p in zip(b, probs):
                 item.future.set_result(float(p))
         if tracer is not None:

@@ -54,7 +54,7 @@ from deepdfa_tpu.data.graphs import BucketSpec, Graph, _round_up, batch_np
 from deepdfa_tpu.resilience import faults
 
 __all__ = ["OversizeGraphError", "ServeBucket", "serve_buckets",
-           "ScoringEngine", "PendingScore"]
+           "mega_bucket", "ScoringEngine", "PendingScore"]
 
 
 class OversizeGraphError(ValueError):
@@ -94,6 +94,20 @@ def serve_buckets(max_batch: int) -> tuple[ServeBucket, ...]:
         out.append(ServeBucket(
             spec=BucketSpec(gcap + 1, nn, 4 * nn), graph_nodes=per_graph))
     return tuple(out)
+
+
+def mega_bucket(max_batch: int, graph_nodes: int = 1022) -> ServeBucket:
+    """The cross-bucket megabatch budget: ONE compiled shape wide enough
+    to absorb a whole mixed-size request window (small CFGs *and* mid-size
+    functions together), so :meth:`ScoringEngine.score_packed` replaces
+    the per-size-class ladder walk with a single dispatch. Node/edge
+    budgets cover ``2 * max_batch`` DeepDFA-regime graphs plus one
+    ``graph_nodes``-sized straggler — graphs over the budget still route
+    through the ladder per class."""
+    gcap = 2 * max(1, int(max_batch))
+    nn_ = _round_up(gcap * 126 + graph_nodes + 2)
+    return ServeBucket(spec=BucketSpec(gcap + 1, nn_, 4 * nn_),
+                       graph_nodes=graph_nodes)
 
 
 def _calibration_graphs(feat_keys, buckets, n_per_bucket: int = 4,
@@ -180,7 +194,8 @@ class ScoringEngine:
                  precision: str = "f32",
                  int8_score_delta: float | None = None,
                  stacked_fn=None, n_replicas: int = 1,
-                 model_rev: str | None = None, export_fn=None):
+                 model_rev: str | None = None, export_fn=None,
+                 mega: ServeBucket | None = None):
         if not buckets:
             raise ValueError("need at least one serving bucket")
         if score_fn is None and stacked_fn is None:
@@ -208,6 +223,10 @@ class ScoringEngine:
         self.label_style = label_style
         self.feat_keys = tuple(feat_keys)
         self.vocab_hash = vocab_hash
+        self.mega_bucket = mega
+        # packed-dispatch efficiency of the last score_packed call (the
+        # nodes/edges/graphs fractions the /metrics padding gauges track)
+        self.last_padding_efficiency: dict[str, float] | None = None
         self.n_dispatches = 0
         self.warm_buckets: list[int] = []
         self.last_warmup_report: dict | None = None
@@ -294,6 +313,64 @@ class ScoringEngine:
         self._record_dispatch("engine.dispatch_stacked", bucket,
                               sum(len(g) for g in groups))
         return [probs[i, : len(g)] for i, g in enumerate(groups)]
+
+    def score_packed(self, graphs) -> np.ndarray:
+        """Score a mixed-size request set through the megabatch bucket:
+        first-fit-decreasing pack the whole set into as few mega-shaped
+        batches as the node/edge/graph budgets allow and dispatch each —
+        one dispatch where the per-size-class ladder would walk several.
+        Graphs over the mega budget route through the ladder per graph
+        (:meth:`assign_bucket` semantics, including
+        :class:`OversizeGraphError`). Returns probabilities in input
+        order; records the packed batches' padding efficiency in
+        ``last_padding_efficiency``."""
+        if self.mega_bucket is None:
+            raise RuntimeError(
+                "score_packed needs a megabatch engine — construct with "
+                "from_model(..., megabatch=True) or pass mega=")
+        graphs = list(graphs)
+        if not graphs:
+            return np.zeros(0, np.float32)
+        spec = self.mega_bucket.spec
+        cap = self.mega_bucket.capacity
+        order = sorted(range(len(graphs)),
+                       key=lambda i: (-graphs[i].n_nodes,
+                                      -graphs[i].n_edges, i))
+        bins: list[list[int]] = []
+        loads: list[list[int]] = []  # [node-sum, edge-sum] per bin
+        overflow: list[int] = []
+        for i in order:
+            g = graphs[i]
+            if g.n_nodes > spec.max_nodes - 1 or g.n_edges > spec.max_edges:
+                overflow.append(i)
+                continue
+            for b, load in zip(bins, loads):
+                if (len(b) < cap
+                        and load[0] + g.n_nodes <= spec.max_nodes - 1
+                        and load[1] + g.n_edges <= spec.max_edges):
+                    b.append(i)
+                    load[0] += g.n_nodes
+                    load[1] += g.n_edges
+                    break
+            else:
+                bins.append([i])
+                loads.append([g.n_nodes, g.n_edges])
+        out = np.zeros(len(graphs), np.float32)
+        for b in bins:
+            out[np.asarray(b)] = self.score([graphs[i] for i in b],
+                                            self.mega_bucket)
+        for i in overflow:
+            out[i] = self.score([graphs[i]], self.assign_bucket(graphs[i]))[0]
+        if bins:
+            real_n = sum(load[0] for load in loads)
+            real_e = sum(load[1] for load in loads)
+            self.last_padding_efficiency = {
+                "nodes": real_n / (len(bins) * spec.max_nodes),
+                "edges": real_e / (len(bins) * spec.max_edges),
+                "graphs": sum(len(b) for b in bins)
+                / (len(bins) * spec.max_graphs),
+            }
+        return out
 
     def submit(self, graphs, bucket: ServeBucket) -> PendingScore:
         """Latency-mode dispatch: pad, upload, launch — NO host sync. The
@@ -456,6 +533,15 @@ class ScoringEngine:
                             stacklevel=2)
                         row["export_error"] = f"{type(exc).__name__}: {exc}"
             report["per_bucket"][str(b.graph_nodes)] = row
+        if self.mega_bucket is not None:
+            # the packed-dispatch shape compiles like any ladder bucket;
+            # it never exports (warm-store keys are ladder shapes) and is
+            # reported under "mega" so ladder rows keep their node keys
+            t0 = time.perf_counter()
+            self._warm_cold(self.mega_bucket, g)
+            report["per_bucket"]["mega"] = {
+                "key": None, "source": "compile",
+                "compile_seconds": round(time.perf_counter() - t0, 3)}
         report["compile_seconds_saved"] = round(
             report["compile_seconds_saved"], 3)
         self.warm_buckets = [b.graph_nodes for b in self.buckets]
@@ -474,7 +560,8 @@ class ScoringEngine:
                    vocab_hash: str | None = None, precision: str = "f32",
                    int8_max_score_delta: float = 0.01,
                    latency_mode: bool = False, calibration_graphs=None,
-                   journal=None, mesh=None) -> "ScoringEngine":
+                   journal=None, mesh=None,
+                   megabatch: bool = False) -> "ScoringEngine":
         """Live-model engine (the checkpoint path's core, split out so
         tests can inject fresh params without checkpoint machinery).
 
@@ -495,7 +582,12 @@ class ScoringEngine:
         and the batcher packs across replicas. Mesh engines dispatch
         synchronously (no donated-buffer submit loop) and keep their
         compiled stack in-process (the warm store serves the
-        single-replica router-fleet topology)."""
+        single-replica router-fleet topology).
+
+        ``megabatch=True`` additionally provisions the :func:`mega_bucket`
+        cross-bucket packed-dispatch shape (warmed alongside the ladder)
+        so :meth:`score_packed` can score a whole mixed-size request
+        window in one dispatch instead of one per size class."""
         import functools
 
         import jax
@@ -505,6 +597,7 @@ class ScoringEngine:
 
         keys = tuple(feat_keys)
         buckets = tuple(buckets or serve_buckets(max_batch))
+        mega = mega_bucket(max_batch) if megabatch else None
         model_rev = _params_content_hash(params)
 
         def _fns(scorer, ps):
@@ -585,7 +678,8 @@ class ScoringEngine:
                        feat_keys=keys, vocab_hash=vocab_hash,
                        latency_mode=latency_mode, precision=precision,
                        int8_score_delta=int8_delta, stacked_fn=stacked_fn,
-                       n_replicas=int(mesh.shape["dp"]), model_rev=model_rev)
+                       n_replicas=int(mesh.shape["dp"]), model_rev=model_rev,
+                       mega=mega)
 
         export_fn = _make_export_fn(chosen_model, chosen_params, label_style,
                                     keys)
@@ -593,7 +687,7 @@ class ScoringEngine:
                    feat_keys=keys, vocab_hash=vocab_hash,
                    device_fn=device_fn, latency_mode=latency_mode,
                    precision=precision, int8_score_delta=int8_delta,
-                   model_rev=model_rev, export_fn=export_fn)
+                   model_rev=model_rev, export_fn=export_fn, mega=mega)
 
     @classmethod
     def from_checkpoint(cls, cfg, ckpt_dir: Path | str, vocabs,

@@ -58,7 +58,11 @@ class ServeMetrics:
       occupancy sum (real graphs ÷ bucket graph capacity), so
       ``occupancy_sum / batches_total`` is the mean batch occupancy;
     - ``queue_depth`` — gauge, requests waiting in the micro-batch queue;
-    - ``inflight`` — gauge, ``/score`` requests currently being handled.
+    - ``inflight`` — gauge, ``/score`` requests currently being handled;
+    - ``padding_efficiency[bucket, axis]`` — gauge, the cumulative real ÷
+      padded fraction per serving bucket and axis (nodes/edges/graphs):
+      the fraction of each dispatched shape's budget occupied by real
+      entries, i.e. the direct multiplier on useful FLOPs per dispatch.
 
     Cache hit/miss counters live on the cache itself (:mod:`.cache`) and
     are merged into the rendering by the server.
@@ -75,6 +79,10 @@ class ServeMetrics:
         self.occupancy_sum = 0.0
         self.queue_depth = 0
         self.inflight = 0
+        # per-bucket padding accumulators: {bucket: {axis: [real, padded]}}
+        # — cumulative, so the exported gauge is the lifetime efficiency
+        # (stable under scrape timing, unlike a last-batch snapshot)
+        self.padding: dict[str, dict[str, list[float]]] = {}
         self.latency = LatencyReservoir(latency_window)
         # stage-level reservoirs fed by the tracing instrumentation: time a
         # graph sat in the micro-batch queue, and time one engine dispatch
@@ -119,6 +127,23 @@ class ServeMetrics:
         if self.flight is not None:  # record() never raises (invariant 14)
             self.flight.record("batch", n_real=n_real, capacity=capacity)
 
+    def observe_padding(self, bucket, real: dict, padded: dict) -> None:
+        """Accumulate one dispatched batch's real vs padded counts per
+        axis (``nodes``/``edges``/``graphs``) under the bucket's label."""
+        with self._lock:
+            acc = self.padding.setdefault(
+                str(bucket), {ax: [0.0, 0.0] for ax in real})
+            for ax, n in real.items():
+                acc[ax][0] += float(n)
+                acc[ax][1] += float(padded[ax])
+
+    def padding_efficiency(self) -> dict[str, dict[str, float]]:
+        """Cumulative real ÷ padded per bucket per axis."""
+        with self._lock:
+            return {bucket: {ax: (r / p if p else 0.0)
+                             for ax, (r, p) in acc.items()}
+                    for bucket, acc in self.padding.items()}
+
     def mean_batch_occupancy(self) -> float | None:
         with self._lock:
             if not self.batches_total:
@@ -140,6 +165,7 @@ class ServeMetrics:
                 "inflight": self.inflight,
                 "warmup": dict(self.warmup) if self.warmup else None,
             }
+        snap["padding_efficiency"] = self.padding_efficiency()
         snap["mean_batch_occupancy"] = (
             snap["occupancy_sum"] / snap["batches_total"]
             if snap["batches_total"] else None)
@@ -182,6 +208,15 @@ class ServeMetrics:
             snap["queue_depth"])
         reg.gauge("inflight", "/score requests currently in flight").set(
             snap["inflight"])
+        if snap["padding_efficiency"]:
+            pad = reg.gauge(
+                "padding_efficiency",
+                "Cumulative real / padded fraction of dispatched batch "
+                "budgets per bucket (axis: nodes, edges, graphs)",
+                labels=("bucket", "axis"))
+            for bucket, axes in snap["padding_efficiency"].items():
+                for axis, value in axes.items():
+                    pad.set(value, bucket=bucket, axis=axis)
         for family, help_, reservoir in (
                 ("latency_ms", "End-to-end /score latency", self.latency),
                 ("queue_wait_ms", "Time a graph waited in the micro-batch "

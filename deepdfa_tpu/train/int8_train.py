@@ -1,0 +1,138 @@
+"""Int8 TRAINING experiment on the message-passing matmuls.
+
+The serving path already proved the bandwidth story (PR 6): the hidden-32
+conv matmuls are memory-bound, int8 weights halve their bytes, and a
+per-bucket f32 score-delta gate refuses the quantisation whenever it moves
+probabilities. This module runs the same weights-int8 discipline at TRAIN
+time, over the megabatch-packed batches the whole-model path produces:
+
+- the conv (``edge_linear`` + both fused GRU projections) is quantized
+  once via :func:`~deepdfa_tpu.models.ggnn_int8.quantize_conv_params` and
+  FROZEN — :func:`~deepdfa_tpu.ops.int8_matmul.int8_matmul` is
+  differentiable w.r.t. activations only, which is exactly the frozen-base
+  convention, so gradients still flow *through* the int8 matmuls into the
+  embeddings upstream of them;
+- everything outside the conv (embedding tables, pooling gate, classifier
+  head) trains normally in f32 against the standard masked BCE;
+- admission reuses the PR 6 gate pattern, per BUCKET SHAPE: before any
+  step, f32-conv and int8-conv probabilities are compared on the same
+  params for every distinct batch shape, and the experiment REFUSES
+  (``accepted=False``, nothing trained) if any bucket's max delta exceeds
+  ``max_score_delta`` — a refusal is the gate working, not a failure.
+
+The result dict nests under the bench artifact's ``ggnn_megabatch`` block
+(``int8_train``), so its numeric leaves become perf-regression ledger
+series (``ggnn_megabatch.int8_train``) and an accuracy slide in the score
+delta or a loss that stops decreasing shows up as ledger drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deepdfa_tpu.config import ExperimentConfig
+from deepdfa_tpu.models import make_model
+from deepdfa_tpu.models.ggnn_int8 import GGNNInt8, quantize_conv_params
+from deepdfa_tpu.train.loop import bce_with_logits, extract_labels
+
+__all__ = ["DEFAULT_MAX_SCORE_DELTA", "bucket_shape_key", "run_int8_train"]
+
+# Train-time gate is looser than serving's 0.01: the deltas compound over
+# optimizer steps anyway, and the ledger guards the trained outcome — the
+# gate only has to catch a quantisation that is wrong from step zero.
+DEFAULT_MAX_SCORE_DELTA = 0.05
+
+
+def bucket_shape_key(batch) -> str:
+    """The gate's bucket identity: the compiled shape, which is what both
+    the jit cache and the VMEM plan key on."""
+    return (f"g{batch.graph_mask.shape[0]}"
+            f"_n{batch.node_mask.shape[0]}"
+            f"_e{batch.senders.shape[0]}")
+
+
+def run_int8_train(batches, *, cfg: ExperimentConfig | None = None,
+                   steps: int = 8, learning_rate: float = 1e-3,
+                   pos_weight: float = 15.0,
+                   max_score_delta: float = DEFAULT_MAX_SCORE_DELTA) -> dict:
+    """Run the frozen-int8-conv training experiment over ``batches``
+    (segment-layout ``BatchedGraphs`` — megabatch-packed or per-bucket).
+
+    Returns a JSON-able dict: the gate verdict (``accepted``,
+    ``int8_score_delta``, ``per_bucket_delta``, ``refused_reason``) plus,
+    when accepted, the training trace (``steps``, ``loss_first``,
+    ``loss_last``, ``loss_decreased``). Never raises on refusal.
+    """
+    cfg = cfg or ExperimentConfig()
+    mcfg = dataclasses.replace(cfg.model, layout="segment", dtype="float32")
+    model32 = make_model(mcfg, input_dim=cfg.input_dim)
+    model8 = GGNNInt8(cfg=mcfg, input_dim=cfg.input_dim)
+    dev = [jax.tree.map(jnp.asarray, b) for b in batches]
+    params32 = model32.init(jax.random.key(0), dev[0])["params"]
+    qparams = quantize_conv_params({"params": params32})["params"]
+
+    # -- per-bucket f32-delta admission gate (the PR 6 pattern) -------------
+    p32_fn = jax.jit(lambda p, b: jax.nn.sigmoid(
+        model32.apply({"params": p}, b)))
+    p8_fn = jax.jit(lambda p, b: jax.nn.sigmoid(
+        model8.apply({"params": p}, b)))
+    per_bucket: dict[str, float] = {}
+    for b in dev:
+        real = np.asarray(b.graph_mask)
+        d = np.abs(np.asarray(p32_fn(params32, b), np.float32)
+                   - np.asarray(p8_fn(qparams, b), np.float32))[real]
+        delta = float(d.max()) if d.size else 0.0
+        key = bucket_shape_key(b)
+        per_bucket[key] = max(per_bucket.get(key, 0.0), delta)
+    int8_delta = max(per_bucket.values(), default=0.0)
+    result: dict = {
+        "accepted": int8_delta <= max_score_delta,
+        "int8_score_delta": round(int8_delta, 6),
+        "max_score_delta": max_score_delta,
+        "per_bucket_delta": {k: round(v, 6)
+                             for k, v in sorted(per_bucket.items())},
+        "refused_reason": None,
+        "steps": 0,
+    }
+    if not result["accepted"]:
+        result["refused_reason"] = (
+            f"max per-bucket score delta {int8_delta:.2e} exceeds "
+            f"max_score_delta {max_score_delta:.2e}")
+        return result
+
+    # -- frozen-conv training: int8 "ggnn" subtree out of the optimizer ----
+    frozen_conv = qparams["ggnn"]
+    trainable = {k: v for k, v in qparams.items() if k != "ggnn"}
+    opt = optax.adam(learning_rate)
+    opt_state = opt.init(trainable)
+
+    @jax.jit
+    def train_step(trainable, opt_state, batch):
+        def loss_fn(tr):
+            params = dict(tr)
+            params["ggnn"] = frozen_conv
+            logits = model8.apply({"params": params}, batch)
+            labels, weights = extract_labels(batch, mcfg.label_style)
+            return bce_with_logits(logits, labels, weights, pos_weight)
+
+        loss, grads = jax.value_and_grad(loss_fn)(trainable)
+        updates, opt_state = opt.update(grads, opt_state, trainable)
+        return optax.apply_updates(trainable, updates), opt_state, loss
+
+    losses: list[float] = []
+    for i in range(steps):
+        trainable, opt_state, loss = train_step(
+            trainable, opt_state, dev[i % len(dev)])
+        losses.append(float(loss))
+    result.update(
+        steps=steps,
+        loss_first=round(losses[0], 6),
+        loss_last=round(losses[-1], 6),
+        loss_decreased=bool(losses[-1] < losses[0]),
+    )
+    return result

@@ -297,7 +297,7 @@ class Trainer:
         # steps instead; correctness is never gated on VMEM.
         self.fallback_train_step = self.fallback_eval_step = None
         self._seg_twin = None
-        if self.cfg.model.layout in ("dense", "fused"):
+        if self.cfg.model.layout in ("dense", "fused", "megabatch"):
             import dataclasses as _dc
 
             from deepdfa_tpu.models import make_model
@@ -350,6 +350,19 @@ class Trainer:
                     batch.senders.shape[0],
                     self.cfg.model.out_dim // 2,
                 ):
+                    return self.train_step, self.eval_step
+            elif self.cfg.model.layout == "megabatch":
+                # megabatch consumes segment batches natively; only shapes
+                # whose whole-model VMEM plan is refused drop to the segment
+                # twin. (The model's own over-plan path computes the same
+                # bit-identical segment math, but routing through the twin's
+                # steps keeps the compiled-step cache per-layout and the
+                # dispatch accounting honest.)
+                if self.model.plan_for(
+                    batch.node_mask.shape[0],
+                    batch.senders.shape[0],
+                    batch.graph_mask.shape[0],
+                ).fits:
                     return self.train_step, self.eval_step
             return self.fallback_train_step, self.fallback_eval_step
         return self.train_step, self.eval_step

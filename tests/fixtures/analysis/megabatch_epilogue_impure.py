@@ -1,0 +1,32 @@
+"""Seeded violation: jit-purity through a ``defvjp`` registration.
+
+The whole-model megabatch kernel (``ops/megabatch.py``) registers its
+recompute backward via ``_megabatch_model.defvjp(fwd, bwd)`` — a traced
+entry point the purity pass must collect even though no ``@jax.jit``
+decorates it. This fixture mirrors that shape: ``_bwd`` is reachable only
+through the ``defvjp`` registration and reads the host wall clock, which
+would freeze at trace time. The jax pass must flag the ``time.time()``
+call inside the registered backward.
+"""
+
+import functools
+import time
+
+import jax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def epilogue(x, n_steps):
+    return x * n_steps
+
+
+def _fwd(x, n_steps):
+    return epilogue(x, n_steps), x
+
+
+def _bwd(n_steps, res, g):
+    # impure: wall-clock scaling inside the recompute backward
+    return (g * res * time.time(),)
+
+
+epilogue.defvjp(_fwd, _bwd)

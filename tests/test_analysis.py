@@ -47,6 +47,7 @@ EXPECTED = {
     "checkpoint_torn_write.py": {"atomic-commit"},
     "serve_lock_cycle.py": {"lock-order", "unguarded-state"},
     "jit_impure.py": {"jit-purity"},
+    "megabatch_epilogue_impure.py": {"jit-purity"},
     "jit_double_donation.py": {"donation"},
     "fault_unregistered.py": {"fault-registry"},
     "metrics_rogue.py": {"metrics"},

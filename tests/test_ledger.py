@@ -22,6 +22,7 @@ import pytest
 
 from deepdfa_tpu.obs import Ledger, LedgerStore
 from deepdfa_tpu.obs.ledger import (
+    EXPLICIT_SERIES,
     discover_artifacts,
     iter_entries,
     lower_is_better,
@@ -56,6 +57,41 @@ def test_lower_is_better_heuristic():
     for m in ("graphs_per_sec", "requests_per_sec", "mfu", "ok",
               "cache_hit_rate", "speedup_vs_single"):
         assert not lower_is_better(m), m
+
+
+def test_megabatch_series_are_explicitly_declared():
+    """Satellite pin (PR 11): the megabatch stage's headline metrics are
+    DECLARED, not just inferred — the heuristic classifies ``mfu`` and
+    ``graphs_per_sec`` as higher-is-better today, and the explicit map
+    keeps them that way even if the token lists drift."""
+    # heuristic agrees with the declaration (no shadowing surprise)
+    assert lower_is_better("mfu") is False
+    assert lower_is_better("graphs_per_sec") is False
+    # the declarations exist and carry the right direction
+    assert EXPLICIT_SERIES[("ggnn_megabatch", "mfu")] is False
+    assert EXPLICIT_SERIES[("ggnn_megabatch", "graphs_per_sec")] is False
+    assert EXPLICIT_SERIES[("ggnn_megabatch", "dispatches_per_step")] is True
+    # the stage-aware form consults the map; a drop in dispatches/step is
+    # an IMPROVEMENT even though nothing in the name says so
+    assert lower_is_better("dispatches_per_step", "ggnn_megabatch") is True
+    assert lower_is_better("mfu", "ggnn_megabatch") is False
+    assert lower_is_better("graphs_per_sec", "ggnn_megabatch") is False
+
+
+def test_explicit_series_direction_flows_into_verdicts(tmp_path):
+    """A dispatches_per_step DROP under the megabatch stage must read
+    improved (the declared direction), exercised end-to-end through
+    ``verdicts`` rather than just the lookup function."""
+    for i, v in enumerate([12.0, 12.0, 12.0, 12.0]):
+        _art(tmp_path, f"BENCH_t{i:02d}.json", emitted=1000 + i,
+             ggnn_megabatch={"dispatches_per_step": v})
+    _art(tmp_path, "BENCH_t99.json", emitted=2000,
+         ggnn_megabatch={"dispatches_per_step": 3.0})
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    (row,) = [r for r in rows if r["metric"] == "dispatches_per_step"]
+    assert row["stage"] == "ggnn_megabatch"
+    assert row["lower_is_better"] is True
+    assert row["verdict"] == "improved" and ok is True
 
 
 # ----------------------------------------------------------------- verdicts

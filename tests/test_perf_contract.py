@@ -233,6 +233,135 @@ def test_fleet_unrouted_replica_fails():
         **_fleet_kwargs(per_replica={}))["ok"] is False
 
 
+# --------------------------------------------------------------- megabatch
+
+
+def _mb_pack(n_batches=3, n_oversize=0, graphs_eff=0.97, fits=True):
+    """A PackResult-shaped measurement; tests flip one knob at a time."""
+    from deepdfa_tpu.ops.megabatch import MegabatchPlan, PackResult
+
+    shape = ((512, 1024) if fits else (400_000, 800_000))
+    plan = MegabatchPlan(
+        max_graphs=33, max_nodes=shape[0], max_edges=shape[1],
+        width=128, n_steps=5, table_rows=208, embed_width=32,
+        n_head_layers=2)
+    assert plan.fits is fits
+    return PackResult(batches=[object()] * n_batches, plans=[plan],
+                      oversize=[object()] * n_oversize,
+                      efficiency={"nodes": 0.62, "edges": 0.55,
+                                  "graphs": graphs_eff})
+
+
+def _mb_run(graphs_per_sec=1000.0, step_ms=100.0, flops_per_step=8e9):
+    # graphs/step = 100, flops/graph = 8e7; at roofline 1e12 the implied
+    # MFU is 0.08 — above the 2 x 0.0358 = 0.0716 acceptance target
+    return {"graphs_per_sec": graphs_per_sec, "step_ms": step_ms,
+            "flops_per_step": flops_per_step}
+
+
+def test_megabatch_schema_and_cpu_structural_gate():
+    art = bench.assemble_megabatch_result(
+        "cpu", "cpu", _mb_run(), _mb_pack(), ladder_dispatches=10,
+        roofline=None, nominal_tflops=None)
+    assert art["metric"] == "ggnn_megabatch_graphs_per_sec"
+    assert art["unit"] == "graphs/sec"
+    assert art["value"] == 1000.0 and art["graphs_per_step"] == 100.0
+    assert art["flops_source"] == "kernel-math (padded shapes)"
+    assert art["anchor_chained_mfu"] == bench.R05_CHAINED_MFU
+    assert art["mfu_target_ratio"] == bench.MEGABATCH_MFU_TARGET_RATIO
+    assert art["packing_efficiency_floor"] == bench.MEGABATCH_EFFICIENCY_FLOOR
+    assert art["dispatches_per_step"] == 3
+    assert art["ladder_dispatches_per_step"] == 10
+    assert art["plan_fits"] is True and art["ceiling"] is None
+    assert art["mfu_ok"] is None  # the MFU claim is a TPU claim
+    assert art["ok"] is True
+    assert PROVENANCE_KEYS <= set(art)
+
+
+@pytest.mark.parametrize("knob", ["efficiency", "dispatches", "plan"])
+def test_megabatch_cpu_structural_gates_each_fail_alone(knob):
+    kw = dict(run=_mb_run(), pack=_mb_pack(), ladder_dispatches=10)
+    if knob == "efficiency":
+        kw["pack"] = _mb_pack(graphs_eff=0.90)
+    elif knob == "dispatches":
+        kw["ladder_dispatches"] = 3  # not strictly lower
+    else:
+        kw["pack"] = _mb_pack(fits=False)
+    art = bench.assemble_megabatch_result(
+        "cpu", "cpu", roofline=None, nominal_tflops=None, **kw)
+    assert art["ok"] is False, knob
+
+
+def test_megabatch_tpu_mfu_target_met_is_ok():
+    art = bench.assemble_megabatch_result(
+        "tpu", "TPU v5e", _mb_run(), _mb_pack(), ladder_dispatches=10,
+        roofline=1e12, nominal_tflops=None)
+    assert art["mfu"] == pytest.approx(0.08)
+    assert art["mfu_ok"] is True
+    assert art["ceiling"] is None and art["ok"] is True
+
+
+def test_megabatch_tpu_ceiling_chain_is_exact():
+    """Below-target MFU on TPU is acceptable ONLY with the exact ceiling
+    recorded — and the chain picks the FIRST limit hit: plan refusal over
+    packing floor over bandwidth."""
+    # slow run: same FLOPs over 10x the time -> mfu 0.008, under target
+    slow = _mb_run(graphs_per_sec=100.0, step_ms=1000.0)
+    art = bench.assemble_megabatch_result(
+        "tpu", "TPU v5e", slow, _mb_pack(), ladder_dispatches=10,
+        roofline=1e12, nominal_tflops=None)
+    assert art["mfu_ok"] is False
+    assert art["ceiling"] == "memory_bandwidth_bound"
+    assert art["ok"] is True  # honest ceiling = acceptance contract met
+
+    floor = bench.assemble_megabatch_result(
+        "tpu", "TPU v5e", slow, _mb_pack(graphs_eff=0.80),
+        ladder_dispatches=10, roofline=1e12, nominal_tflops=None)
+    assert floor["ceiling"] == "packer_efficiency_floor"
+    assert "0.800" in floor["ceiling_note"]
+
+    refusal = bench.assemble_megabatch_result(
+        "tpu", "TPU v5e", slow, _mb_pack(fits=False),
+        ladder_dispatches=10, roofline=1e12, nominal_tflops=None)
+    assert refusal["ceiling"] == "vmem_plan_refusal"
+    assert refusal["plan_fits"] is False
+
+
+def test_megabatch_tpu_dispatch_regression_fails_despite_ceiling():
+    """The dispatches-strictly-lower gate is never waived — a megabatch
+    run that dispatches as often as the ladder fails even with a
+    recorded ceiling."""
+    art = bench.assemble_megabatch_result(
+        "tpu", "TPU v5e", _mb_run(graphs_per_sec=100.0, step_ms=1000.0),
+        _mb_pack(), ladder_dispatches=3, roofline=1e12, nominal_tflops=None)
+    assert art["ceiling"] == "memory_bandwidth_bound"
+    assert art["ok"] is False
+
+
+def test_megabatch_error_path_not_ok():
+    art = bench.assemble_megabatch_result(
+        "cpu", "cpu", None, None, None, roofline=None, nominal_tflops=None,
+        error="packer produced no megabatches")
+    assert art["ok"] is False and art["value"] is None
+    assert art["error"] == "packer produced no megabatches"
+    assert art["dispatches_per_step"] is None
+    assert PROVENANCE_KEYS <= set(art)
+
+
+def test_megabatch_carries_int8_train_block_verbatim():
+    """The int8-train verdict nests under the stage so its numeric leaves
+    become ``ggnn_megabatch.int8_train`` ledger series; a refusal dict
+    rides along unchanged (refusal is the gate working)."""
+    refusal = {"accepted": False, "int8_score_delta": 0.3,
+               "max_score_delta": 0.05, "steps": 0,
+               "refused_reason": "max per-bucket score delta ..."}
+    art = bench.assemble_megabatch_result(
+        "cpu", "cpu", _mb_run(), _mb_pack(), ladder_dispatches=10,
+        roofline=None, nominal_tflops=None, int8_train=refusal)
+    assert art["int8_train"] == refusal
+    assert art["ok"] is True  # the int8 experiment never gates the stage
+
+
 def test_serve_result_ands_fleet_block():
     """The serving artifact carries the fleet block and ANDs its ok —
     a green single-replica run cannot mask a failed fleet phase."""
