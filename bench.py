@@ -495,7 +495,8 @@ SERVE_MIN_OCCUPANCY = 0.5
 def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
                           p99_ms, mean_batch_occupancy, cache_hit_rate,
                           cache_hits, requests_total, errors_total,
-                          concurrency=None, notes=None, fleet=None):
+                          concurrency=None, notes=None, fleet=None,
+                          autoscale=None):
     """ONE-line artifact for the serving stage (scripts/bench_serving.py).
 
     Shared between the load generator and the bench-contract test so the
@@ -505,7 +506,9 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
     "batch" per request would pass a pure throughput check), and the
     repeated-corpus phase produced real cache hits (asserted via the hit
     COUNTER, not timing). ``fleet`` (an ``assemble_fleet_result`` block,
-    from ``--fleet N`` runs) rides along and ANDs its own ok."""
+    from ``--fleet N`` runs) and ``autoscale`` (an
+    ``assemble_autoscale_result`` block, from ``--autoscale`` runs) ride
+    along and AND their own ok."""
     ok = (requests_total > 0 and errors_total == 0
           and requests_per_sec > 0
           and mean_batch_occupancy is not None
@@ -513,6 +516,8 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
           and cache_hits > 0)
     if fleet is not None:
         ok = ok and bool(fleet.get("ok"))
+    if autoscale is not None:
+        ok = ok and bool(autoscale.get("ok"))
     return {
         "metric": "serve_requests_per_sec",
         "value": round(float(requests_per_sec), 2),
@@ -535,6 +540,7 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
         "concurrency": concurrency,
         "notes": notes or {},
         "fleet": fleet,
+        "autoscale": autoscale,
         "ok": ok,
         **_provenance_fields(),
     }
@@ -610,6 +616,75 @@ def assemble_fleet_result(backend, device_kind, n_replicas, single_cold_rps,
         "errors_total": int(errors_total),
         "notes": notes or {},
         "ok": structural_ok and speedup_ok is not False,
+        **_provenance_fields(),
+    }
+
+
+# autoscale gate: minutes of SLO-alert time the sawtooth is allowed to burn
+# while the fleet resizes and a killed replica is replaced. The swing is 10x
+# and the kill lands mid-load, so SOME burn is expected — the budget bounds
+# how long the fleet may page before capacity catches up.
+AUTOSCALE_MAX_BURN_MINUTES = 1.0
+
+
+def assemble_autoscale_result(backend, device_kind, min_replicas,
+                              max_replicas, replace_deadline_s, summary,
+                              slo_burn_minutes, errors_total, notes=None):
+    """ONE-line ``autoscale`` block for ``bench_serving.py --autoscale``.
+
+    ``summary`` is :meth:`Autoscaler.summary` — every decision the loop
+    made, verbatim, so the artifact is the audit trail. The gates are the
+    chaos acceptance criteria: the ``kill -9``'d replica was replaced
+    within ``replace_deadline_s`` and its replacement warm-joined with
+    ZERO cold compiles (invariant 11); the loop scaled up under the 10x
+    swing without a single spawn give-up; SLO burn stayed within the
+    bench budget; and zero request errors surfaced beyond the failover
+    window (the ring absorbed the crash)."""
+    decisions = summary.get("decisions") or []
+    replacements = int(summary.get("replacements") or 0)
+    replace_latency_s = summary.get("replace_latency_s")
+    join_cold_compiles = summary.get("join_cold_compiles")
+    spawn_give_ups = int(summary.get("spawn_give_ups") or 0)
+    scale_ups = sum(d.get("action") == "scale_up" for d in decisions)
+    scale_downs = sum(d.get("action") == "scale_down" for d in decisions)
+    replaced_in_time = (replacements > 0
+                        and replace_latency_s is not None
+                        and replace_latency_s <= replace_deadline_s)
+    ok = (replaced_in_time
+          and join_cold_compiles == 0
+          and spawn_give_ups == 0
+          and scale_ups > 0
+          and errors_total == 0
+          and len(decisions) == int(summary.get("scale_decisions") or 0)
+          and slo_burn_minutes is not None
+          and slo_burn_minutes <= AUTOSCALE_MAX_BURN_MINUTES)
+    return {
+        "metric": "autoscale_replace_latency_s",
+        "value": (None if replace_latency_s is None
+                  else round(float(replace_latency_s), 3)),
+        "unit": "s",
+        "backend": backend,
+        "device_kind": device_kind,
+        "min_replicas": int(min_replicas),
+        "max_replicas": int(max_replicas),
+        "replace_deadline_s": round(float(replace_deadline_s), 3),
+        "replace_latency_s": (None if replace_latency_s is None
+                              else round(float(replace_latency_s), 3)),
+        "replaced_in_time": replaced_in_time,
+        "slo_burn_minutes": (None if slo_burn_minutes is None
+                             else round(float(slo_burn_minutes), 3)),
+        "max_burn_minutes": AUTOSCALE_MAX_BURN_MINUTES,
+        "scale_decisions": len(decisions),
+        "scale_ups": int(scale_ups),
+        "scale_downs": int(scale_downs),
+        "replacements": replacements,
+        "join_cold_compiles": (None if join_cold_compiles is None
+                               else int(join_cold_compiles)),
+        "spawn_give_ups": spawn_give_ups,
+        "errors_total": int(errors_total),
+        "decisions": decisions,
+        "notes": notes or {},
+        "ok": ok,
         **_provenance_fields(),
     }
 

@@ -344,6 +344,57 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class AutoscaleConfig:
+    """Fleet autoscaler knobs (``serve/autoscaler.py``; CLI: ``--set
+    serve.autoscale.*``): the SLO-driven decision loop that spawns and
+    drains replicas. Scale-up admits only warm-joined replicas; scale-down
+    is SIGTERM flag-only drain; a dead replica is replaced within
+    ``replace_deadline_s`` (standing invariant 22)."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    poll_interval_s: float = 2.0  # supervisor scrape + decide cadence
+    # burn-rate watermarks (fast window, from each backend's /slo): scale
+    # up when the worst ratio-SLO burn sits above the high watermark for
+    # up_consecutive polls; scale down when every burn sits below the low
+    # watermark for down_consecutive polls. The gap is the hysteresis band
+    # that keeps burn flapping from oscillating the fleet.
+    burn_high: float = 2.0
+    burn_low: float = 0.5
+    up_consecutive: int = 2
+    down_consecutive: int = 5
+    cooldown_s: float = 30.0  # no new scale decision after any action
+    replace_deadline_s: float = 30.0  # crash detection -> warm replacement
+    spawn_attempts: int = 3  # launcher retries through resilience/retry.py
+    spawn_backoff_s: float = 0.5  # base backoff between spawn attempts
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.min_replicas > self.max_replicas:
+            raise ValueError("min_replicas must be <= max_replicas")
+        if self.poll_interval_s <= 0:
+            raise ValueError("poll_interval_s must be > 0")
+        if self.burn_high <= 0:
+            raise ValueError("burn_high must be > 0")
+        if not 0 <= self.burn_low < self.burn_high:
+            raise ValueError("need 0 <= burn_low < burn_high")
+        if self.up_consecutive < 1:
+            raise ValueError("up_consecutive must be >= 1")
+        if self.down_consecutive < 1:
+            raise ValueError("down_consecutive must be >= 1")
+        if self.cooldown_s <= 0:
+            raise ValueError("cooldown_s must be > 0")
+        if self.replace_deadline_s <= 0:
+            raise ValueError("replace_deadline_s must be > 0")
+        if self.spawn_attempts < 1:
+            raise ValueError("spawn_attempts must be >= 1")
+        if self.spawn_backoff_s <= 0:
+            raise ValueError("spawn_backoff_s must be > 0")
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online scoring service knobs (``deepdfa_tpu/serve``; CLI:
     ``--set serve.*``): the micro-batching window, admission control, the
@@ -384,6 +435,8 @@ class ServeConfig:
     mesh_replicas: int = 0
     # observability plane (deepdfa_tpu/obs): tracing, exemplars, drift
     obs: ObsConfig = field(default_factory=ObsConfig)
+    # fleet autoscaler (serve/autoscaler.py): SLO-driven scale decisions
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -478,6 +531,7 @@ _NESTED: dict[tuple[str, str], type] = {
     ("ExperimentConfig", "resilience"): ResilienceConfig,
     ("ExperimentConfig", "serve"): ServeConfig,
     ("ServeConfig", "obs"): ObsConfig,
+    ("ServeConfig", "autoscale"): AutoscaleConfig,
 }
 
 

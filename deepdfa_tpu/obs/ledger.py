@@ -82,6 +82,14 @@ EXPLICIT_SERIES: dict[tuple[str, str], bool] = {
     ("ggnn_megabatch", "graphs_per_sec"): False,
     ("ggnn_megabatch", "packing_efficiency"): False,
     ("ggnn_megabatch", "dispatches_per_step"): True,
+    # the autoscale bench block (scripts/bench_serving.py --autoscale):
+    # all four are lower-is-better — fast replacement, little SLO burn,
+    # a calm decision loop (flap shows up as extra decisions), and the
+    # invariant-11 join metric where any nonzero value is a regression
+    ("autoscale", "replace_latency_s"): True,
+    ("autoscale", "slo_burn_minutes"): True,
+    ("autoscale", "scale_decisions"): True,
+    ("autoscale", "join_cold_compiles"): True,
 }
 
 

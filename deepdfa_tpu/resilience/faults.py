@@ -66,6 +66,8 @@ KNOWN_POINTS = (
     "step.hang",
     "obs.trace_drop",
     "obs.flight_drop",
+    "autoscale.spawn_fail",
+    "autoscale.replica_crash",
 )
 
 # One line per point; keys must equal KNOWN_POINTS (the analysis faults
@@ -107,6 +109,14 @@ POINT_DOCS = {
         "lose one flight-recorder event at record — counted in "
         "obs_dropped_total; the request/step it annotates must still "
         "succeed (obs/flightrec.py)"),
+    "autoscale.spawn_fail": (
+        "fail one replica launch inside the autoscaler's launcher — the "
+        "spawn retries with backoff and journals a give-up on exhaustion "
+        "(serve/autoscaler.py)"),
+    "autoscale.replica_crash": (
+        "kill -9 one managed replica mid-load — the ring fails over, the "
+        "autoscaler detects the dead probe and warm-joins a replacement "
+        "within replace_deadline_s (serve/autoscaler.py)"),
 }
 
 

@@ -12,14 +12,23 @@ Composition (one request's path)::
 Fleet mode (many replicas, one cache): ``serve/router.py`` fronts N
 ScoreServers, consistent-hashing ``source_key`` so the scan cache shards
 shared-nothing; ``serve/warmstore.py`` hands joining replicas their
-compiled bucket ladder (zero cold compiles); ``mesh=`` engines replicate
-scoring across local devices in one process.
+compiled bucket ladder (zero cold compiles); ``serve/autoscaler.py``
+closes the loop — an SLO-driven supervisor that spawns, drains, and
+replaces replicas through the same warm-join/drain protocol; ``mesh=``
+engines replicate scoring across local devices in one process.
 
 Entry points: ``python -m deepdfa_tpu.serve.server`` (one replica),
 ``python -m deepdfa_tpu.serve.router`` (the fleet front); load-test with
 ``scripts/bench_serving.py`` (``--fleet N`` drives the whole topology).
 """
 
+from .autoscaler import (
+    AdminRouterClient,
+    Autoscaler,
+    SpawnError,
+    SubprocessLauncher,
+    SubprocessReplica,
+)
 from .batcher import MicroBatcher, QueueFullError
 from .cache import ScanCache, ScanEntry
 from .engine import (
@@ -35,6 +44,11 @@ from .server import ScoreServer, build_server, serve_command
 from .warmstore import WarmEntry, WarmStore, bucket_artifact_key
 
 __all__ = [
+    "AdminRouterClient",
+    "Autoscaler",
+    "SpawnError",
+    "SubprocessLauncher",
+    "SubprocessReplica",
     "MicroBatcher",
     "QueueFullError",
     "ScanCache",

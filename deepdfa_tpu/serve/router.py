@@ -232,12 +232,17 @@ class FleetRouter:
                  vnodes: int = DEFAULT_VNODES,
                  probe_interval_s: float = 2.0,
                  metrics: RouterMetrics | None = None,
-                 obs: ObsConfig | None = None):
+                 obs: ObsConfig | None = None,
+                 allow_empty: bool = False):
+        # membership is dynamic (the autoscaler adds/removes ring members
+        # over /admin/backends at runtime), so every read of the table
+        # snapshots under this lock
+        self._backends_lock = threading.Lock()
         self.backends: dict[str, Backend] = {}
         for spec in backends:
             b = spec if isinstance(spec, Backend) else Backend.parse(str(spec))
             self.backends[b.name] = b
-        if not self.backends:
+        if not self.backends and not allow_empty:
             raise ValueError("router needs at least one backend")
         self.ring = HashRing(vnodes)
         self.metrics = metrics or RouterMetrics()
@@ -287,7 +292,7 @@ class FleetRouter:
             target=self.httpd.serve_forever, name="router-http", daemon=True)
         self._serve_thread.start()
         logger.info("routing on :%s over %d backend(s), %d ready",
-                    self.port, len(self.backends), len(self.ring))
+                    self.port, len(self._backend_list()), len(self.ring))
         return self
 
     def install_signal_handlers(self) -> None:
@@ -315,6 +320,43 @@ class FleetRouter:
         the engine directly. Never fails the scrape (invariant 14)."""
         self.slo.observe(self.metrics.snapshot())
         return self.slo.render("deepdfa_router_")
+
+    # -- dynamic membership (the autoscaler's actuation surface) ------------
+
+    def add_backend(self, spec) -> Backend:
+        """Register a backend at runtime. It enters as ``pending`` and
+        joins the ring only after the next probe finds it warm — the same
+        readiness gate as construction-time members (invariant 13), so the
+        autoscaler can never admit a cold replica by registering early."""
+        b = spec if isinstance(spec, Backend) else Backend.parse(str(spec))
+        with self._backends_lock:
+            existing = self.backends.get(b.name)
+            if existing is not None:
+                return existing
+            self.backends[b.name] = b
+        self._probe_backend(b)
+        logger.info("backend %s registered (state %s)", b.name, b.state)
+        return b
+
+    def remove_backend(self, name: str) -> bool:
+        """Deregister a backend: out of the ring immediately (its keyspace
+        slides to ring neighbours), out of the table. The caller owns the
+        replica's drain — the router never signals processes."""
+        with self._backends_lock:
+            b = self.backends.pop(name, None)
+        if b is None:
+            return False
+        self.ring.remove(name)
+        logger.info("backend %s deregistered", name)
+        return True
+
+    def _backend_list(self) -> list[Backend]:
+        with self._backends_lock:
+            return list(self.backends.values())
+
+    def _get_backend(self, name: str) -> Backend | None:
+        with self._backends_lock:
+            return self.backends.get(name)
 
     # -- backend health -----------------------------------------------------
 
@@ -355,9 +397,10 @@ class FleetRouter:
 
     def probe_once(self) -> dict:
         """Probe every backend once; returns ``{name: state}``."""
-        for b in list(self.backends.values()):
+        snapshot = self._backend_list()
+        for b in snapshot:
             self._probe_backend(b)
-        return {name: b.state for name, b in self.backends.items()}
+        return {b.name: b.state for b in snapshot}
 
     def _probe_loop(self) -> None:
         while not self._stop_requested.wait(timeout=self.probe_interval_s):
@@ -393,7 +436,11 @@ class FleetRouter:
             name = self.ring.route(key, exclude=tried)
             if name is None:
                 break
-            b = self.backends[name]
+            b = self._get_backend(name)
+            if b is None:  # deregistered between route and lookup
+                self.ring.remove(name)
+                tried.add(name)
+                continue
             try:
                 # the forward span's context rides the hop as the
                 # traceparent header: the backend's server.request span
@@ -411,6 +458,16 @@ class FleetRouter:
                 self.metrics.inc("retries_total")
                 logger.warning("forward to %s failed (%s) — failing over",
                                name, type(exc).__name__)
+                continue
+            if status == 503 and "draining" in str(
+                    (body or {}).get("error", "")):
+                # stale ring: the backend started draining between route
+                # and forward. Scoring is idempotent, so the request
+                # fails over; only the probe-confirmed drain is terminal.
+                tried.add(name)
+                self._mark(b, "draining", {"error": body.get("error")})
+                self.metrics.inc("retries_total")
+                logger.info("backend %s draining — failing over", name)
                 continue
             b.forwarded += 1
             self.metrics.observe_forward(name)
@@ -436,6 +493,42 @@ class FleetRouter:
         except json.JSONDecodeError:
             return 502, {"error": "backend returned invalid JSON"}
 
+    def admin_backends(self) -> tuple[int, dict]:
+        """``GET /admin/backends``: the membership table as the autoscaler
+        sees it (states, ring membership, forward/failure counters)."""
+        return 200, {
+            "ready": sorted(self.ring.nodes),
+            "backends": {b.name: {"state": b.state,
+                                  "replica_id": b.health.get("replica_id"),
+                                  "forwarded": b.forwarded,
+                                  "failures": b.failures}
+                         for b in self._backend_list()},
+        }
+
+    def handle_admin(self, raw: bytes) -> tuple[int, dict]:
+        """``POST /admin/backends``: ``{"action": "add"|"remove",
+        "backend": "host:port"}`` — the runtime membership surface the
+        autoscaler drives. Add is readiness-gated (the member enters
+        ``pending`` and must probe warm before taking traffic); remove
+        only drops ring membership — draining the process stays with the
+        caller, so the router can never hard-kill a replica."""
+        try:
+            payload = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            return 400, {"error": "body is not valid JSON"}
+        action = payload.get("action") if isinstance(payload, dict) else None
+        spec = payload.get("backend") if isinstance(payload, dict) else None
+        if action not in ("add", "remove") or not isinstance(spec, str) \
+                or ":" not in spec:
+            return 400, {"error": "need {'action': 'add'|'remove', "
+                                  "'backend': 'host:port'}"}
+        if action == "add":
+            b = self.add_backend(spec)
+            return 200, {"backend": b.name, "state": b.state}
+        removed = self.remove_backend(spec)
+        return (200 if removed else 404), {"backend": spec,
+                                           "removed": removed}
+
     def healthz(self) -> tuple[int, dict]:
         ready = sorted(self.ring.nodes)
         body = {
@@ -443,11 +536,11 @@ class FleetRouter:
                 "ok" if ready else "no_ready_backends"),
             "draining": self.draining,
             "ready_backends": ready,
-            "backends": {name: {"state": b.state,
-                                "replica_id": b.health.get("replica_id"),
-                                "forwarded": b.forwarded,
-                                "failures": b.failures}
-                         for name, b in self.backends.items()},
+            "backends": {b.name: {"state": b.state,
+                                  "replica_id": b.health.get("replica_id"),
+                                  "forwarded": b.forwarded,
+                                  "failures": b.failures}
+                         for b in self._backend_list()},
         }
         ok = bool(ready) and not self.draining
         return (200 if ok else 503), body
@@ -482,10 +575,22 @@ def _make_handler(router: FleetRouter):
             elif self.path == "/slo":
                 self._send(200, router.render_slo(),
                            content_type="text/plain; version=0.0.4")
+            elif self.path == "/admin/backends":
+                code, body = router.admin_backends()
+                self._send(code, body)
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/admin/backends":
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    code, body = router.handle_admin(self.rfile.read(length))
+                except Exception as exc:  # noqa: BLE001
+                    code, body = 500, {
+                        "error": f"{type(exc).__name__}: {exc}"}
+                self._send(code, body)
+                return
             if self.path != "/score":
                 self._send(404, {"error": f"no route {self.path}"})
                 return

@@ -43,6 +43,15 @@ throughput (speedup gated on TPU only — one starved CPU core cannot
 exhibit device parallelism and a "passing" CPU number would be a lie),
 per-replica routing/occupancy, sharded-cache hit counters, aggregate
 p50/p99 under the multiplied load.
+
+``--autoscale N`` closes the loop: an SLO-driven
+:class:`~deepdfa_tpu.serve.Autoscaler` supervises 2..N warm-joining
+replicas behind the router while the load sawtooths 10x and a chaos
+``kill -9`` (the ``autoscale.replica_crash`` fault) lands mid-load. The
+artifact gains an ``autoscale`` block (``bench.assemble_autoscale_result``)
+gated on the chaos criteria: replacement within the deadline with zero
+join compiles, SLO burn minutes within budget, zero client-visible
+errors beyond the failover window, and every scale decision recorded.
 """
 
 from __future__ import annotations
@@ -110,7 +119,8 @@ def _build_ckpt(cfg, vocabs):
 
 
 def _make_server(ckpt, vocabs, max_batch: int, max_wait_ms: float,
-                 warm_store=None, journal=None, replica_id=None):
+                 warm_store=None, journal=None, replica_id=None,
+                 latency_window=None, obs=None):
     """One ScoreServer replica over a FRESH engine from the shared
     checkpoint (each replica pays — or warm-loads — its own ladder)."""
     from deepdfa_tpu.config import ServeConfig
@@ -120,8 +130,13 @@ def _make_server(ckpt, vocabs, max_batch: int, max_wait_ms: float,
         ckpt["model"], ckpt["params"], ckpt["label_style"],
         feat_keys=ckpt["feat_keys"], max_batch=max_batch,
         vocab_hash=ckpt["vocab_hash"], journal=journal)
+    extra = {}
+    if latency_window is not None:
+        extra["latency_window"] = latency_window
+    if obs is not None:
+        extra["obs"] = obs
     serve_cfg = ServeConfig(port=0, max_batch=max_batch,
-                            max_wait_ms=max_wait_ms)
+                            max_wait_ms=max_wait_ms, **extra)
     return ScoreServer(engine, vocabs, serve_cfg, replica_id=replica_id,
                        warm_store=warm_store, journal=journal)
 
@@ -367,6 +382,215 @@ def _run_fleet(ckpt, vocabs, bodies, args, single_cold_rps: float,
         })
 
 
+def _run_autoscale(ckpt, vocabs, bodies, args, warm_store_dir, backend: str,
+                   device_kind: str) -> dict:
+    """The closed-loop actuator end-to-end: an SLO-driven autoscaler
+    supervises warm-joining in-process replicas behind the router while
+    the load sawtooths 10x (trickle → ``load_x``× replay → trickle) and a
+    chaos kill lands mid-load. The ``autoscale`` block gates on the chaos
+    criteria: replacement within ``replace_deadline_s`` with zero join
+    compiles, SLO burn minutes within budget, no spawn give-ups, zero
+    client-visible errors beyond the failover window, and every scale
+    decision recorded in the artifact."""
+    import re
+    import tempfile
+
+    from bench import assemble_autoscale_result
+
+    from deepdfa_tpu.config import AutoscaleConfig, ObsConfig
+    from deepdfa_tpu.obs import FlightRecorder
+    from deepdfa_tpu.resilience import faults
+    from deepdfa_tpu.resilience.journal import RunJournal
+    from deepdfa_tpu.serve import Autoscaler, FleetRouter, WarmStore
+
+    acfg = AutoscaleConfig(
+        enabled=True, min_replicas=2, max_replicas=args.autoscale,
+        poll_interval_s=0.5, burn_high=1.4, burn_low=0.8,
+        up_consecutive=2, down_consecutive=4, cooldown_s=3.0,
+        replace_deadline_s=args.replace_deadline_s, spawn_attempts=3,
+        spawn_backoff_s=0.2)
+    # short SLO windows + a small latency reservoir so the burn signal
+    # tracks the sawtooth instead of the whole run's history; the p99
+    # target sits between the trickle and saturated latency so the 10x
+    # leg reads burn > burn_high and the trickle leg burn < burn_low
+    obs = ObsConfig(slo_p99_ms=60.0, slo_fast_window_s=2.0,
+                    slo_slow_window_s=4.0)
+    store = WarmStore(warm_store_dir)
+    jdir = Path(tempfile.mkdtemp(prefix="deepdfa-autoscale-"))
+
+    class _Replica:
+        """In-process stand-in for SubprocessReplica (same handle duck
+        type). ``kill()`` is the in-process analogue of ``kill -9``: the
+        listening socket closes abruptly, new connections are refused,
+        the router fails the keyspace over."""
+
+        def __init__(self, server, report):
+            self.server = server
+            self.host = "127.0.0.1"
+            self.port = server.port
+            self.name = f"127.0.0.1:{server.port}"
+            self.join_cold_compiles = report["misses"]
+            self._exit = None
+
+        def poll(self):
+            return self._exit
+
+        def drain(self):
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
+
+        def kill(self):
+            self._exit = 137
+            try:
+                self.server.httpd.shutdown()
+                self.server.httpd.server_close()
+            except OSError:
+                pass
+
+    class _Launcher:
+        def __init__(self):
+            self.spawned = 0
+
+        def spawn(self):
+            i = self.spawned
+            self.spawned += 1
+            journal = RunJournal(jdir / f"replica{i}.json")
+            srv = _make_server(ckpt, vocabs, args.max_batch,
+                               args.max_wait_ms, warm_store=store,
+                               journal=journal, replica_id=f"auto{i}",
+                               latency_window=64, obs=obs)
+            report = srv.warmup()  # warm join: store hits, zero compiles
+            srv.start()
+            return _Replica(srv, report)
+
+    router = FleetRouter([], port=0, probe_interval_s=0.25,
+                         allow_empty=True)
+    router.start(probe=True)
+    flight = FlightRecorder(capacity=256, proc="autoscaler",
+                            dump_dir=str(jdir))
+    launcher = _Launcher()
+    scaler = Autoscaler(acfg, router, launcher,
+                        journal=RunJournal(jdir / "autoscaler.json"),
+                        flight=flight)
+
+    # burn sampler: accumulate wall time while any ready replica's /slo
+    # exposes a firing alert — the artifact's slo_burn_minutes
+    alert_re = re.compile(r"slo_alert\{[^}]*\}\s+1(?:\.0*)?\s*$", re.M)
+    alert = {"seconds": 0.0}
+    sampler_stop = threading.Event()
+
+    def _sample_alerts():
+        import http.client
+
+        period = 0.25
+        while not sampler_stop.wait(period):
+            _, body = router.admin_backends()
+            firing = False
+            for name, info in body["backends"].items():
+                if info.get("state") != "ready":
+                    continue
+                host, _, port = name.rpartition(":")
+                try:
+                    conn = http.client.HTTPConnection(host, int(port),
+                                                      timeout=2.0)
+                    try:
+                        conn.request("GET", "/slo")
+                        text = conn.getresponse().read().decode()
+                    finally:
+                        conn.close()
+                except OSError:
+                    continue
+                if alert_re.search(text):
+                    firing = True
+                    break
+            if firing:
+                alert["seconds"] += period
+
+    threading.Thread(target=_sample_alerts, daemon=True).start()
+
+    errors_total = 0
+    try:
+        scaler.start()  # spawns min_replicas warm joiners synchronously
+
+        # sawtooth leg 1 — trickle (replay, 2 workers)
+        _, err = _run_phase(router.port, bodies, concurrency=2)
+        errors_total += err
+
+        # sawtooth leg 2a — load_x× replay at full concurrency until the
+        # burn streak grows the fleet (bounded; one replay lasts about a
+        # second, shorter than streak × poll interval, so repeat it)
+        high_bodies = bodies * args.load_x
+        high = {"elapsed": 0.0, "requests": 0}
+        burn_scale_up = False
+        t_high = time.perf_counter()
+        while time.perf_counter() - t_high < 20.0:
+            s, e = _run_phase(router.port, high_bodies, args.concurrency)
+            high["elapsed"] += s
+            high["requests"] += len(high_bodies)
+            errors_total += e
+            if any(d.get("reason") == "burn_high"
+                   for d in scaler.summary()["decisions"]):
+                burn_scale_up = True
+                break
+
+        # sawtooth leg 2b — the chaos kill lands mid-load on one more
+        # high replay
+        def _high_phase():
+            s, e = _run_phase(router.port, high_bodies, args.concurrency)
+            high["elapsed"] += s
+            high["requests"] += len(high_bodies)
+            high["errors"] = e
+
+        high_thread = threading.Thread(target=_high_phase, daemon=True)
+        high_thread.start()
+        time.sleep(2 * acfg.poll_interval_s)  # let the queue build
+        faults.install("autoscale.replica_crash@1")  # next poll kills one
+        deadline = time.perf_counter() + acfg.replace_deadline_s + 10.0
+        while time.perf_counter() < deadline:
+            if scaler.summary()["replacements"] > 0:
+                break
+            time.sleep(0.1)
+        faults.clear()
+        high_thread.join(timeout=600.0)
+        errors_total += high.get("errors", 0)
+
+        # sawtooth leg 3 — trickle until the loop scales back down
+        # (bounded: cooldown + down_consecutive polls)
+        t_low = time.perf_counter()
+        while time.perf_counter() - t_low < 30.0:
+            _, err = _run_phase(router.port, bodies[:8], concurrency=1)
+            errors_total += err
+            if any(d["action"] == "scale_down"
+                   for d in scaler.summary()["decisions"]):
+                break
+    finally:
+        faults.clear()
+        sampler_stop.set()
+        summary = scaler.stop(drain=True)
+        rsnap = router.shutdown()
+    errors_total += rsnap["no_backend_total"]
+
+    return assemble_autoscale_result(
+        backend=backend, device_kind=device_kind,
+        min_replicas=acfg.min_replicas, max_replicas=acfg.max_replicas,
+        replace_deadline_s=acfg.replace_deadline_s, summary=summary,
+        slo_burn_minutes=alert["seconds"] / 60.0,
+        errors_total=errors_total,
+        notes={
+            "low_requests": len(bodies),
+            "high_requests": high["requests"],
+            "load_x": args.load_x,
+            "burn_scale_up": burn_scale_up,
+            "high_requests_per_sec": (
+                round(high["requests"] / high["elapsed"], 2)
+                if high.get("elapsed") else None),
+            "router_retries": rsnap["retries_total"],
+            "no_backend_total": rsnap["no_backend_total"],
+            "replicas_spawned": launcher.spawned,
+            "journal_dir": str(jdir),
+        })
+
+
 def main(argv=None) -> dict:
     import argparse
     import tempfile
@@ -399,9 +623,19 @@ def main(argv=None) -> dict:
                     "pass a path to measure cross-process joins)")
     ap.add_argument("--probe-interval", type=float, default=2.0,
                     dest="probe_interval_s")
+    ap.add_argument("--autoscale", type=int, default=0,
+                    help="N>=2: run the SLO-driven autoscaler sawtooth "
+                    "stage (2..N replicas, chaos kill mid-load, "
+                    "warm-join replacement gated on the replace deadline)")
+    ap.add_argument("--replace-deadline", type=float, default=30.0,
+                    dest="replace_deadline_s",
+                    help="serve.autoscale.replace_deadline_s for the "
+                    "--autoscale stage")
     args = ap.parse_args(argv)
     if args.fleet == 1:
         ap.error("--fleet needs N >= 2 (the baseline IS the single replica)")
+    if args.autoscale == 1:
+        ap.error("--autoscale needs N >= 2 (min_replicas is 2)")
 
     backend = jax.default_backend()
     device_kind = jax.devices()[0].device_kind
@@ -413,7 +647,7 @@ def main(argv=None) -> dict:
     ]
 
     warm_store = journal0 = warm_dir = None
-    if args.fleet:
+    if args.fleet or args.autoscale:
         from deepdfa_tpu.resilience.journal import RunJournal
         from deepdfa_tpu.serve import WarmStore
 
@@ -441,6 +675,12 @@ def main(argv=None) -> dict:
                            device_kind=device_kind,
                            baseline_warm=baseline_warm)
 
+    autoscale = None
+    if args.autoscale:
+        autoscale = _run_autoscale(ckpt, vocabs, bodies, args,
+                                   warm_store_dir=warm_dir, backend=backend,
+                                   device_kind=device_kind)
+
     tiers = tier_precision = tier_refusal = None
     if args.tier_requests > 0:
         tiers, tier_precision, tier_refusal = _precision_tiers(
@@ -462,6 +702,7 @@ def main(argv=None) -> dict:
         errors_total=cold_err + hot_err,
         concurrency=args.concurrency,
         fleet=fleet,
+        autoscale=autoscale,
         notes={
             "cold_requests_per_sec": round(len(bodies) / cold_s, 2),
             "hot_requests_per_sec": round(len(bodies) / hot_s, 2),

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """The fast pre-commit gate: ruff over the library + the device-free perf
 contract suite (``pytest -m perf_contract``) + the fleet unit suite
-(``pytest -m fleet``: hash ring, router, warm store) + the observability
+(``pytest -m fleet``: hash ring, router, warm store, autoscaler
+decision loop + kill -9 chaos) + the observability
 suite (``pytest -m obs``: tracing, exposition conformance, drift) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
 lock-order, jit-purity/donation, fault-registry, metrics conformance
@@ -69,7 +70,7 @@ def main() -> int:
     print("lint_gate: pytest -m fleet")
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "-m", "fleet", "-q",
-         "tests/test_serve.py"],
+         "tests/test_serve.py", "tests/test_autoscaler.py"],
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("fleet")

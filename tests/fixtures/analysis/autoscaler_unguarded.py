@@ -1,0 +1,29 @@
+"""Seeded violation: autoscaler-shaped unguarded decision state.
+
+``_streak_up`` and ``_decisions`` are written from the supervisor
+thread's poll loop and read by ``summary()`` on the caller's thread with
+no common lock — exactly the race the real
+``deepdfa_tpu/serve/autoscaler.py`` guards with its one decision-state
+lock. The unguarded-state pass must flag both attributes.
+"""
+
+import threading
+
+
+class LooseAutoscaler:
+    def __init__(self):
+        self._streak_up = 0
+        self._decisions = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.wait(1.0):
+            self._streak_up = self._streak_up + 1
+            if self._streak_up >= 3:
+                self._decisions = self._decisions + [{"action": "scale_up"}]
+                self._streak_up = 0
+
+    def summary(self) -> dict:
+        return {"streak": self._streak_up,
+                "decisions": list(self._decisions)}

@@ -55,3 +55,48 @@ def test_layered_load(tmp_path):
     assert cfg.model.n_steps == 7
     assert cfg.seed == 3
     assert isinstance(cfg, ExperimentConfig)
+
+
+def test_autoscale_config_validation():
+    from deepdfa_tpu.config import AutoscaleConfig
+
+    with pytest.raises(ValueError, match="min_replicas must be <= max"):
+        AutoscaleConfig(min_replicas=5, max_replicas=2)
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscaleConfig(min_replicas=0)
+    with pytest.raises(ValueError, match="poll_interval_s"):
+        AutoscaleConfig(poll_interval_s=0.0)
+    with pytest.raises(ValueError, match="replace_deadline_s"):
+        AutoscaleConfig(replace_deadline_s=-1.0)
+    with pytest.raises(ValueError, match="cooldown_s"):
+        AutoscaleConfig(cooldown_s=0.0)
+    with pytest.raises(ValueError, match="burn_low"):
+        AutoscaleConfig(burn_high=1.0, burn_low=1.5)
+    with pytest.raises(ValueError, match="up_consecutive"):
+        AutoscaleConfig(up_consecutive=0)
+    with pytest.raises(ValueError, match="spawn_attempts"):
+        AutoscaleConfig(spawn_attempts=0)
+    with pytest.raises(ValueError, match="spawn_backoff_s"):
+        AutoscaleConfig(spawn_backoff_s=0.0)
+
+
+def test_autoscale_config_dotted_overrides_and_roundtrip(tmp_path):
+    from deepdfa_tpu.config import AutoscaleConfig, to_json
+
+    cfg = load_config(overrides={"serve.autoscale.enabled": True,
+                                 "serve.autoscale.min_replicas": 2,
+                                 "serve.autoscale.max_replicas": 6,
+                                 "serve.autoscale.cooldown_s": 5.0})
+    asc = cfg.serve.autoscale
+    assert isinstance(asc, AutoscaleConfig)
+    assert (asc.enabled, asc.min_replicas, asc.max_replicas,
+            asc.cooldown_s) == (True, 2, 6, 5.0)
+    # JSON round-trip preserves the nested block exactly
+    path = tmp_path / "cfg.json"
+    path.write_text(to_json(cfg))
+    again = load_config(path)
+    assert again.serve.autoscale == asc
+    # an invalid combination is rejected at construction, not at use
+    with pytest.raises(ValueError, match="min_replicas"):
+        load_config(overrides={"serve.autoscale.min_replicas": 9,
+                               "serve.autoscale.max_replicas": 2})

@@ -258,3 +258,42 @@ def test_repo_head_gates_green():
     ok, rows = ledger.check()
     assert ok is True, [r for r in rows if r["verdict"] == "regression"]
     assert ledger_main(["--check", str(REPO)]) == 0
+
+
+def test_autoscale_series_are_explicitly_declared():
+    """Satellite pin (PR 12): the autoscale stage's gate metrics are
+    DECLARED lower-is-better — ``scale_decisions`` and
+    ``join_cold_compiles`` carry no latency/err token the heuristic
+    could classify, so only the explicit map keeps a churnier or
+    colder fleet reading as a regression."""
+    for metric in ("replace_latency_s", "slo_burn_minutes",
+                   "scale_decisions", "join_cold_compiles"):
+        assert EXPLICIT_SERIES[("autoscale", metric)] is True, metric
+        assert lower_is_better(metric, "autoscale") is True, metric
+
+
+def test_autoscale_direction_flows_into_verdicts(tmp_path):
+    """A scale_decisions DROP under the autoscale stage reads improved
+    (less churn for the same load), and a replace-latency JUMP reads
+    as a regression — end to end through ``verdicts``."""
+    for i in range(4):
+        _art(tmp_path, f"BENCH_t{i:02d}.json", emitted=1000 + i,
+             autoscale={"scale_decisions": 12.0, "replace_latency_s": 2.0})
+    _art(tmp_path, "BENCH_t99.json", emitted=2000,
+         autoscale={"scale_decisions": 4.0, "replace_latency_s": 2.0})
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    (row,) = [r for r in rows if r["metric"] == "scale_decisions"]
+    assert row["stage"] == "autoscale"
+    assert row["lower_is_better"] is True
+    assert row["verdict"] == "improved" and ok is True
+
+    slow = tmp_path / "slow"
+    slow.mkdir()
+    for i in range(4):
+        _art(slow, f"BENCH_t{i:02d}.json", emitted=1000 + i,
+             autoscale={"replace_latency_s": 2.0})
+    _art(slow, "BENCH_t99.json", emitted=2000,
+         autoscale={"replace_latency_s": 3.0})
+    ok, rows = Ledger.from_paths([slow]).check()
+    (row,) = [r for r in rows if r["metric"] == "replace_latency_s"]
+    assert row["verdict"] == "regression" and ok is False

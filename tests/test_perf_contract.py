@@ -383,3 +383,110 @@ def test_serve_result_ands_fleet_block():
                             join_cold_compiles=2)))
     assert bad["fleet"]["ok"] is False
     assert bad["ok"] is False  # fleet failure surfaces at the top level
+
+
+# --------------------------------------------------------------- autoscale
+
+
+def _autoscale_summary(**over):
+    """A fully-green Autoscaler.summary(); tests flip one field at a time."""
+    decisions = [
+        {"action": "scale_up", "reason": "min_replicas", "t": 0.1,
+         "join_cold_compiles": 0},
+        {"action": "scale_up", "reason": "burn_high", "t": 4.0, "burn": 3.2,
+         "join_cold_compiles": 0},
+        {"action": "replica_crash_injected", "t": 8.0, "backend": "h:1"},
+        {"action": "replace", "t": 8.1, "backend": "h:1", "exit_code": -9,
+         "replacement": "h:4", "replace_latency_s": 1.4,
+         "join_cold_compiles": 0},
+        {"action": "scale_down", "reason": "burn_low", "t": 20.0},
+    ]
+    s = {"replicas": ["h:2", "h:3", "h:4"], "decisions": decisions,
+         "scale_decisions": len(decisions), "replace_latency_s": 1.4,
+         "replacements": 1, "join_cold_compiles": 0, "spawn_give_ups": 0}
+    s.update(over)
+    return s
+
+
+def _autoscale_kwargs(**over):
+    kw = dict(backend="cpu", device_kind="cpu", min_replicas=2,
+              max_replicas=4, replace_deadline_s=30.0,
+              summary=_autoscale_summary(), slo_burn_minutes=0.2,
+              errors_total=0)
+    kw.update(over)
+    return kw
+
+
+def test_autoscale_schema_and_green_gate():
+    art = bench.assemble_autoscale_result(**_autoscale_kwargs())
+    assert art["metric"] == "autoscale_replace_latency_s"
+    assert art["unit"] == "s"
+    assert art["value"] == 1.4 == art["replace_latency_s"]
+    assert art["replaced_in_time"] is True
+    assert art["scale_ups"] == 2 and art["scale_downs"] == 1
+    assert art["replacements"] == 1
+    assert art["join_cold_compiles"] == 0
+    assert art["slo_burn_minutes"] == 0.2
+    assert art["max_burn_minutes"] == bench.AUTOSCALE_MAX_BURN_MINUTES
+    assert len(art["decisions"]) == art["scale_decisions"] == 5
+    assert art["ok"] is True
+    assert PROVENANCE_KEYS <= set(art)
+
+
+@pytest.mark.parametrize("knob, value", [
+    ("slo_burn_minutes", 2.0),              # paged longer than the budget
+    ("slo_burn_minutes", None),             # sampler never ran: not green
+    ("errors_total", 3),                    # 5xx leaked past the failover
+])
+def test_autoscale_gate_rejects_bad_top_level_knob(knob, value):
+    art = bench.assemble_autoscale_result(**_autoscale_kwargs(**{knob: value}))
+    assert art["ok"] is False
+
+
+@pytest.mark.parametrize("field, value", [
+    ("replace_latency_s", 45.0),            # replacement blew the deadline
+    ("replace_latency_s", None),            # no measured replacement
+    ("replacements", 0),                    # chaos never exercised the heal
+    ("join_cold_compiles", 2),              # replacement compiled cold
+    ("spawn_give_ups", 1),                  # a spawn retry loop exhausted
+])
+def test_autoscale_gate_rejects_bad_summary_field(field, value):
+    summary = _autoscale_summary(**{field: value})
+    art = bench.assemble_autoscale_result(
+        **_autoscale_kwargs(summary=summary))
+    assert art["ok"] is False
+
+
+def test_autoscale_requires_a_scale_up_under_load():
+    """A sawtooth that never grew the fleet proves nothing: the gate
+    demands at least one burn-driven or floor scale-up decision."""
+    summary = _autoscale_summary()
+    summary["decisions"] = [d for d in summary["decisions"]
+                            if d["action"] != "scale_up"]
+    summary["scale_decisions"] = len(summary["decisions"])
+    art = bench.assemble_autoscale_result(**_autoscale_kwargs(summary=summary))
+    assert art["ok"] is False
+
+
+def test_serve_result_ands_autoscale_block():
+    """The serving artifact carries the autoscale block and ANDs its ok,
+    exactly like the fleet block — and the nested dict is what the
+    ledger walks into ``autoscale.*`` series."""
+    serve_kw = dict(backend="cpu", device_kind="cpu", requests_per_sec=50.0,
+                    p50_ms=5.0, p99_ms=20.0, mean_batch_occupancy=3.0,
+                    cache_hit_rate=0.5, cache_hits=10, requests_total=100,
+                    errors_total=0)
+    solo = bench.assemble_serve_result(**serve_kw)
+    assert solo["ok"] is True and solo["autoscale"] is None
+
+    good = bench.assemble_serve_result(
+        **serve_kw,
+        autoscale=bench.assemble_autoscale_result(**_autoscale_kwargs()))
+    assert good["ok"] is True and good["autoscale"]["ok"] is True
+
+    bad = bench.assemble_serve_result(
+        **serve_kw,
+        autoscale=bench.assemble_autoscale_result(
+            **_autoscale_kwargs(errors_total=2)))
+    assert bad["autoscale"]["ok"] is False
+    assert bad["ok"] is False  # the autoscale failure surfaces at the top
