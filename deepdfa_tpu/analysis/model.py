@@ -266,14 +266,23 @@ class ProjectModel:
                         if label:
                             ann[arg.arg] = label
                 for stmt in ast.walk(init):
-                    if not isinstance(stmt, ast.Assign):
+                    # annotated form (`self._q: deque = deque()`) included:
+                    # the ctor decides lock/safe-container classification
+                    # regardless of annotation style
+                    if isinstance(stmt, ast.AnnAssign):
+                        if stmt.value is None:
+                            continue
+                        targets, value = [stmt.target], stmt.value
+                    elif isinstance(stmt, ast.Assign):
+                        targets, value = stmt.targets, stmt.value
+                    else:
                         continue
-                    for target in stmt.targets:
+                    for target in targets:
                         if (isinstance(target, ast.Attribute)
                                 and isinstance(target.value, ast.Name)
                                 and target.value.id == "self"):
                             self._record_attr_init(info, ci, target.attr,
-                                                   stmt.value, ann)
+                                                   value, ann)
 
     def _record_attr_init(self, info: ModuleInfo, ci: ClassInfo, attr: str,
                           value: ast.AST, ann: dict[str, str]) -> None:

@@ -1,0 +1,141 @@
+"""On-disk content-addressed extraction cache: normalized source → CPG payload.
+
+Per-function CPG extraction dominates corpus-build wall clock (the
+reference sharded it over a 0–99 SLURM array), yet most rebuilds touch a
+handful of functions. This cache makes a re-scan pay only for *changed*
+functions: entries are keyed on :func:`deepdfa_tpu.pipeline.source_key`
+(the same whitespace-normalized sha256 the serve scan cache uses — a
+whitespace-only edit shares the entry) salted with an extractor-version /
+vocab component, so bumping the frontend or re-vocabing a corpus misses
+cleanly instead of serving stale graphs.
+
+Commit protocol (ROADMAP invariants 1/10, the checkpoint/warm-store
+discipline): the pickled payload lands FIRST via ``atomic_write_bytes``,
+then the ``{key}.json`` meta marker commits the entry via
+``atomic_write_text``. An entry exists iff its meta exists; a torn write,
+a missing payload, a meta/payload digest mismatch or an unpicklable blob
+all read as a MISS — never as a decode crash (the ``extract.cache_corrupt``
+chaos point pins it). Writers race benignly: both write identical content
+under content-addressed names, last ``os.replace`` wins.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pickle
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+
+from deepdfa_tpu.resilience import faults
+from deepdfa_tpu.resilience.journal import atomic_write_bytes, atomic_write_text
+
+__all__ = ["EXTRACTOR_VERSION", "ExtractCache"]
+
+# Bump when the extraction pipeline's OUTPUT changes shape/content for the
+# same source text (frontend node schema, dependence-edge pass, feature
+# extraction) — old entries then miss instead of resurrecting stale CPGs.
+EXTRACTOR_VERSION = 1
+
+
+@dataclass
+class _Stats:
+    hits: int = 0
+    misses: int = 0
+    corrupt: int = 0
+    puts: int = 0
+
+
+class ExtractCache:
+    """``key(code) -> get/put`` over one directory of committed entries."""
+
+    def __init__(self, root: str | Path, *,
+                 version: int = EXTRACTOR_VERSION, salt: str = ""):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        # the extractor-version/vocab-salt key component: folded into every
+        # key so entries from a different pipeline generation cannot collide
+        self._salt = hashlib.sha256(
+            f"extractor-v{int(version)}:{salt}".encode()).hexdigest()[:16]
+        self._lock = threading.Lock()
+        self._stats = _Stats()
+
+    # -- keys ---------------------------------------------------------------
+    def key(self, code: str) -> str:
+        """Content address of one function/file source under this cache's
+        pipeline generation (``source_key`` ⊕ version/vocab salt)."""
+        from deepdfa_tpu.pipeline import source_key
+
+        return hashlib.sha256(
+            f"{source_key(code)}:{self._salt}".encode()).hexdigest()
+
+    def _paths(self, key: str) -> tuple[Path, Path]:
+        return self.root / f"{key}.pkl", self.root / f"{key}.json"
+
+    # -- protocol -----------------------------------------------------------
+    def get(self, key: str):
+        """The committed payload for ``key``, or None (MISS). Any torn,
+        corrupt or injected-corrupt entry is a MISS, never an exception."""
+        payload_path, meta_path = self._paths(key)
+        try:
+            meta = json.loads(meta_path.read_text())
+            blob = payload_path.read_bytes()
+            if faults.fire("extract.cache_corrupt"):
+                blob = blob[: len(blob) // 2] + b"\x00corrupt"
+            if meta.get("sha256") != hashlib.sha256(blob).hexdigest():
+                raise ValueError("payload digest mismatch")
+            value = pickle.loads(blob)
+        except FileNotFoundError:
+            with self._lock:
+                self._stats.misses += 1
+            return None
+        except Exception:  # noqa: BLE001 — corrupt entry == miss, by design
+            with self._lock:
+                self._stats.misses += 1
+                self._stats.corrupt += 1
+            return None
+        with self._lock:
+            self._stats.hits += 1
+        return value
+
+    def put(self, key: str, value) -> None:
+        """Commit payload-first: the ``{key}.json`` meta marker is written
+        only after the pickled payload is durably in place."""
+        payload_path, meta_path = self._paths(key)
+        blob = pickle.dumps(value)
+        atomic_write_bytes(payload_path, blob)
+        atomic_write_text(meta_path, json.dumps({
+            "schema": 1,
+            "sha256": hashlib.sha256(blob).hexdigest(),
+            "bytes": len(blob),
+        }))
+        with self._lock:
+            self._stats.puts += 1
+
+    def get_or_extract(self, code: str, extract):
+        """``(value, hit)`` — the committed payload for ``code``, or
+        ``extract(code)`` committed on the way out."""
+        k = self.key(code)
+        value = self.get(k)
+        if value is not None:
+            return value, True
+        value = extract(code)
+        self.put(k, value)
+        return value, False
+
+    # -- accounting ---------------------------------------------------------
+    def __len__(self) -> int:
+        return sum(1 for _ in self.root.glob("*.json"))
+
+    def stats(self) -> dict:
+        with self._lock:
+            s = self._stats
+            lookups = s.hits + s.misses
+            return {
+                "hits": s.hits,
+                "misses": s.misses,
+                "corrupt": s.corrupt,
+                "puts": s.puts,
+                "hit_rate": (s.hits / lookups) if lookups else 0.0,
+            }

@@ -68,6 +68,8 @@ KNOWN_POINTS = (
     "obs.flight_drop",
     "autoscale.spawn_fail",
     "autoscale.replica_crash",
+    "extract.worker_crash",
+    "extract.cache_corrupt",
 )
 
 # One line per point; keys must equal KNOWN_POINTS (the analysis faults
@@ -117,6 +119,13 @@ POINT_DOCS = {
         "kill -9 one managed replica mid-load — the ring fails over, the "
         "autoscaler detects the dead probe and warm-joins a replacement "
         "within replace_deadline_s (serve/autoscaler.py)"),
+    "extract.worker_crash": (
+        "kill one extraction-pool worker thread mid-task — its in-flight "
+        "item is re-queued and survivors steal its backlog "
+        "(data/extraction.py)"),
+    "extract.cache_corrupt": (
+        "corrupt one extraction-cache payload at read — the entry must "
+        "read as a MISS, never a decode crash (data/extract_cache.py)"),
 }
 
 

@@ -1,0 +1,200 @@
+"""``deepdfa-tpu scan <repo-or-dir>`` — the streaming end-to-end surface.
+
+``predict`` scores a handful of files with full statement ranking; *scan*
+is the corpus-scale sibling: walk every C source under a repo, stream the
+files through the work-stealing :class:`~deepdfa_tpu.data.extraction.
+ExtractionPool` with the content-addressed :class:`~deepdfa_tpu.data.
+extract_cache.ExtractCache` in front, and (when a checkpoint or exported
+artifact is given) batch the encoded functions through the serving
+:class:`~deepdfa_tpu.serve.engine.ScoringEngine` grouped by serve bucket.
+
+The economics mirror the ingest pipeline, not the request path: a re-scan
+of a mostly-unchanged repo re-encodes only changed files (the cache key is
+the whitespace-normalized content hash salted with the vocabulary hash, so
+a re-vocab invalidates cleanly), an unparseable file is one error row
+(never a dead scan), and a poison file lands in quarantine without idling
+the other workers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Sequence
+
+from deepdfa_tpu.data.extract_cache import ExtractCache
+from deepdfa_tpu.data.extraction import ExtractionPool
+from deepdfa_tpu.pipeline import vocab_content_hash
+
+__all__ = ["scan_paths", "scan_command"]
+
+logger = logging.getLogger("deepdfa_tpu")
+
+C_SUFFIXES = (".c",)  # the frontend is a C11 parser (pycparser) — see predict
+
+
+def collect_c_files(paths: Sequence[str | Path]) -> list[Path]:
+    """Every scannable file under ``paths``: directories recurse over
+    ``*.c``; an explicit file path of any extension is honored (the
+    caller asked for that exact file). Missing paths raise."""
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.c")))
+        elif p.exists():
+            out.append(p)
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+class _EncodeSession:
+    """The pool's 'session' for in-process encoding: one vocab closure.
+    Real Joern extraction swaps in a JoernSession/ProcessSession factory;
+    the supervision contract (close(), SESSION_ERRORS) is identical."""
+
+    def __init__(self, vocabs):
+        self._vocabs = vocabs
+
+    def encode(self, code: str):
+        from deepdfa_tpu.pipeline import encode_source
+
+        # keep_cpg=False: cache entries hold (name, Graph, node_ids) only —
+        # small, picklable, and exactly what scoring needs
+        return encode_source(code, self._vocabs, keep_cpg=False)
+
+    def close(self) -> None:
+        pass
+
+
+def _score_functions(engine, rows: list[dict], graphs: list) -> None:
+    """Batch ``graphs`` through the engine grouped by serve bucket and
+    write ``vulnerable_probability`` back onto the paired rows."""
+    by_bucket: dict = {}
+    for row, g in zip(rows, graphs):
+        try:
+            bucket = engine.assign_bucket(g)
+        except Exception as exc:  # noqa: BLE001 — oversize = error row
+            row["error"] = f"{type(exc).__name__}: {exc}"
+            continue
+        by_bucket.setdefault(engine.bucket_key(bucket), (bucket, []))[1].append(
+            (row, g))
+    for bucket, pairs in by_bucket.values():
+        cap = max(int(bucket.capacity), 1)
+        for start in range(0, len(pairs), cap):
+            chunk = pairs[start:start + cap]
+            probs = engine.score([g for _, g in chunk], bucket)
+            for (row, _), p in zip(chunk, probs):
+                row["vulnerable_probability"] = round(float(p), 6)
+
+
+def scan_paths(
+    paths: Sequence[str | Path],
+    vocabs,
+    *,
+    engine=None,
+    n_workers: int = 4,
+    cache_dir: str | Path | None = None,
+    attempts_per_item: int = 2,
+) -> dict:
+    """Scan ``paths``; returns the report dict (also what ``scan.json``
+    records). Per-file failures are error rows; nothing aborts the scan."""
+    files = collect_c_files(paths)
+    sources: list[tuple[str, str]] = [
+        (str(f), f.read_text(errors="replace")) for f in files]
+    cache = None
+    if cache_dir is not None:
+        # salt with the vocabulary content: encoding is vocab-dependent, so
+        # a re-vocabed corpus must MISS rather than serve stale encodings
+        cache = ExtractCache(cache_dir, salt=vocab_content_hash(vocabs))
+    pool = ExtractionPool(
+        lambda wid: _EncodeSession(vocabs),
+        n_workers=max(1, min(n_workers, max(len(sources), 1))),
+        attempts_per_item=attempts_per_item,
+        cache=cache,
+        cache_code=lambda code: code,
+    )
+    t0 = time.perf_counter()
+    results = pool.run(
+        [(name, code) for name, code in sources],
+        lambda session, code: session.encode(code),
+    )
+    elapsed = time.perf_counter() - t0
+
+    rows: list[dict] = []
+    score_rows: list[dict] = []
+    score_graphs: list = []
+    for res in results:
+        if res.error is not None:
+            rows.append({"file": res.key, "error": res.error,
+                         "quarantined": res.quarantined})
+            continue
+        for fn in res.value:
+            row = {"file": res.key, "function": fn.name,
+                   "cache_hit": res.cache_hit}
+            if fn.graph is None:
+                row["error"] = fn.error
+            elif engine is not None:
+                score_rows.append(row)
+                score_graphs.append(fn.graph)
+            rows.append(row)
+    if engine is not None and score_graphs:
+        _score_functions(engine, score_rows, score_graphs)
+
+    n_err = sum(1 for r in rows if "error" in r)
+    report = {
+        "results": rows,
+        "n_files": len(sources),
+        "n_functions": len(rows) - sum(1 for r in rows if "function" not in r),
+        "n_scored": sum(1 for r in rows if "vulnerable_probability" in r),
+        "n_errors": n_err,
+        "elapsed_s": round(elapsed, 3),
+        "pool": pool.report(),
+        "cache": cache.stats() if cache is not None else None,
+    }
+    logger.info(
+        "scan: %d file(s) → %d function(s), %d scored, %d error row(s) "
+        "in %.2fs (cache %s)", report["n_files"], report["n_functions"],
+        report["n_scored"], n_err, elapsed,
+        f"hit_rate={report['cache']['hit_rate']:.2f}" if cache else "off",
+    )
+    return report
+
+
+def scan_command(cfg, run_dir: Path, targets: Sequence[str], *,
+                 ckpt_dir: Path | None = None, artifact: str | None = None,
+                 workers: int = 4, cache_dir: Path | None = None) -> dict:
+    """The CLI entry: resolve vocabs from the config's shard dir, build a
+    scoring engine when a checkpoint/artifact is given (scan still runs
+    encode-only without one), write ``scan.json`` atomically."""
+    from deepdfa_tpu import utils
+    from deepdfa_tpu.pipeline import load_vocabs
+    from deepdfa_tpu.resilience.journal import atomic_write_text
+
+    sample_text = "_sample" if cfg.data.sample else ""
+    shard_dir = utils.processed_dir() / cfg.data.dsname / f"shards{sample_text}"
+    vocabs = load_vocabs(shard_dir)
+
+    engine = None
+    if artifact is not None:
+        from deepdfa_tpu.serve.engine import ScoringEngine
+
+        engine = ScoringEngine.from_artifact(artifact, vocabs=vocabs)
+    elif ckpt_dir is not None:
+        from deepdfa_tpu.serve.engine import ScoringEngine
+
+        engine = ScoringEngine.from_checkpoint(cfg, ckpt_dir, vocabs)
+    else:
+        logger.info("scan: no --ckpt-dir/--artifact — encoding without scores")
+
+    report = scan_paths(
+        targets, vocabs, engine=engine, n_workers=workers,
+        cache_dir=cache_dir if cache_dir is not None
+        else run_dir / "extract_cache")
+    atomic_write_text(run_dir / "scan.json", json.dumps(report, indent=2))
+    print(json.dumps({k: v for k, v in report.items() if k != "results"},
+                     sort_keys=True), flush=True)
+    return report

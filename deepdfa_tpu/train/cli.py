@@ -1209,12 +1209,13 @@ def main(argv: Sequence[str] | None = None) -> dict:
     parser = argparse.ArgumentParser(prog="deepdfa-tpu")
     parser.add_argument("command",
                         choices=["fit", "test", "analyze", "predict",
-                                 "export", "serve", "trace", "bench"])
+                                 "export", "serve", "trace", "bench", "scan"])
     parser.add_argument("subcommand", nargs="?", default=None,
                         help="trace: 'export' (the default) — merge a run "
                         "dir's trace exemplars into Chrome trace-event JSON; "
                         "bench: 'ledger' (the default) — perf-regression "
-                        "verdicts over the repo's bench artifacts")
+                        "verdicts over the repo's bench artifacts; "
+                        "scan: the repo/dir/file to walk (or use --source)")
     parser.add_argument("--out", default=None,
                         help="trace export: output path (default: "
                         "<run-dir>/trace_events.json)")
@@ -1229,7 +1230,12 @@ def main(argv: Sequence[str] | None = None) -> dict:
     parser.add_argument("--ckpt-dir", default=None,
                         help="checkpoint dir for test/predict/export")
     parser.add_argument("--source", action="append", default=[],
-                        help="predict: C file or directory (repeatable)")
+                        help="predict/scan: C file or directory (repeatable)")
+    parser.add_argument("--workers", type=int, default=4,
+                        help="scan: extraction-pool worker count")
+    parser.add_argument("--cache-dir", default=None,
+                        help="scan: extraction-cache dir (default: "
+                        "<run-dir>/extract_cache)")
     parser.add_argument("--top-k", type=int, default=5,
                         help="predict: statements ranked per function")
     parser.add_argument("--artifact", default=None,
@@ -1252,6 +1258,8 @@ def main(argv: Sequence[str] | None = None) -> dict:
     args = parser.parse_args(argv)
     if args.command == "predict" and not args.source:
         parser.error("predict requires at least one --source")
+    if args.command == "scan" and not (args.subcommand or args.source):
+        parser.error("scan requires a target path (positional or --source)")
     if args.command == "trace":
         # a reporting path: no config load, no run-dir creation, no logging
         # re-init — it must work against a finished (or foreign) run dir
@@ -1280,7 +1288,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         return {"command": "bench", "subcommand": "ledger", "rc": rc}
 
     layers = list(args.config)
-    if args.command in ("predict", "export", "serve") and args.run_dir:
+    if args.command in ("predict", "export", "serve", "scan") and args.run_dir:
         # score with the RUN'S OWN recorded config as the base layer (CLI
         # configs/overrides still win): `predict --run-dir <fit dir>` must
         # restore a non-default-trained checkpoint without the caller
@@ -1306,7 +1314,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
     )
     from deepdfa_tpu.config import to_json
 
-    if (args.command not in ("predict", "export", "serve")
+    if (args.command not in ("predict", "export", "serve", "scan")
             or not (run_dir / "config.json").exists()):
         # no-clobber for predict: it is routinely pointed AT a fit run dir
         # (README usage) and must not overwrite the trained run's recorded
@@ -1334,6 +1342,16 @@ def main(argv: Sequence[str] | None = None) -> dict:
                 cfg, run_dir=run_dir,
                 ckpt_dir=Path(args.ckpt_dir) if args.ckpt_dir else None,
                 artifact=args.artifact)
+        if args.command == "scan":
+            from deepdfa_tpu.scan import scan_command
+
+            targets = ([args.subcommand] if args.subcommand else []) + list(
+                args.source)
+            return scan_command(
+                cfg, run_dir, targets,
+                ckpt_dir=Path(args.ckpt_dir) if args.ckpt_dir else None,
+                artifact=args.artifact, workers=args.workers,
+                cache_dir=Path(args.cache_dir) if args.cache_dir else None)
         return analyze(cfg, run_dir)
     except Exception:
         # crash marker parity: rename log to .log.error (main_cli.py:324-336).
@@ -1341,7 +1359,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
         # failed scan must not mark the completed TRAINING run as crashed.
         for h in handlers:
             h.close()
-        if args.command not in ("predict", "export", "serve"):
+        if args.command not in ("predict", "export", "serve", "scan"):
             log_file.rename(log_file.with_suffix(".log.error"))
         raise
 

@@ -128,6 +128,12 @@ def dfmp(
     ordered (``imap``) or unordered (``imap_unordered``); chunked; tqdm'd.
     Falls back to a serial map when ``workers <= 1`` (useful in tests and on
     single-core hosts).
+
+    Workers come from an explicit **spawn** context: the default fork start
+    method after a jax import can deadlock children on inherited runtime
+    locks, and ``maxtasksperchild`` recycles workers so one leaky native
+    extraction cannot grow a worker process unboundedly. A worker exception
+    propagates to the caller (the pool survives and is torn down cleanly).
     """
     import tqdm
 
@@ -142,7 +148,8 @@ def dfmp(
         return [function(i) for i in tqdm.tqdm(items, total=len(items), desc=desc)]
 
     mapper = lambda pool: pool.imap(function, items, cs) if ordr else pool.imap_unordered(function, items, cs)
-    with multiprocessing.Pool(processes=workers) as pool:
+    ctx = multiprocessing.get_context("spawn")
+    with ctx.Pool(processes=workers, maxtasksperchild=64) as pool:
         return list(tqdm.tqdm(mapper(pool), total=len(items), desc=desc))
 
 

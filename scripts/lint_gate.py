@@ -4,6 +4,8 @@ contract suite (``pytest -m perf_contract``) + the fleet unit suite
 (``pytest -m fleet``: hash ring, router, warm store, autoscaler
 decision loop + kill -9 chaos) + the observability
 suite (``pytest -m obs``: tracing, exposition conformance, drift) + the
+streaming-extraction suite (``pytest -m 'extraction and not slow'``:
+pool exactly-once semantics, cache commit protocol, chaos points) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
 lock-order, jit-purity/donation, fault-registry, metrics conformance
 static passes) + the perf-regression ledger (``python -m
@@ -82,6 +84,17 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("obs")
+
+    # the streaming-extraction suite: pool exactly-once semantics, cache
+    # commit protocol, chaos points — fast subset only (the kill -9 corpus
+    # resume test is `slow` and stays in the full tier-1 run)
+    print("lint_gate: pytest -m 'extraction and not slow'")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "extraction and not slow",
+         "-q", "tests/test_extraction.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("extraction")
 
     # step 5: the invariant gate — AST passes for atomic-commit,
     # lock-order, jit-purity/donation, fault-registry and metrics

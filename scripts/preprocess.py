@@ -7,10 +7,16 @@ abstract_dataflow → absdf) as one resumable driver, JVM-free:
 1. **ingest** — Big-Vul/Devign CSVs via :mod:`deepdfa_tpu.data.ingest`
    (requires the downloaded corpus on disk), or ``--dataset demo`` for the
    generated-C corpus (:mod:`deepdfa_tpu.data.codegen`, hermetic).
-2. **extract** — native C frontend per function (parallel ``dfmp`` over
-   workers, parity with the SLURM-sharded Joern stage of
-   ``run_getgraphs.sh``); failures land in ``failed_frontend.txt`` and are
-   skipped, mirroring ``failed_joern.txt``.
+2. **extract** — native C frontend per function through the work-stealing
+   :class:`~deepdfa_tpu.data.extraction.ExtractionPool` (process-backed
+   sessions when ``--workers > 1``; parity with the SLURM-sharded Joern
+   stage of ``run_getgraphs.sh``) with the content-addressed
+   :class:`~deepdfa_tpu.data.extract_cache.ExtractCache` in front and
+   per-shard progress journaled to ``build_journal.json`` — a ``kill -9``
+   mid-corpus resumes without re-extracting completed shards. Failures land
+   in ``failed_frontend.txt`` and are skipped, mirroring
+   ``failed_joern.txt``; poison functions are quarantined into
+   ``quarantine.json``, never build aborts.
 3. **label** — vulnerable lines = removed ∪ dependent-added
    (``evaluate.py:194-218``); Devign-style corpora broadcast the graph label.
 4. **materialize** — abstract-dataflow features → train-split vocab →
@@ -38,73 +44,58 @@ sys.path.insert(0, str(REPO))
 from deepdfa_tpu.resilience.journal import atomic_write_text  # noqa: E402
 
 
-def _extract_one(item: dict) -> tuple[int, object, str | None]:
-    """(id, CPG|None, error) — module-level so process pools can pickle it.
-
-    Per-function resume (``getgraphs.py:47-54`` idempotence parity): when the
-    item carries a ``_cache_dir``, the augmented CPG is pickled under a
-    content-addressed name and reused on re-runs; writes go through a
-    temp-file rename so parallel workers never see partial pickles."""
-    import hashlib
-    import os
-    import pickle
-
+def _extract_src(code: str):
+    """The per-function native extraction (module-level so a spawned
+    ``ProcessSession`` child can import it by reference)."""
     from deepdfa_tpu.cpg.features import add_dependence_edges
     from deepdfa_tpu.cpg.frontend import parse_source
 
-    fid, code = item["id"], item["before"]
-    cache_dir = item.get("_cache_dir")
-    cache_path = None
-    if cache_dir:
-        digest = hashlib.sha1(str(code).encode()).hexdigest()[:16]
-        cache_path = Path(cache_dir) / f"{fid}_{digest}.pkl"
-        if cache_path.exists():
-            try:
-                with open(cache_path, "rb") as f:
-                    return fid, pickle.load(f), None
-            except Exception:  # noqa: BLE001 — corrupt cache entry: re-extract
-                pass
-    try:
-        cpg = add_dependence_edges(parse_source(code))
-    except Exception as exc:  # noqa: BLE001 — failure-file protocol
-        return fid, None, f"{fid}\t{type(exc).__name__}: {exc}"
-    if cache_path is not None:
-        tmp = cache_path.with_suffix(f".tmp{os.getpid()}")
-        with open(tmp, "wb") as f:
-            pickle.dump(cpg, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, cache_path)
-    return fid, cpg, None
+    return add_dependence_edges(parse_source(code))
 
 
-def _extract_with_joern(records: list[dict], dataset: str):
-    """Joern extraction path: source files land under ``processed/{ds}/before``
-    (the reference's storage layout), one interactive session exports
-    ``.nodes/.edges/.dataflow.json`` per function via the framework's own
-    query script (``cpg/queries/export_func_graph.sc``), and the artifacts are
-    read back with :func:`deepdfa_tpu.cpg.joern.load_cpg`.
+class _InlineExtractSession:
+    """Serial-path session: native extraction in-process (workers <= 1)."""
 
-    The session is driven through an :class:`ExtractionSupervisor`: a REPL
-    that hangs or dies mid-function is restarted (spawn retried with
-    backoff) and the function retried on the fresh session; a function that
-    keeps killing sessions is quarantined — one failure row, the build
-    continues. Returns ``(cpgs, failures, parse_after, supervisor)`` where
-    ``parse_after`` extracts an after-patch CPG for the statement labeler
-    through the same supervised session and ``supervisor.report()`` feeds
-    the ingest summary/quarantine file."""
+    def extract(self, code: str):
+        return _extract_src(code)
+
+    def close(self) -> None:
+        pass
+
+
+def _native_setup(args):
+    """(session_factory, extract_fn) for the hermetic native frontend. With
+    workers > 1 each pool worker's session is a spawned child process
+    (fork-after-jax safe, scales past the GIL); serial runs stay
+    in-process."""
+    if args.workers > 1:
+        from deepdfa_tpu.data.extraction import ProcessSession
+
+        factory = lambda wid: ProcessSession("scripts.preprocess:_extract_src")  # noqa: E731
+    else:
+        factory = lambda wid: _InlineExtractSession()  # noqa: E731
+    return factory, (lambda session, row: session.extract(str(row["before"])))
+
+
+def _joern_setup(dataset: str):
+    """(session_factory, extract_fn, parse_after, supervisor) for the Joern
+    path: source files land under ``processed/{ds}/before`` (the reference's
+    storage layout), each pool worker drives its OWN interactive session
+    exporting ``.nodes/.edges/.dataflow.json`` per function via the
+    framework's query script (``cpg/queries/export_func_graph.sc``), read
+    back with :func:`deepdfa_tpu.cpg.joern.load_cpg`. ``parse_after``
+    extracts after-patch CPGs for the statement labeler through a separate
+    lazily-spawned supervised session; the caller must ``close()`` the
+    returned supervisor after labeling (a JVM must never leak)."""
     import hashlib
 
     from deepdfa_tpu import utils
     from deepdfa_tpu.cpg.joern import load_cpg
     from deepdfa_tpu.cpg.joern_session import JoernSession
-    from deepdfa_tpu.resilience import ExtractionSupervisor, QuarantinedError
+    from deepdfa_tpu.resilience import ExtractionSupervisor
 
     src_dir = utils.get_dir(utils.processed_dir() / dataset / "before")
     after_dir = utils.get_dir(utils.processed_dir() / dataset / "after")
-    supervisor = ExtractionSupervisor(lambda: JoernSession(worker_id=0))
-    cpgs: dict[int, object] = {}
-    failures: list[str] = []
 
     def _export_and_load(session, c_path: Path):
         stem = str(c_path)
@@ -112,26 +103,16 @@ def _extract_with_joern(records: list[dict], dataset: str):
             session.run_script("export_func_graph", {"filename": stem})
         return load_cpg(stem)
 
-    try:
-        for row in records:
-            fid = row["id"]
-            # content-addressed like the native CPG cache: a changed `before`
-            # text must never silently reuse stale artifacts
-            digest = hashlib.sha1(str(row["before"]).encode()).hexdigest()[:16]
-            c_path = src_dir / f"{fid}_{digest}.c"
-            if not c_path.exists():
-                atomic_write_text(c_path, str(row["before"]))
-            try:
-                cpgs[fid] = supervisor.run(
-                    fid, lambda s, p=c_path: _export_and_load(s, p)
-                )
-            except QuarantinedError as exc:
-                failures.append(f"{fid}\tQuarantined: {exc.reason}")
-            except Exception as exc:  # noqa: BLE001 — failure-file protocol
-                failures.append(f"{fid}\t{type(exc).__name__}: {exc}")
-    except BaseException:
-        supervisor.close()
-        raise
+    def extract_fn(session, row):
+        # content-addressed like the native CPG cache: a changed `before`
+        # text must never silently reuse stale artifacts
+        digest = hashlib.sha1(str(row["before"]).encode()).hexdigest()[:16]
+        c_path = src_dir / f"{row['id']}_{digest}.c"
+        if not c_path.exists():
+            atomic_write_text(c_path, str(row["before"]))
+        return _export_and_load(session, c_path)
+
+    supervisor = ExtractionSupervisor(lambda: JoernSession(worker_id=99))
 
     def parse_after(source: str):
         digest = hashlib.sha1(source.encode()).hexdigest()[:16]
@@ -142,7 +123,96 @@ def _extract_with_joern(records: list[dict], dataset: str):
             f"after:{digest}", lambda s: _export_and_load(s, c_path)
         )
 
-    return cpgs, failures, parse_after, supervisor
+    return (lambda wid: JoernSession(worker_id=wid)), extract_fn, parse_after, supervisor
+
+
+def _extract_streaming(records, args, out_dir: Path, session_factory,
+                       extract_fn, *, salt: str):
+    """Shard-chunked extraction through the work-stealing pool with the
+    content-addressed cache in front and per-shard progress journaled to
+    ``build_journal.json``: a ``kill -9`` mid-corpus resumes at the first
+    unjournaled shard — journaled shards read straight from the cache (a
+    journaled-but-missing entry, e.g. a failure row or a pruned cache,
+    simply re-extracts), so only uncommitted work is re-done.
+
+    Returns ``(cpgs, failures, report)`` where ``failures`` follows the
+    ``failed_frontend.txt`` line protocol and quarantined functions (the
+    invariant-4 poison path) are failure rows, never build aborts."""
+    import hashlib
+
+    from deepdfa_tpu import utils
+    from deepdfa_tpu.data.extract_cache import ExtractCache
+    from deepdfa_tpu.data.extraction import ExtractionPool
+    from deepdfa_tpu.pipeline import source_key
+    from deepdfa_tpu.resilience.journal import RunJournal
+
+    cache = None
+    if not args.no_cache:
+        cache = ExtractCache(
+            utils.get_dir(utils.cache_dir() / "cpg_cache" / args.dataset),
+            salt=salt)
+
+    shard_size = max(1, args.shard_size)
+    shards = [records[i:i + shard_size]
+              for i in range(0, len(records), shard_size)]
+    # the journal cursor is only valid against the SAME corpus in the SAME
+    # order under the same sharding — anything else restarts at shard 0
+    fingerprint = hashlib.sha1(json.dumps(
+        [[r["id"], source_key(str(r["before"]))] for r in records]
+        + [shard_size, salt]).encode()).hexdigest()
+    journal = RunJournal(out_dir / "build_journal.json")
+    start_shard = 0
+    rec = journal.read()
+    if cache is not None and rec and rec.get("fingerprint") == fingerprint:
+        start_shard = min(int(rec.get("shards_done", 0)), len(shards))
+        if start_shard:
+            print(f"[preprocess] journal: resuming at shard "
+                  f"{start_shard}/{len(shards)}", file=sys.stderr)
+
+    cpgs: dict = {}
+    failures: list[str] = []
+    report = {"workers": max(1, args.workers), "restarts": 0,
+              "quarantined": [], "steals": 0, "requeued": 0,
+              "extracted": 0, "cache_hits": 0}
+
+    def _keep(fid, value) -> None:
+        if value is not None and len(value):
+            cpgs[fid] = value
+
+    for si, shard in enumerate(shards):
+        if si < start_shard:
+            pending = []
+            for row in shard:
+                value = cache.get(cache.key(str(row["before"])))
+                if value is None:
+                    pending.append(row)
+                else:
+                    report["cache_hits"] += 1
+                    _keep(row["id"], value)
+            shard = pending
+            if not shard:
+                continue
+        pool = ExtractionPool(
+            session_factory, n_workers=max(1, args.workers), cache=cache,
+            cache_code=lambda row: str(row["before"]))
+        for res in pool.run([(row["id"], row) for row in shard], extract_fn):
+            if res.error is not None:
+                failures.append(f"{res.key}\t{res.error}")
+            else:
+                _keep(res.key, res.value)
+        rep = pool.report()
+        for k in ("restarts", "steals", "requeued", "extracted", "cache_hits"):
+            report[k] += rep[k]
+        report["quarantined"].extend(rep["quarantined"])
+        if cache is not None:
+            # shard si is now fully committed (payloads + meta markers are
+            # on disk before this record lands — the invariant-10 ordering)
+            journal.write(fingerprint=fingerprint, shards_done=si + 1,
+                          n_shards=len(shards), functions=len(records))
+    report["resumed_from_shard"] = start_shard
+    report["shards"] = len(shards)
+    report["cache"] = cache.stats() if cache is not None else None
+    return cpgs, failures, report
 
 
 def main(argv=None) -> dict:
@@ -180,7 +250,12 @@ def main(argv=None) -> dict:
                              "graphs with error diagnostics, and report "
                              "per-check counts in the summary")
     parser.add_argument("--no-cache", action="store_true",
-                        help="disable the per-function CPG extraction cache")
+                        help="disable the per-function CPG extraction cache "
+                             "(also disables the resume journal — resume "
+                             "replays cached shards, so it needs the cache)")
+    parser.add_argument("--shard-size", type=int, default=64,
+                        help="functions per journaled extraction shard: the "
+                             "resume granularity after a mid-build crash")
     args = parser.parse_args(argv)
 
     import numpy as np
@@ -228,27 +303,23 @@ def main(argv=None) -> dict:
         df = ingest.ds(args.dataset, sample=args.sample)
         graph_level = args.dataset == "devign"
 
-    # 2. extract CPGs (parallel, with the failure-file protocol; per-function
-    # pickle cache makes interrupted runs resume where they stopped)
+    # 2. extract CPGs — work-stealing session pool + content-addressed cache
+    # + per-shard journal (failure-file protocol; a kill -9 mid-build resumes
+    # at the first unjournaled shard)
     records = df.to_dict("records")
     parse_after = parse_source
     supervisor = None
+    out_dir.mkdir(parents=True, exist_ok=True)
     if args.frontend == "joern":
-        cpgs, failures, parse_after, supervisor = _extract_with_joern(
-            records, args.dataset
+        session_factory, extract_fn, parse_after, supervisor = _joern_setup(
+            args.dataset
         )
     else:
-        if not args.no_cache:
-            cache = utils.get_dir(utils.cache_dir() / "cpg_cache" / args.dataset)
-            df = df.assign(_cache_dir=str(cache))
-        results = utils.dfmp(df, _extract_one, workers=args.workers, desc="extract")
-        cpgs, failures = {}, []
-        for fid, cpg, err in results:
-            if cpg is not None and len(cpg):
-                cpgs[fid] = cpg
-            if err is not None:
-                failures.append(err)
-    out_dir.mkdir(parents=True, exist_ok=True)
+        session_factory, extract_fn = _native_setup(args)
+    cpgs, failures, extraction = _extract_streaming(
+        records, args, out_dir, session_factory, extract_fn,
+        salt=args.frontend,
+    )
     failed_rate = len(failures) / max(len(records), 1)
     if failures:
         atomic_write_text(out_dir / "failed_frontend.txt", "\n".join(failures) + "\n")
@@ -369,16 +440,27 @@ def main(argv=None) -> dict:
     }
     if validation is not None:
         summary["validation"] = validation
-    if supervisor is not None:
+    if supervisor is not None:  # the labeling-stage session's own restarts
+        extraction["restarts"] += supervisor.report()["restarts"]
+        extraction["quarantined"].extend(supervisor.report()["quarantined"])
+    summary["extraction"] = {
+        "workers": extraction["workers"],
+        "restarts": extraction["restarts"],
+        "quarantined": len(extraction["quarantined"]),
+        "steals": extraction["steals"],
+        "requeued": extraction["requeued"],
+        "extracted": extraction["extracted"],
+        "cache_hits": extraction["cache_hits"],
+        "resumed_from_shard": extraction["resumed_from_shard"],
+        "extraction_shards": extraction["shards"],
+        "cache": extraction["cache"],
+    }
+    if extraction["quarantined"]:
         from deepdfa_tpu.data.ingest import write_quarantine
 
-        report = supervisor.report()
-        summary["extraction"] = {
-            "restarts": report["restarts"],
-            "quarantined": len(report["quarantined"]),
-        }
-        if report["quarantined"]:
-            summary["quarantine_file"] = str(write_quarantine(out_dir, report))
+        summary["quarantine_file"] = str(
+            write_quarantine(out_dir, {"quarantined": extraction["quarantined"]})
+        )
     if args.dataflow_families:
         summary["dataflow_families"] = True
     print(json.dumps(summary))

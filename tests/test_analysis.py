@@ -45,6 +45,7 @@ FIXTURES = REPO / "tests" / "fixtures" / "analysis"
 # one seeded violation per pass: fixture file -> invariant ids it must trip
 EXPECTED = {
     "autoscaler_unguarded.py": {"unguarded-state"},
+    "extraction_pool_unguarded.py": {"unguarded-state"},
     "checkpoint_torn_write.py": {"atomic-commit"},
     "serve_lock_cycle.py": {"lock-order", "unguarded-state"},
     "jit_impure.py": {"jit-purity"},

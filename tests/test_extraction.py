@@ -1,0 +1,557 @@
+"""Streaming-extraction suite: the work-stealing session pool, the
+content-addressed extraction cache, process-backed sessions, journaled
+corpus resume, the dfmp spawn contract, and the scan surface.
+
+Device-free. Chaos tests pin the `extract.worker_crash` /
+`extract.cache_corrupt` fault points: a crashed worker's in-flight item is
+re-queued (not lost, not double-counted) and a corrupt cache entry reads
+as a MISS, never a decode crash.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+from deepdfa_tpu.data.extract_cache import ExtractCache
+from deepdfa_tpu.data.extraction import (
+    ExtractionItemError,
+    ExtractionPool,
+    ProcessSession,
+)
+from deepdfa_tpu.resilience import RetryPolicy, faults
+
+pytestmark = pytest.mark.extraction
+
+
+# ---------------------------------------------------------------------------
+# fakes
+
+
+class _PoolSession:
+    """Scripted pool session: ``plan[payload]`` is a list of per-attempt
+    outcomes (Exception instances raised, values returned); unplanned
+    payloads echo ``done:{payload}``. ``delay`` simulates a slow session."""
+
+    def __init__(self, plan=None, delay=0.0):
+        self.plan = plan or {}
+        self.delay = delay
+        self.closed = False
+
+    def extract(self, payload):
+        if self.delay:
+            time.sleep(self.delay)
+        outcomes = self.plan.get(payload)
+        if outcomes is None:
+            return f"done:{payload}"
+        out = outcomes.pop(0) if len(outcomes) > 1 else outcomes[0]
+        if isinstance(out, BaseException):
+            raise out
+        return out
+
+    def close(self):
+        self.closed = True
+
+
+def _run_pool(items, *, n_workers=3, plan=None, delay=0.0, **kw):
+    pool = ExtractionPool(
+        lambda wid: _PoolSession(plan, delay=delay), n_workers=n_workers,
+        spawn_policy=RetryPolicy(attempts=2, base_delay=0.0, jitter=0.0),
+        sleep=lambda _s: None, **kw)
+    results = pool.run(items, lambda session, payload: session.extract(payload))
+    return results, pool.report()
+
+
+# ---------------------------------------------------------------------------
+# pool: ordering, stealing, failure domains
+
+
+def test_pool_results_in_input_order_across_workers():
+    items = [(f"k{i}", f"p{i}") for i in range(24)]
+    results, report = _run_pool(items, n_workers=3)
+    assert [r.key for r in results] == [k for k, _ in items]
+    assert [r.value for r in results] == [f"done:p{i}" for i in range(24)]
+    assert all(r.error is None for r in results)
+    assert report["extracted"] == 24 and report["quarantined"] == []
+    assert len({r.worker for r in results}) >= 1  # workers recorded
+
+
+def test_pool_accepts_zero_arg_factory():
+    pool = ExtractionPool(lambda: _PoolSession(), n_workers=2)
+    results = pool.run([("a", "x"), ("b", "y")],
+                       lambda session, payload: session.extract(payload))
+    assert [r.value for r in results] == ["done:x", "done:y"]
+
+
+def test_pool_rejects_zero_workers():
+    with pytest.raises(ValueError, match="n_workers"):
+        ExtractionPool(lambda: _PoolSession(), n_workers=0)
+
+
+def test_pool_item_error_is_one_failure_row():
+    """ValueError family = the failure-file protocol: one error row, no
+    restart, no quarantine, every other item unaffected."""
+    plan = {"bad": [ValueError("malformed artifact")]}
+    items = [("g1", "x"), ("b", "bad"), ("g2", "y")]
+    results, report = _run_pool(items, plan=plan, n_workers=2)
+    assert results[1].error == "ValueError: malformed artifact"
+    assert not results[1].quarantined
+    assert results[0].value == "done:x" and results[2].value == "done:y"
+    assert report["restarts"] == 0 and report["quarantined"] == []
+
+
+def test_pool_quarantines_poison_and_never_aborts():
+    """A function that keeps killing sessions lands on the quarantine list
+    (invariant 4) as one error row; the rest of the corpus completes."""
+    plan = {"poison": [TimeoutError("no prompt")]}  # every attempt times out
+    items = [(f"k{i}", f"p{i}") for i in range(6)] + [("px", "poison")]
+    results, report = _run_pool(items, plan=plan, n_workers=2)
+    row = results[-1]
+    assert row.quarantined and row.error.startswith("Quarantined:")
+    assert all(r.error is None for r in results[:-1])
+    assert len(report["quarantined"]) == 1
+    assert report["quarantined"][0]["key"] == "px"
+    assert report["restarts"] >= 1  # the poison item tore sessions down
+
+
+def test_pool_steals_from_slow_workers_queue():
+    """Round-robin dealing puts even items on worker 0; making those slow
+    forces worker 1 to run dry and steal from worker 0's backlog."""
+    slow = {f"s{i}": [f"v{i}"] for i in range(8)}
+    items = []
+    for i in range(8):
+        items.append((f"a{i}", f"s{i}"))   # worker 0 (slow session payloads)
+        items.append((f"b{i}", f"q{i}"))   # worker 1 (instant)
+
+    class _Mixed(_PoolSession):
+        def extract(self, payload):
+            if payload.startswith("s"):
+                time.sleep(0.02)
+            return f"done:{payload}"
+
+    pool = ExtractionPool(lambda wid: _Mixed(), n_workers=2)
+    results = pool.run(items, lambda s, p: s.extract(p))
+    assert all(r.error is None for r in results)
+    assert pool.report()["steals"] >= 1
+
+
+def test_pool_cache_short_circuits_warm_run(tmp_path):
+    """The acceptance pin: a warm re-run of an unchanged corpus performs
+    ZERO extractions — every item is a committed-cache hit."""
+    cache = ExtractCache(tmp_path / "cache", salt="t")
+    items = [(f"k{i}", f"code {i}") for i in range(8)]
+
+    def run(c):
+        pool = ExtractionPool(lambda wid: _PoolSession(), n_workers=2,
+                              cache=c, cache_code=lambda p: p)
+        return pool.run(items, lambda s, p: s.extract(p)), pool.report()
+
+    _cold, cold_rep = run(cache)
+    assert cold_rep["extracted"] == 8 and cold_rep["cache_hits"] == 0
+    warm_cache = ExtractCache(tmp_path / "cache", salt="t")
+    warm, warm_rep = run(warm_cache)
+    assert warm_rep["extracted"] == 0 and warm_rep["cache_hits"] == 8
+    assert all(r.cache_hit for r in warm)
+    assert [r.value for r in warm] == [f"done:code {i}" for i in range(8)]
+    assert warm_cache.stats()["hit_rate"] == 1.0
+
+
+def test_pool_failed_items_are_not_cached(tmp_path):
+    cache = ExtractCache(tmp_path / "cache")
+    plan = {"bad": [ValueError("nope")]}
+    _run_pool([("b", "bad")], plan=plan, n_workers=1, cache=cache,
+              cache_code=lambda p: p)
+    assert len(cache) == 0
+    results, report = _run_pool([("b", "bad")], plan={}, n_workers=1,
+                                cache=cache, cache_code=lambda p: p)
+    assert results[0].value == "done:bad"  # re-extracted, not a stale miss
+
+
+# ---------------------------------------------------------------------------
+# pool chaos: crashed workers re-queue in-flight work exactly once
+
+
+@pytest.mark.faults
+def test_worker_crash_requeues_in_flight_item_exactly_once():
+    """`extract.worker_crash@2`: the second task picked up anywhere kills
+    its worker thread mid-task. The in-flight item must be re-queued and
+    every item processed EXACTLY once (the pool's _record double-count
+    guard raises if the re-queue path ever duplicates one)."""
+    items = [(f"k{i}", f"p{i}") for i in range(12)]
+    with faults.installed("extract.worker_crash@2"):
+        results, report = _run_pool(items, n_workers=2)
+    assert [r.value for r in results] == [f"done:p{i}" for i in range(12)]
+    assert report["requeued"] == 1
+    assert len(report["crashed_workers"]) == 1
+    assert report["extracted"] == 12  # nothing lost, nothing double-counted
+
+
+@pytest.mark.faults
+def test_all_workers_crash_recovery_session_completes_corpus():
+    """`extract.worker_crash@1,2` kills BOTH workers; the leftovers drain
+    inline on the recovery session and the corpus still completes."""
+    items = [(f"k{i}", f"p{i}") for i in range(10)]
+    with faults.installed("extract.worker_crash@1,2"):
+        results, report = _run_pool(items, n_workers=2)
+    assert all(r.error is None for r in results)
+    assert [r.value for r in results] == [f"done:p{i}" for i in range(10)]
+    assert sorted(report["crashed_workers"]) == [0, 1]
+    assert report["requeued"] == 2 and report["extracted"] == 10
+
+
+# ---------------------------------------------------------------------------
+# extraction cache: commit protocol, torn writes, salting
+
+
+def test_cache_roundtrip_len_and_stats(tmp_path):
+    cache = ExtractCache(tmp_path)
+    k = cache.key("int f(void) { return 1; }")
+    assert cache.get(k) is None
+    cache.put(k, {"graph": [1, 2, 3]})
+    assert cache.get(k) == {"graph": [1, 2, 3]}
+    assert len(cache) == 1
+    s = cache.stats()
+    assert s["hits"] == 1 and s["misses"] == 1 and s["puts"] == 1
+    assert s["hit_rate"] == 0.5
+
+
+def test_cache_key_normalizes_whitespace_but_not_content(tmp_path):
+    """`source_key` normalization: trailing whitespace / blank lines /
+    CRLF share one entry; any byte the frontend reads is a distinct key."""
+    cache = ExtractCache(tmp_path)
+    assert cache.key("int f() { return 1; }  \n\n") == cache.key(
+        "int f() { return 1; }\r\n")
+    assert cache.key("int f() { return 1; }") != cache.key(
+        "int f() { return 2; }")
+
+
+def test_cache_version_and_salt_partition_generations(tmp_path):
+    """Bumping the extractor version or re-salting (new vocab) must MISS
+    cleanly — old entries can never resurrect under a new pipeline."""
+    code = "int f(void) { return 1; }"
+    v1 = ExtractCache(tmp_path, version=1, salt="vocabA")
+    v1.put(v1.key(code), "gen1")
+    v2 = ExtractCache(tmp_path, version=2, salt="vocabA")
+    resalted = ExtractCache(tmp_path, version=1, salt="vocabB")
+    assert v1.key(code) != v2.key(code) != resalted.key(code)
+    assert v2.get(v2.key(code)) is None
+    assert resalted.get(resalted.key(code)) is None
+    assert v1.get(v1.key(code)) == "gen1"
+
+
+def test_cache_torn_write_reads_as_miss(tmp_path):
+    """Payload-first commit: an entry exists iff its meta marker does, and
+    every torn/corrupt shape is a MISS, never an exception."""
+    import pickle
+
+    cache = ExtractCache(tmp_path)
+    k = cache.key("code")
+    payload, meta = tmp_path / f"{k}.pkl", tmp_path / f"{k}.json"
+    # payload landed, crash before the meta marker → uncommitted == miss
+    payload.write_bytes(pickle.dumps("v"))
+    assert cache.get(k) is None and len(cache) == 0
+    # meta without payload (manual deletion) → miss
+    payload.unlink()
+    meta.write_text(json.dumps({"schema": 1, "sha256": "0" * 64, "bytes": 1}))
+    assert cache.get(k) is None
+    # garbage payload under a valid meta → digest mismatch → miss
+    cache.put(k, "good")
+    payload.write_bytes(b"garbage")
+    assert cache.get(k) is None
+    # only the digest mismatch is CORRUPTION; the torn shapes above are
+    # uncommitted entries — plain misses by the commit protocol
+    assert cache.stats()["corrupt"] == 1
+
+
+@pytest.mark.faults
+def test_cache_corrupt_fault_reads_as_miss_never_crashes(tmp_path):
+    """`extract.cache_corrupt@1`: the first read after arming sees a
+    corrupted blob — it must classify as MISS (corrupt counter up), and
+    the UNDAMAGED on-disk entry still hits afterwards."""
+    cache = ExtractCache(tmp_path)
+    k = cache.key("code")
+    cache.put(k, {"nodes": 5})
+    with faults.installed("extract.cache_corrupt@1"):
+        assert cache.get(k) is None
+    assert cache.stats()["corrupt"] == 1
+    assert cache.get(k) == {"nodes": 5}  # injection corrupted the read, not the file
+
+
+def test_cache_get_or_extract(tmp_path):
+    cache = ExtractCache(tmp_path)
+    calls = []
+
+    def extract(code):
+        calls.append(code)
+        return code.upper()
+
+    assert cache.get_or_extract("abc", extract) == ("ABC", False)
+    assert cache.get_or_extract("abc", extract) == ("ABC", True)
+    assert calls == ["abc"]
+
+
+# ---------------------------------------------------------------------------
+# process-backed sessions (spawned children; extractors resolve in-child)
+
+
+def test_process_session_roundtrip_and_item_error():
+    session = ProcessSession("json:dumps", timeout_s=30, spawn_timeout_s=60)
+    try:
+        assert session.extract([1, 2]) == "[1, 2]"
+        assert session.extract({"a": 1}) == '{"a": 1}'
+    finally:
+        session.close()
+    bad = ProcessSession("json:loads", timeout_s=30, spawn_timeout_s=60)
+    try:
+        # the child survives an item failure: error reply, then next item ok
+        with pytest.raises(ExtractionItemError, match="JSONDecodeError"):
+            bad.extract("not json")
+        assert bad.extract("[3]") == [3]
+    finally:
+        bad.close()
+
+
+def test_process_session_bad_extractor_ref_fails_spawn():
+    with pytest.raises(RuntimeError, match="failed to spawn"):
+        ProcessSession("deepdfa_tpu.no_such_module:fn", spawn_timeout_s=60)
+
+
+def test_process_session_dead_child_is_session_error():
+    session = ProcessSession("json:dumps", timeout_s=5, spawn_timeout_s=60)
+    try:
+        session._proc.terminate()
+        session._proc.join(timeout=5)
+        with pytest.raises((RuntimeError, TimeoutError, OSError)):
+            session.extract([1])
+    finally:
+        session.close()
+
+
+def test_pool_over_process_sessions():
+    """Integration: the pool supervises real spawned children end-to-end."""
+    pool = ExtractionPool(
+        lambda wid: ProcessSession("json:dumps", spawn_timeout_s=60),
+        n_workers=2)
+    items = [(i, [i, i + 1]) for i in range(6)]
+    results = pool.run(items, lambda session, p: session.extract(p))
+    assert [r.value for r in results] == [f"[{i}, {i + 1}]" for i in range(6)]
+    assert pool.report()["quarantined"] == []
+
+
+# ---------------------------------------------------------------------------
+# dfmp spawn contract (satellite: explicit spawn ctx + maxtasksperchild)
+
+
+def _dfmp_double(x):
+    return x * 2
+
+
+def _dfmp_maybe_boom(x):
+    if x == 3:
+        raise ValueError("worker exploded on 3")
+    return x
+
+
+def test_dfmp_spawn_preserves_order():
+    import pandas as pd
+
+    from deepdfa_tpu import utils
+
+    df = pd.DataFrame({"v": list(range(12))})
+    out = utils.dfmp(df, _dfmp_double, columns="v", workers=2, cs=2)
+    assert out == [i * 2 for i in range(12)]
+
+
+def test_dfmp_worker_exception_propagates_cleanly():
+    import pandas as pd
+
+    from deepdfa_tpu import utils
+
+    df = pd.DataFrame({"v": [0, 1, 2, 3, 4]})
+    with pytest.raises(ValueError, match="worker exploded on 3"):
+        utils.dfmp(df, _dfmp_maybe_boom, columns="v", workers=2, cs=1)
+    # the pool tore down cleanly: a fresh call on the same interpreter works
+    assert utils.dfmp(df, _dfmp_double, columns="v", workers=2, cs=2) == [
+        0, 2, 4, 6, 8]
+
+
+# ---------------------------------------------------------------------------
+# journaled corpus resume (tentpole c): kill -9 mid-build, resume, and only
+# non-journaled functions are re-extracted
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_preprocess_kill9_mid_corpus_resumes_from_journal(tmp_path):
+    """Chaos acceptance pin: SIGKILL a corpus build once at least one shard
+    is journaled; the re-run must resume at `build_journal.json`'s cursor
+    and re-extract ONLY the non-journaled functions."""
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ, DEEPDFA_STORAGE=str(tmp_path / "storage"),
+               JAX_PLATFORMS="cpu")
+    argv = [sys.executable, str(repo / "scripts" / "preprocess.py"),
+            "--dataset", "demo", "--n", "120", "--workers", "1",
+            "--shard-size", "4"]
+    journal = (tmp_path / "storage" / "processed" / "demo" / "shards"
+               / "build_journal.json")
+
+    proc = subprocess.Popen(argv, env=env, cwd=repo,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 120
+        shards_done = 0
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                shards_done = json.loads(journal.read_text())["shards_done"]
+            except (FileNotFoundError, json.JSONDecodeError, KeyError):
+                shards_done = 0
+            if shards_done >= 2:
+                proc.send_signal(signal.SIGKILL)
+                break
+            time.sleep(0.05)
+    finally:
+        proc.wait(timeout=60)
+    if proc.returncode == 0:  # build outran the poller — nothing to resume
+        pytest.skip("corpus build finished before the kill window")
+    assert shards_done >= 2, "journal never advanced before the kill"
+
+    out = subprocess.run(argv, env=env, cwd=repo, capture_output=True,
+                         text=True, timeout=600, check=True)
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["status"] == "ok" and summary["graphs"] == 120
+    ext = summary["extraction"]
+    assert ext["resumed_from_shard"] >= 2
+    # only non-journaled work re-extracted; journaled shards came from cache
+    assert ext["extracted"] < 120
+    assert ext["cache_hits"] >= ext["resumed_from_shard"] * 4 - 4
+    assert ext["extracted"] + ext["cache_hits"] == 120
+
+
+# ---------------------------------------------------------------------------
+# scan surface (encode-only; engine-backed scoring is exercised in
+# test_predict's end-to-end path)
+
+
+@pytest.fixture(scope="module")
+def demo_vocabs(tmp_path_factory):
+    """Demo shards built once for the module; yields (vocabs, storage)."""
+    storage = tmp_path_factory.mktemp("scan_storage")
+    old = os.environ.get("DEEPDFA_STORAGE")
+    os.environ["DEEPDFA_STORAGE"] = str(storage)
+    try:
+        import preprocess
+
+        summary = preprocess.main(["--dataset", "demo", "--n", "16",
+                                   "--workers", "1"])
+        assert summary["status"] == "ok"
+        from deepdfa_tpu import utils
+        from deepdfa_tpu.pipeline import load_vocabs
+
+        vocabs = load_vocabs(utils.processed_dir() / "demo" / "shards")
+        yield vocabs, storage
+    finally:
+        if old is None:
+            os.environ.pop("DEEPDFA_STORAGE", None)
+        else:
+            os.environ["DEEPDFA_STORAGE"] = old
+
+
+def _write_scan_dir(root: Path) -> Path:
+    import numpy as np
+
+    from deepdfa_tpu.data.codegen import generate_function
+
+    rng = np.random.default_rng(7)
+    src = root / "src"
+    (src / "sub").mkdir(parents=True)
+    for i in range(3):
+        (src / "sub" / f"f{i}.c").write_text(
+            generate_function(800 + i, bool(i % 2), rng)["before"])
+    (src / "broken.c").write_text("int f( {{{ not C at all")
+    (src / "README.md").write_text("not a C file — must be skipped")
+    return src
+
+
+def test_scan_paths_encode_only_and_warm_rescan(tmp_path, demo_vocabs):
+    from deepdfa_tpu.scan import scan_paths
+
+    vocabs, _ = demo_vocabs
+    src = _write_scan_dir(tmp_path)
+    report = scan_paths([src], vocabs, n_workers=2,
+                        cache_dir=tmp_path / "cache")
+    assert report["n_files"] == 4  # .md skipped by the walker
+    assert report["n_functions"] >= 3
+    assert report["n_errors"] == 1  # broken.c is one row, not a dead scan
+    (err_row,) = [r for r in report["results"] if "error" in r]
+    assert err_row["file"].endswith("broken.c")
+    assert report["n_scored"] == 0  # encode-only without an engine
+
+    # warm re-scan of the unchanged tree: zero extractions, all hits
+    warm = scan_paths([src], vocabs, n_workers=2,
+                      cache_dir=tmp_path / "cache")
+    assert warm["pool"]["extracted"] == 0
+    # every ENCODABLE file hits; broken.c fails again (failures are never
+    # cached), which is the one honest miss
+    assert warm["cache"]["hits"] == 3 and warm["cache"]["misses"] == 1
+    assert all(r["cache_hit"] for r in warm["results"] if "function" in r)
+
+
+def test_scan_vocab_salt_invalidates_cache(tmp_path, demo_vocabs):
+    """Encoding is vocab-dependent: the same tree under a DIFFERENT vocab
+    must re-encode, not serve the other vocab's cached encodings."""
+    import dataclasses
+
+    from deepdfa_tpu.scan import scan_paths
+
+    vocabs, _ = demo_vocabs
+    src = _write_scan_dir(tmp_path)
+    scan_paths([src], vocabs, n_workers=1, cache_dir=tmp_path / "cache")
+    name, voc = next(iter(vocabs.items()))
+    other = dict(vocabs)
+    other[name] = dataclasses.replace(
+        voc, all_vocab={**voc.all_vocab,
+                        "__probe__": len(voc.all_vocab) + 1})
+    rescan = scan_paths([src], other, n_workers=1,
+                        cache_dir=tmp_path / "cache")
+    assert rescan["pool"]["extracted"] > 0  # MISS under the new vocab hash
+    assert rescan["cache"]["hits"] == 0
+
+
+def test_scan_missing_target_raises(demo_vocabs):
+    from deepdfa_tpu.scan import scan_paths
+
+    vocabs, _ = demo_vocabs
+    with pytest.raises(FileNotFoundError):
+        scan_paths(["/nonexistent/definitely_not_here"], vocabs)
+
+
+@pytest.mark.slow
+def test_scan_cli_end_to_end(tmp_path, demo_vocabs):
+    """`deepdfa-tpu scan <dir>`: walks the tree, writes scan.json into the
+    run dir, and the report round-trips."""
+    from deepdfa_tpu.train import cli
+
+    _vocabs, _storage = demo_vocabs
+    src = _write_scan_dir(tmp_path)
+    run_dir = tmp_path / "run"
+    report = cli.main(["scan", str(src), "--run-dir", str(run_dir),
+                       "--set", "data.dsname=demo", "--workers", "2"])
+    assert report["n_files"] == 4 and report["n_errors"] == 1
+    on_disk = json.loads((run_dir / "scan.json").read_text())
+    assert on_disk["n_functions"] == report["n_functions"]
+    assert (run_dir / "extract_cache").is_dir()  # default cache location
+
+
+def test_scan_cli_requires_target(tmp_path):
+    from deepdfa_tpu.train import cli
+
+    with pytest.raises(SystemExit):
+        cli.main(["scan", "--run-dir", str(tmp_path / "r")])

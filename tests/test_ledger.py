@@ -78,6 +78,36 @@ def test_megabatch_series_are_explicitly_declared():
     assert lower_is_better("graphs_per_sec", "ggnn_megabatch") is False
 
 
+def test_extraction_series_are_explicitly_declared():
+    """Satellite pin (PR 13): the extraction stage's metrics are DECLARED.
+    ``quarantined`` is the one the heuristic would get WRONG — no token in
+    the name says lower-is-better, but more quarantined functions is a
+    corpus-quality regression."""
+    assert lower_is_better("quarantined") is False  # heuristic misreads it
+    assert EXPLICIT_SERIES[("extraction", "functions_per_sec")] is False
+    assert EXPLICIT_SERIES[("extraction", "cache_hit_rate")] is False
+    assert EXPLICIT_SERIES[("extraction", "quarantined")] is True
+    assert lower_is_better("quarantined", "extraction") is True
+    assert lower_is_better("functions_per_sec", "extraction") is False
+    assert lower_is_better("cache_hit_rate", "extraction") is False
+
+
+def test_extraction_quarantined_rise_is_regression(tmp_path):
+    """End-to-end: a quarantine-count JUMP under the extraction stage must
+    go red even though the bare heuristic reads the name as
+    higher-is-better."""
+    for i, v in enumerate([0.0, 0.0, 1.0, 0.0]):
+        _art(tmp_path, f"BENCH_e{i:02d}.json", emitted=1000 + i,
+             extraction={"quarantined": v, "cache_hit_rate": 1.0})
+    _art(tmp_path, "BENCH_e99.json", emitted=2000,
+         extraction={"quarantined": 9.0, "cache_hit_rate": 1.0})
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    (row,) = [r for r in rows if r["metric"] == "quarantined"]
+    assert row["stage"] == "extraction"
+    assert row["lower_is_better"] is True
+    assert row["verdict"] == "regression" and ok is False
+
+
 def test_explicit_series_direction_flows_into_verdicts(tmp_path):
     """A dispatches_per_step DROP under the megabatch stage must read
     improved (the declared direction), exercised end-to-end through

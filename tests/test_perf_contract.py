@@ -40,9 +40,62 @@ def test_every_new_assembler_carries_provenance():
         bench.assemble_fused_train_result("cpu", "cpu", _run(1.0), _run(2.0), 64),
         bench.assemble_strict_latency_result("cpu", "cpu", 10.0, 2.0, 8, 64),
         bench.assemble_int8_serving_result("cpu", "cpu", "int8", 1e-4, 0.01, {}),
+        bench.assemble_extraction_result(
+            n_functions=8, n_workers=2, host_cpus=8, serial_fps=10.0,
+            pool_fps=18.0, warm_hit_rate=1.0, warm_extracted=0, n_results=8,
+            quarantined=0),
     ]
     for art in arts:
         assert PROVENANCE_KEYS <= set(art), art["metric"]
+
+
+# --------------------------------------------------------------- extraction
+
+
+def _extraction_kwargs(**over):
+    kw = dict(n_functions=100, n_workers=8, host_cpus=16, serial_fps=50.0,
+              pool_fps=50.0 * 8 * 0.9, warm_hit_rate=1.0, warm_extracted=0,
+              n_results=100, quarantined=0, steals=3)
+    kw.update(over)
+    return kw
+
+
+def test_extraction_gates_pass_and_ledger_stage_block():
+    art = bench.assemble_extraction_result(**_extraction_kwargs())
+    assert art["ok"] is True and art["scaling_ok"] is True
+    assert art["scaling_vs_serial"] == 7.2
+    # the nested stage block the ledger ingests as stage "extraction"
+    assert art["extraction"] == {
+        "functions_per_sec": 360.0, "cache_hit_rate": 1.0, "quarantined": 0}
+
+
+def test_extraction_scaling_gate_conditional_on_host_cores():
+    """The 1-core-host escape hatch: below-floor scaling FAILS only when
+    the host actually has N cores; with fewer cores the honest measurement
+    is recorded ungated (scaling_ok is None, ok still gates the rest)."""
+    slow = _extraction_kwargs(pool_fps=50.0 * 8 * 0.5)  # 0.5x/worker < 0.75
+    gated = bench.assemble_extraction_result(**slow)
+    assert gated["scaling_ok"] is False and gated["ok"] is False
+    starved = bench.assemble_extraction_result(**{**slow, "host_cpus": 1})
+    assert starved["scaling_ok"] is None and starved["ok"] is True
+
+
+def test_extraction_warm_rescan_gate_always_applies():
+    art = bench.assemble_extraction_result(
+        **_extraction_kwargs(warm_hit_rate=0.99, warm_extracted=1))
+    assert art["ok"] is False
+    # ...even on a core-starved host where the scaling gate is waived
+    art = bench.assemble_extraction_result(
+        **_extraction_kwargs(host_cpus=1, warm_extracted=2))
+    assert art["ok"] is False
+
+
+def test_extraction_lost_item_or_error_is_not_ok():
+    art = bench.assemble_extraction_result(**_extraction_kwargs(n_results=99))
+    assert art["ok"] is False
+    art = bench.assemble_extraction_result(
+        **_extraction_kwargs(error="pool wedged"))
+    assert art["ok"] is False and art["error"] == "pool wedged"
 
 
 # ------------------------------------------------------------- fused train

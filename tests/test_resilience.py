@@ -350,6 +350,31 @@ def test_supervisor_spawn_retries_then_gives_up():
     assert sessions2 == []
 
 
+def test_supervisor_respawn_backoff_under_spawn_fault_schedule():
+    """A spawn-refusing fault schedule (`joern.die@1,2`) makes the first
+    two spawn attempts die; the supervisor must wait the policy's
+    exponential backoff (base, base*multiplier) between them — recorded
+    sleeps, not wall clock — and then extract on the third session."""
+    slept: list[float] = []
+    log: list = []
+
+    def factory():
+        faults.raise_if("joern.die")  # InjectedFault ∈ SESSION_ERRORS
+        return _FakeSession({"f": ["cpg"]}, log)
+
+    sup = ExtractionSupervisor(
+        factory,
+        spawn_policy=RetryPolicy(attempts=3, base_delay=1.0, max_delay=15.0,
+                                 multiplier=2.0, jitter=0.0),
+        attempts_per_item=2,
+        sleep=slept.append,
+    )
+    with faults.installed("joern.die@1,2"):
+        assert sup.run("f", lambda s: s.extract("f")) == "cpg"
+    assert slept == [1.0, 2.0]  # delay(n) = base * multiplier**(n-1)
+    assert sup.restarts == 0  # spawn retries are not session RESTARTS
+
+
 def test_supervisor_item_error_propagates_unwrapped():
     """ValueError is the caller's failure-file protocol, not a session
     fault — no restart, no quarantine."""
