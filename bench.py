@@ -496,7 +496,7 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
                           p99_ms, mean_batch_occupancy, cache_hit_rate,
                           cache_hits, requests_total, errors_total,
                           concurrency=None, notes=None, fleet=None,
-                          autoscale=None):
+                          autoscale=None, cascade=None):
     """ONE-line artifact for the serving stage (scripts/bench_serving.py).
 
     Shared between the load generator and the bench-contract test so the
@@ -506,9 +506,10 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
     "batch" per request would pass a pure throughput check), and the
     repeated-corpus phase produced real cache hits (asserted via the hit
     COUNTER, not timing). ``fleet`` (an ``assemble_fleet_result`` block,
-    from ``--fleet N`` runs) and ``autoscale`` (an
-    ``assemble_autoscale_result`` block, from ``--autoscale`` runs) ride
-    along and AND their own ok."""
+    from ``--fleet N`` runs), ``autoscale`` (an
+    ``assemble_autoscale_result`` block, from ``--autoscale`` runs) and
+    ``cascade`` (an ``assemble_cascade_result`` block, from ``--cascade``
+    runs) ride along and AND their own ok."""
     ok = (requests_total > 0 and errors_total == 0
           and requests_per_sec > 0
           and mean_batch_occupancy is not None
@@ -518,6 +519,8 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
         ok = ok and bool(fleet.get("ok"))
     if autoscale is not None:
         ok = ok and bool(autoscale.get("ok"))
+    if cascade is not None:
+        ok = ok and bool(cascade.get("ok"))
     return {
         "metric": "serve_requests_per_sec",
         "value": round(float(requests_per_sec), 2),
@@ -541,6 +544,81 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
         "notes": notes or {},
         "fleet": fleet,
         "autoscale": autoscale,
+        "cascade": cascade,
+        "ok": ok,
+        **_provenance_fields(),
+    }
+
+
+# cascade gates: the bench pre-scores its corpus through the tier-1 engine
+# and places the band at known score quantiles, so the expected escalation
+# fraction is the band's exact mass — the measured fraction must land
+# within ±20% of it (routing, not luck). Nominal load must produce ZERO
+# degraded answers (invariant 24 covers failure; the bench covers the
+# absence of failure), and the cascade may not tax confident traffic:
+# tier-1 p50 regresses < 10% against the no-cascade baseline phase.
+CASCADE_ESCALATION_TOL = 0.20
+CASCADE_MAX_T1_P50_REGRESSION = 0.10
+
+
+def assemble_cascade_result(backend, device_kind, band, expected_frac,
+                            escalated_total, answered_tier2, degraded_total,
+                            requests_total, tier1_p50_ms, baseline_p50_ms,
+                            tier2_p50_ms, tier2_p99_ms, errors_total,
+                            notes=None):
+    """ONE-line ``cascade`` block for ``bench_serving.py --cascade``.
+
+    ``expected_frac`` is the analytically expected band mass (the fraction
+    of the pre-scored corpus whose tier-1 score falls inside ``band``);
+    ``tier1_p50_ms`` / ``baseline_p50_ms`` are the same load with and
+    without the cascade enabled. Gates: escalation fraction within
+    ``CASCADE_ESCALATION_TOL`` of expected, every escalation answered by
+    tier 2 (``degraded_total == 0`` nominal), zero errors, and tier-1 p50
+    within ``CASCADE_MAX_T1_P50_REGRESSION`` of the baseline phase."""
+    escalated_frac = (None if not requests_total
+                      else float(escalated_total) / float(requests_total))
+    escalation_ok = (expected_frac is not None and expected_frac > 0
+                     and escalated_frac is not None
+                     and abs(escalated_frac - expected_frac)
+                     <= CASCADE_ESCALATION_TOL * expected_frac)
+    t1_regression_ok = (baseline_p50_ms is not None and baseline_p50_ms > 0
+                        and tier1_p50_ms is not None
+                        and float(tier1_p50_ms) <= float(baseline_p50_ms)
+                        * (1.0 + CASCADE_MAX_T1_P50_REGRESSION))
+    ok = (requests_total > 0 and errors_total == 0
+          and degraded_total == 0
+          and int(answered_tier2) == int(escalated_total)
+          and escalation_ok and t1_regression_ok)
+    return {
+        "metric": "cascade_escalated_frac",
+        "value": (None if escalated_frac is None
+                  else round(escalated_frac, 4)),
+        "unit": "frac",
+        "backend": backend,
+        "device_kind": device_kind,
+        "band": [round(float(band[0]), 6), round(float(band[1]), 6)],
+        "expected_frac": (None if expected_frac is None
+                          else round(float(expected_frac), 4)),
+        "escalated_frac": (None if escalated_frac is None
+                           else round(escalated_frac, 4)),
+        "escalation_tol": CASCADE_ESCALATION_TOL,
+        "escalation_ok": escalation_ok,
+        "escalated_total": int(escalated_total),
+        "answered_tier2": int(answered_tier2),
+        "degraded_total": int(degraded_total),
+        "requests_total": int(requests_total),
+        "tier1_p50_ms": (None if tier1_p50_ms is None
+                         else round(float(tier1_p50_ms), 3)),
+        "baseline_p50_ms": (None if baseline_p50_ms is None
+                            else round(float(baseline_p50_ms), 3)),
+        "max_t1_p50_regression": CASCADE_MAX_T1_P50_REGRESSION,
+        "t1_regression_ok": t1_regression_ok,
+        "tier2_p50_ms": (None if tier2_p50_ms is None
+                         else round(float(tier2_p50_ms), 3)),
+        "tier2_p99_ms": (None if tier2_p99_ms is None
+                         else round(float(tier2_p99_ms), 3)),
+        "errors_total": int(errors_total),
+        "notes": notes or {},
         "ok": ok,
         **_provenance_fields(),
     }

@@ -126,7 +126,7 @@ def _chaos_covered_points(repo_root: Path) -> set[str]:
             continue
         # fault specs carry schedules ("step.hang@1", "joern.hang:p=.5"),
         # so the point name may be followed by @ or : rather than the quote
-        for m in re.finditer(r'["\']([a-z_]+\.[a-z_]+)(?=[@:"\'])', text):
+        for m in re.finditer(r'["\']([a-z0-9_]+\.[a-z0-9_]+)(?=[@:"\'])', text):
             covered.add(m.group(1))
     return covered
 

@@ -395,6 +395,45 @@ class AutoscaleConfig:
 
 
 @dataclass(frozen=True)
+class CascadeConfig:
+    """Two-tier scoring cascade knobs (``serve/cascade.py``; CLI: ``--set
+    serve.cascade.*``): tier 1 (the GGNN engine) answers every request;
+    scores inside ``[band_lo, band_hi]`` escalate to a second bounded
+    micro-batch queue feeding the joint LLM+GNN ``JointEngine``. Tier-2
+    failure (queue full, deadline blown, engine error) degrades to the
+    tier-1 answer with ``tier2_degraded: true`` — it may never fail a
+    request tier 1 already answered (standing invariant 24)."""
+
+    enabled: bool = False
+    # borderline band: tier-1 scores inside [band_lo, band_hi] escalate
+    band_lo: float = 0.35
+    band_hi: float = 0.65
+    # tier-2 micro-batch queue: its own batch cap, batching window, and
+    # bounded depth (beyond max_queue the escalation degrades, not 503s)
+    tier2_max_batch: int = 4
+    tier2_max_wait_ms: float = 10.0
+    tier2_max_queue: int = 64
+    # per-request tier-2 wait budget: escalate -> answer; blown deadline
+    # serves the tier-1 score with tier2_degraded: true
+    tier2_deadline_ms: float = 2000.0
+    # train_joint.py run dir holding epoch_N fusion checkpoints; None at
+    # serve build time means a hermetic tiny-LLM tier 2 (tests/smoke)
+    joint_dir: str | None = None
+
+    def __post_init__(self):
+        if not 0.0 <= self.band_lo < self.band_hi <= 1.0:
+            raise ValueError("need 0 <= band_lo < band_hi <= 1")
+        if self.tier2_max_batch < 1:
+            raise ValueError("tier2_max_batch must be >= 1")
+        if self.tier2_max_wait_ms < 0:
+            raise ValueError("tier2_max_wait_ms must be >= 0")
+        if self.tier2_max_queue < 1:
+            raise ValueError("tier2_max_queue must be >= 1")
+        if self.tier2_deadline_ms <= 0:
+            raise ValueError("tier2_deadline_ms must be > 0")
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online scoring service knobs (``deepdfa_tpu/serve``; CLI:
     ``--set serve.*``): the micro-batching window, admission control, the
@@ -437,6 +476,8 @@ class ServeConfig:
     obs: ObsConfig = field(default_factory=ObsConfig)
     # fleet autoscaler (serve/autoscaler.py): SLO-driven scale decisions
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    # two-tier GGNN -> joint-LLM scoring cascade (serve/cascade.py)
+    cascade: CascadeConfig = field(default_factory=CascadeConfig)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -532,6 +573,7 @@ _NESTED: dict[tuple[str, str], type] = {
     ("ExperimentConfig", "serve"): ServeConfig,
     ("ServeConfig", "obs"): ObsConfig,
     ("ServeConfig", "autoscale"): AutoscaleConfig,
+    ("ServeConfig", "cascade"): CascadeConfig,
 }
 
 

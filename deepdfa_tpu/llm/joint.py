@@ -53,9 +53,40 @@ __all__ = [
     "weight_decay_mask",
     "cosine_warmup_schedule",
     "eval_points",
+    "best_threshold_sweep",
     "make_joint_steps",
     "JointTrainer",
 ]
+
+
+def best_threshold_sweep(
+    probs: np.ndarray,
+    labels: np.ndarray,
+    *,
+    macro: bool = True,
+    grid: Iterable[float] | None = None,
+) -> tuple[float, float]:
+    """MSIVD's eval-time threshold selection: sweep ``grid`` (default
+    0.01..0.99 in 0.01 steps) over F1 of the positive-probability vector
+    and return ``(best_threshold, best_f1)``.
+
+    Deterministic by construction: the grid is fixed, the comparison is
+    strict, so ties keep the EARLIEST (lowest) threshold — the selected
+    value is a pure function of ``(probs, labels, grid)``, which makes the
+    cascade band (``serve.cascade.band_lo/hi``, usually straddling this
+    threshold) reproducible across re-evaluations of the same checkpoint."""
+    probs = np.asarray(probs, np.float64)
+    labels = np.asarray(labels)
+    ts = (np.round(np.arange(1, 100) / 100.0, 2) if grid is None
+          else np.asarray(list(grid), np.float64))
+    key = "f1_macro" if macro else "f1_weighted"
+    best_t, best_f = float(ts[0]), -1.0
+    for t in ts:
+        f1 = classification_report(
+            probs, labels, macro=macro, threshold=float(t))[key]
+        if f1 > best_f:
+            best_t, best_f = float(t), float(f1)
+    return best_t, best_f
 
 
 @dataclasses.dataclass(frozen=True)

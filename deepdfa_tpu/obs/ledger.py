@@ -97,6 +97,16 @@ EXPLICIT_SERIES: dict[tuple[str, str], bool] = {
     ("extraction", "functions_per_sec"): False,
     ("extraction", "cache_hit_rate"): False,
     ("extraction", "quarantined"): True,
+    # the cascade bench block (scripts/bench_serving.py --cascade):
+    # tier-2 tail latency and the invariant-24 degraded counter go down
+    # (any nonzero degraded under nominal load is a regression);
+    # "escalated_frac" is a band-mass CONFORMANCE metric — drifting UP
+    # means the band leaks confident traffic to the expensive tier, so
+    # lower is the safe gate direction (the ±tolerance gate in
+    # bench.assemble_cascade_result owns the two-sided check).
+    ("cascade", "tier2_p99_ms"): True,
+    ("cascade", "degraded_total"): True,
+    ("cascade", "escalated_frac"): True,
 }
 
 

@@ -279,13 +279,21 @@ class SLOEngine:
 
 
 def serve_specs(*, availability: float = 0.99, error_rate: float = 0.95,
-                p99_ms: float = 2000.0) -> tuple[SLOSpec, ...]:
+                p99_ms: float = 2000.0, tier2_p99_ms: float | None = None,
+                tier2_success: float = 0.99) -> tuple[SLOSpec, ...]:
     """Serve-side objectives. ``availability`` budgets 5xx only (the
     server's own failures); ``error_rate`` budgets every non-2xx (client
     junk included — a looser floor that catches abusive traffic shifts);
     ``score_drift`` turns the PR 8 PSI alert gauge into a page + promotion
-    veto the moment any model_rev's window drifts."""
-    return (
+    veto the moment any model_rev's window drifts.
+
+    With the cascade enabled, pass ``tier2_p99_ms`` (its own deadline
+    budget — tier 2 is allowed to be slower than tier 1, but not slower
+    than the budget the degradation contract waits out) to add the
+    per-tier objectives: a tier-2 latency ceiling and a tier-2 success
+    ratio (degraded / escalated — degradations are correct behaviour per
+    request, invariant 24, but a *rate* of them is an incident)."""
+    specs = (
         SLOSpec("availability", "ratio", availability,
                 bad="responses_5xx_total", total="responses_total"),
         SLOSpec("error_rate", "ratio", error_rate,
@@ -293,6 +301,15 @@ def serve_specs(*, availability: float = 0.99, error_rate: float = 0.95,
         SLOSpec("latency_p99", "max", p99_ms, value="latency_p99_ms"),
         SLOSpec("score_drift", "max", 0.0, value="drift_alerting"),
     )
+    if tier2_p99_ms is not None:
+        specs += (
+            SLOSpec("tier2_latency_p99", "max", tier2_p99_ms,
+                    value="tier2_latency_p99_ms"),
+            SLOSpec("tier2_success", "ratio", tier2_success,
+                    bad="cascade_degraded_total",
+                    total="cascade_escalated_total"),
+        )
+    return specs
 
 
 def router_specs(*, availability: float = 0.99,

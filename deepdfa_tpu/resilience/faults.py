@@ -70,6 +70,8 @@ KNOWN_POINTS = (
     "autoscale.replica_crash",
     "extract.worker_crash",
     "extract.cache_corrupt",
+    "cascade.tier2_timeout",
+    "cascade.escalation_drop",
 )
 
 # One line per point; keys must equal KNOWN_POINTS (the analysis faults
@@ -126,6 +128,14 @@ POINT_DOCS = {
     "extract.cache_corrupt": (
         "corrupt one extraction-cache payload at read — the entry must "
         "read as a MISS, never a decode crash (data/extract_cache.py)"),
+    "cascade.tier2_timeout": (
+        "blow one tier-2 batch's deadline inside the cascade dispatcher — "
+        "the requests keep their tier-1 answers with tier2_degraded: true "
+        "(serve/cascade.py)"),
+    "cascade.escalation_drop": (
+        "drop one borderline escalation at enqueue — the request keeps its "
+        "tier-1 answer with tier2_degraded: true, never a 5xx "
+        "(serve/cascade.py)"),
 }
 
 

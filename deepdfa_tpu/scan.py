@@ -91,11 +91,46 @@ def _score_functions(engine, rows: list[dict], graphs: list) -> None:
                 row["vulnerable_probability"] = round(float(p), 6)
 
 
+def _cascade_rescore(tier2, band, rows: list[dict], graphs: list,
+                     source_by_file: dict[str, str]) -> None:
+    """Offline mirror of the serving cascade (``serve/cascade.py``): every
+    scored row records the answering ``tier`` and its ``tier1_score``;
+    rows inside the borderline band rescore through the tier-2 joint
+    engine, fed the owning file's source text (the LLM branch input).
+    A tier-2 failure keeps the tier-1 score (invariant 24) and marks the
+    borderline rows ``tier2_degraded`` — the scan never aborts on it."""
+    lo, hi = band
+    scored = [(row, g) for row, g in zip(rows, graphs)
+              if "vulnerable_probability" in row]
+    for row, _ in scored:
+        row["tier"] = 1
+        row["tier1_score"] = row["vulnerable_probability"]
+    borderline = [(row, g) for row, g in scored
+                  if lo <= row["vulnerable_probability"] <= hi]
+    if not borderline:
+        return
+    items = [(source_by_file.get(row["file"], ""), g)
+             for row, g in borderline]
+    try:
+        probs = tier2.score(items)
+    except Exception as exc:  # noqa: BLE001 — degrade, never abort the scan
+        logger.warning("scan cascade: tier-2 rescore failed (%s: %s) — "
+                       "keeping tier-1 scores", type(exc).__name__, exc)
+        for row, _ in borderline:
+            row["tier2_degraded"] = True
+        return
+    for (row, _), p in zip(borderline, probs):
+        row["tier"] = 2
+        row["vulnerable_probability"] = round(float(p), 6)
+
+
 def scan_paths(
     paths: Sequence[str | Path],
     vocabs,
     *,
     engine=None,
+    tier2=None,
+    tier2_band: tuple[float, float] = (0.35, 0.65),
     n_workers: int = 4,
     cache_dir: str | Path | None = None,
     attempts_per_item: int = 2,
@@ -143,6 +178,9 @@ def scan_paths(
             rows.append(row)
     if engine is not None and score_graphs:
         _score_functions(engine, score_rows, score_graphs)
+        if tier2 is not None:
+            _cascade_rescore(tier2, tier2_band, score_rows, score_graphs,
+                             dict(sources))
 
     n_err = sum(1 for r in rows if "error" in r)
     report = {
@@ -155,6 +193,13 @@ def scan_paths(
         "pool": pool.report(),
         "cache": cache.stats() if cache is not None else None,
     }
+    if tier2 is not None:
+        report["cascade"] = {
+            "band": [float(tier2_band[0]), float(tier2_band[1])],
+            "n_tier2": sum(1 for r in rows if r.get("tier") == 2),
+            "n_degraded": sum(1 for r in rows if r.get("tier2_degraded")),
+            "tier2_model_rev": getattr(tier2, "model_rev", "unknown"),
+        }
     logger.info(
         "scan: %d file(s) → %d function(s), %d scored, %d error row(s) "
         "in %.2fs (cache %s)", report["n_files"], report["n_functions"],
@@ -166,13 +211,26 @@ def scan_paths(
 
 def scan_command(cfg, run_dir: Path, targets: Sequence[str], *,
                  ckpt_dir: Path | None = None, artifact: str | None = None,
-                 workers: int = 4, cache_dir: Path | None = None) -> dict:
+                 workers: int = 4, cache_dir: Path | None = None,
+                 cascade: bool = False) -> dict:
     """The CLI entry: resolve vocabs from the config's shard dir, build a
     scoring engine when a checkpoint/artifact is given (scan still runs
     encode-only without one), write ``scan.json`` atomically."""
     from deepdfa_tpu import utils
     from deepdfa_tpu.pipeline import load_vocabs
     from deepdfa_tpu.resilience.journal import atomic_write_text
+
+    ccfg = cfg.serve.cascade
+    if cascade:
+        # fail fast, before shard/vocab resolution touches the filesystem
+        if artifact is None and ckpt_dir is None:
+            raise ValueError(
+                "scan --cascade needs tier-1 scores: pass --ckpt-dir or "
+                "--artifact")
+        if ccfg.joint_dir is None:
+            raise ValueError(
+                "scan --cascade needs a tier-2 checkpoint: set "
+                "serve.cascade.joint_dir (a train_joint.py run dir)")
 
     sample_text = "_sample" if cfg.data.sample else ""
     shard_dir = utils.processed_dir() / cfg.data.dsname / f"shards{sample_text}"
@@ -190,8 +248,16 @@ def scan_command(cfg, run_dir: Path, targets: Sequence[str], *,
     else:
         logger.info("scan: no --ckpt-dir/--artifact — encoding without scores")
 
+    tier2 = None
+    if cascade:
+        from deepdfa_tpu.llm.joint_engine import JointEngine
+
+        tier2 = JointEngine.from_run_dir(
+            ccfg.joint_dir, max_batch=ccfg.tier2_max_batch)
+
     report = scan_paths(
-        targets, vocabs, engine=engine, n_workers=workers,
+        targets, vocabs, engine=engine, tier2=tier2,
+        tier2_band=(ccfg.band_lo, ccfg.band_hi), n_workers=workers,
         cache_dir=cache_dir if cache_dir is not None
         else run_dir / "extract_cache")
     atomic_write_text(run_dir / "scan.json", json.dumps(report, indent=2))

@@ -90,6 +90,18 @@ class ServeMetrics:
         # records both in its notes block)
         self.queue_wait = LatencyReservoir(latency_window)
         self.dispatch = LatencyReservoir(latency_window)
+        # cascade (serve/cascade.py): escalation counters + per-tier latency
+        # reservoirs. answered counts key on the tier that produced the
+        # served score; degraded = tier-2 failures converted to tier-1
+        # answers (invariant 24 — they are NOT errors)
+        self.cascade_escalated_total = 0
+        self.cascade_degraded_total = 0
+        self.cascade_answered: dict[int, int] = {}
+        self.tier2_queue_depth = 0
+        self.tier1_latency = LatencyReservoir(latency_window)
+        self.tier2_latency = LatencyReservoir(latency_window)
+        self.tier2_queue_wait = LatencyReservoir(latency_window)
+        self.tier2_dispatch = LatencyReservoir(latency_window)
         self.warmup: dict | None = None  # last engine warmup report
         # attachment points set by the server: the request tracer and the
         # score-drift sentinel both render through /metrics when present;
@@ -118,6 +130,11 @@ class ServeMetrics:
             if code >= 400:
                 self.errors_total += 1
         self.latency.observe(latency_ms)
+
+    def observe_answered(self, tier: int) -> None:
+        """One served /score row attributed to the tier that scored it."""
+        with self._lock:
+            self.cascade_answered[tier] = self.cascade_answered.get(tier, 0) + 1
 
     def observe_batch(self, n_real: int, capacity: int) -> None:
         with self._lock:
@@ -164,6 +181,10 @@ class ServeMetrics:
                 "queue_depth": self.queue_depth,
                 "inflight": self.inflight,
                 "warmup": dict(self.warmup) if self.warmup else None,
+                "cascade_escalated_total": self.cascade_escalated_total,
+                "cascade_degraded_total": self.cascade_degraded_total,
+                "cascade_answered": dict(self.cascade_answered),
+                "tier2_queue_depth": self.tier2_queue_depth,
             }
         snap["padding_efficiency"] = self.padding_efficiency()
         snap["mean_batch_occupancy"] = (
@@ -175,6 +196,12 @@ class ServeMetrics:
         snap["queue_wait_p99_ms"] = self.queue_wait.quantile(0.99)
         snap["dispatch_p50_ms"] = self.dispatch.quantile(0.50)
         snap["dispatch_p99_ms"] = self.dispatch.quantile(0.99)
+        snap["tier1_latency_p50_ms"] = self.tier1_latency.quantile(0.50)
+        snap["tier1_latency_p99_ms"] = self.tier1_latency.quantile(0.99)
+        snap["tier2_latency_p50_ms"] = self.tier2_latency.quantile(0.50)
+        snap["tier2_latency_p99_ms"] = self.tier2_latency.quantile(0.99)
+        snap["tier2_queue_wait_p99_ms"] = self.tier2_queue_wait.quantile(0.99)
+        snap["tier2_dispatch_p99_ms"] = self.tier2_dispatch.quantile(0.99)
         return snap
 
     def render(self, cache_stats: dict | None = None) -> str:
@@ -217,12 +244,36 @@ class ServeMetrics:
             for bucket, axes in snap["padding_efficiency"].items():
                 for axis, value in axes.items():
                     pad.set(value, bucket=bucket, axis=axis)
+        reg.counter("cascade_escalated_total",
+                    "Borderline tier-1 scores escalated to tier 2").set(
+            snap["cascade_escalated_total"])
+        reg.counter("cascade_degraded_total",
+                    "Escalations degraded back to the tier-1 answer "
+                    "(queue full / deadline blown / tier-2 failure — "
+                    "invariant 24, never a 5xx)").set(
+            snap["cascade_degraded_total"])
+        answered = reg.counter("cascade_answered_total",
+                               "Served /score rows by answering tier",
+                               labels=("tier",))
+        for tier, n in snap["cascade_answered"].items():
+            answered.set(n, tier=tier)
+        reg.gauge("tier2_queue_depth",
+                  "Escalations waiting in the tier-2 queue").set(
+            snap["tier2_queue_depth"])
         for family, help_, reservoir in (
                 ("latency_ms", "End-to-end /score latency", self.latency),
                 ("queue_wait_ms", "Time a graph waited in the micro-batch "
                                   "queue", self.queue_wait),
                 ("dispatch_ms", "Engine dispatch wall time per batch",
-                 self.dispatch)):
+                 self.dispatch),
+                ("tier1_latency_ms", "Tier-1 (GGNN) per-row score latency",
+                 self.tier1_latency),
+                ("tier2_latency_ms", "Tier-2 escalate-to-answer latency",
+                 self.tier2_latency),
+                ("tier2_queue_wait_ms", "Time an escalation waited in the "
+                                        "tier-2 queue", self.tier2_queue_wait),
+                ("tier2_dispatch_ms", "Joint-engine dispatch wall time per "
+                                      "tier-2 window", self.tier2_dispatch)):
             fam = reg.gauge(family, f"{help_} (windowed quantiles)",
                             labels=("quantile",))
             for q in (0.50, 0.99):

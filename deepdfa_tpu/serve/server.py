@@ -71,7 +71,7 @@ class ScoreServer:
                  cfg: ServeConfig | None = None, cache: ScanCache | None = None,
                  metrics: ServeMetrics | None = None,
                  replica_id: str | None = None, warm_store=None,
-                 journal=None):
+                 journal=None, tier2_engine=None):
         self.cfg = cfg or ServeConfig()
         self.engine = engine
         self.vocabs = vocabs
@@ -97,10 +97,16 @@ class ScoreServer:
         self.flight = FlightRecorder(
             capacity=obs.flight_events, proc="serve",
             dump_dir=obs.flight_dir)
+        cascade_cfg = self.cfg.cascade
         self.slo = SLOEngine(
             serve_specs(availability=obs.slo_availability,
                         error_rate=obs.slo_error_rate,
-                        p99_ms=obs.slo_p99_ms),
+                        p99_ms=obs.slo_p99_ms,
+                        # tier 2 gets its own deadline budget as the SLO
+                        # ceiling: sustained waits at the degradation
+                        # boundary are an incident before degradations are
+                        tier2_p99_ms=(cascade_cfg.tier2_deadline_ms
+                                      if cascade_cfg.enabled else None)),
             fast_window_s=obs.slo_fast_window_s,
             slow_window_s=obs.slo_slow_window_s,
             burn_threshold=obs.slo_burn_threshold,
@@ -115,6 +121,26 @@ class ScoreServer:
             engine, max_batch=self.cfg.max_batch,
             max_wait_ms=self.cfg.max_wait_ms, max_queue=self.cfg.max_queue,
             metrics=self.metrics, tracer=self.tracer).start()
+        # tier-2 escalation plane (serve/cascade.py): band routing over a
+        # second bounded queue feeding the joint LLM+GNN engine
+        self.cascade = None
+        if cascade_cfg.enabled:
+            if tier2_engine is None:
+                if not cascade_cfg.joint_dir:
+                    raise ValueError(
+                        "serve.cascade.enabled needs a tier-2 engine: pass "
+                        "tier2_engine= or set serve.cascade.joint_dir to a "
+                        "train_joint.py run dir")
+                from deepdfa_tpu.llm.joint_engine import JointEngine
+
+                tier2_engine = JointEngine.from_run_dir(
+                    cascade_cfg.joint_dir,
+                    max_batch=cascade_cfg.tier2_max_batch)
+            from .cascade import CascadeRouter
+
+            self.cascade = CascadeRouter(
+                cascade_cfg, tier2_engine,
+                metrics=self.metrics, tracer=self.tracer).start()
         self._draining = threading.Event()
         self._stop_requested = threading.Event()
         self._stopped = threading.Event()
@@ -181,6 +207,8 @@ class ScoreServer:
         self._draining.set()
         self._stop_requested.set()
         self.batcher.stop(drain=drain, timeout=self.cfg.drain_timeout_s)
+        if self.cascade is not None:
+            self.cascade.stop(drain=drain, timeout=self.cfg.drain_timeout_s)
         deadline = time.monotonic() + self.cfg.drain_timeout_s
         while drain and self.metrics.inflight > 0:
             if time.monotonic() >= deadline:
@@ -214,6 +242,10 @@ class ScoreServer:
             "responses_error_total": errors,
             "latency_p99_ms": snap.get("latency_p99_ms"),
             "drift_alerting": drift_alerting,
+            # cascade keys — read by the tier-2 specs when enabled
+            "tier2_latency_p99_ms": snap.get("tier2_latency_p99_ms"),
+            "cascade_escalated_total": snap.get("cascade_escalated_total"),
+            "cascade_degraded_total": snap.get("cascade_degraded_total"),
         }
 
     def render_slo(self) -> str:
@@ -286,10 +318,12 @@ class ScoreServer:
 
         rows: list[dict] = []
         futures: list = []
+        graphs: list = []  # aligned with rows; the tier-2 escalation payload
         for enc in encoded:
             if enc.graph is None:
                 rows.append({"function": enc.name, "error": enc.error})
                 futures.append(None)
+                graphs.append(None)
                 continue
             try:
                 futures.append(self.batcher.submit(enc.graph))
@@ -301,9 +335,16 @@ class ScoreServer:
             except RuntimeError as exc:  # draining race
                 return 503, {"error": str(exc)}
             rows.append({"function": enc.name})
+            graphs.append(enc.graph)
 
-        deadline = time.monotonic() + REQUEST_TIMEOUT_S
-        for row, fut in zip(rows, futures):
+        cascade = self.cascade
+        tier1_rev = getattr(self.engine, "model_rev", None) or "unknown"
+        t_req = time.monotonic()
+        deadline = t_req + REQUEST_TIMEOUT_S
+        # (row, tier-2 future, escalation time) — submitted as each tier-1
+        # score lands, awaited together after the loop so escalations batch
+        pending_t2: list[tuple[dict, object, float]] = []
+        for row, fut, graph in zip(rows, futures, graphs):
             if fut is None:
                 continue
             try:
@@ -319,11 +360,59 @@ class ScoreServer:
                 self.flight.dump("engine_error")
                 return 500, {"error": f"{type(exc).__name__}: {exc}"}
             row["vulnerable_probability"] = round(prob, 6)
-            self.drift.observe(
-                prob, getattr(self.engine, "model_rev", None) or "unknown")
+            if cascade is None:
+                self.drift.observe(prob, tier1_rev)
+                continue
+            # cascade path: per-(model_rev, tier) drift keying + tier
+            # attribution on every row; borderline scores escalate
+            self.metrics.tier1_latency.observe(
+                (time.monotonic() - t_req) * 1e3)
+            self.drift.observe(prob, f"{tier1_rev}@t1")
+            row["tier"] = 1
+            row["tier1_score"] = round(prob, 6)
+            if not cascade.in_band(prob):
+                continue
+            self.metrics.inc("cascade_escalated_total")
+            with self._span("cascade.escalate", score=round(prob, 6),
+                            band_lo=cascade.cfg.band_lo,
+                            band_hi=cascade.cfg.band_hi):
+                try:
+                    fut2 = cascade.escalate(source, graph)
+                except Exception as exc:  # noqa: BLE001 — invariant 24:
+                    # enqueue failure (queue full, injected drop, draining)
+                    # degrades to the tier-1 answer, never fails the request
+                    self._cascade_degrade(row, exc)
+                else:
+                    pending_t2.append((row, fut2, time.monotonic()))
+
+        for row, fut2, t_esc in pending_t2:
+            remain = cascade.deadline_s - (time.monotonic() - t_esc)
+            try:
+                prob2 = fut2.result(timeout=max(0.0, remain))
+            except Exception as exc:  # noqa: BLE001 — invariant 24: blown
+                # deadline / tier-2 engine failure keeps the tier-1 answer
+                self._cascade_degrade(row, exc)
+                continue
+            self.metrics.tier2_latency.observe(
+                (time.monotonic() - t_esc) * 1e3)
+            row["tier"] = 2
+            row["vulnerable_probability"] = round(prob2, 6)
+            self.drift.observe(prob2, f"{cascade.model_rev}@t2")
+        if cascade is not None:
+            for row, fut in zip(rows, futures):
+                if fut is not None:
+                    self.metrics.observe_answered(row["tier"])
 
         self.cache.store(key, results=rows)
         return 200, {"results": rows, "cached": False}
+
+    def _cascade_degrade(self, row: dict, exc: Exception) -> None:
+        """Invariant 24: tier-2 failure keeps the tier-1 answer. The row is
+        marked, the degradation counted and journaled — never a 5xx."""
+        self.metrics.inc("cascade_degraded_total")
+        row["tier2_degraded"] = True
+        self.flight.record("cascade.degraded", function=row.get("function"),
+                           reason=f"{type(exc).__name__}: {exc}")
 
 
 def _make_handler(server: ScoreServer):
@@ -362,7 +451,11 @@ def _make_handler(server: ScoreServer):
                             "model_rev": eng.model_rev,
                             "precision": eng.precision,
                             "n_replicas": eng.n_replicas,
-                            "label_style": eng.label_style})
+                            "label_style": eng.label_style,
+                            "cascade": server.cascade is not None,
+                            "tier2_model_rev": (
+                                server.cascade.model_rev
+                                if server.cascade is not None else None)})
             elif self.path == "/metrics":
                 self._send(200, server.metrics.render(server.cache.stats()),
                            content_type="text/plain; version=0.0.4")
@@ -468,6 +561,10 @@ def serve_command(cfg: ExperimentConfig, run_dir: Path | None = None,
         "label_style": server.engine.label_style,
         "vocab_hash": server.engine.vocab_hash,
         "model_rev": server.engine.model_rev,
+        "cascade": ({"band": [cfg.serve.cascade.band_lo,
+                              cfg.serve.cascade.band_hi],
+                     "tier2_model_rev": server.cascade.model_rev}
+                    if server.cascade is not None else None),
     }), flush=True)
     summary = server.wait()
     print(json.dumps({"status": "drained", **{

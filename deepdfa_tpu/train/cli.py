@@ -1249,6 +1249,11 @@ def main(argv: Sequence[str] | None = None) -> dict:
     parser.add_argument("--ledger-dir", action="append", default=[],
                         help="bench ledger: artifact file or directory to "
                         "ingest (repeatable; default: CWD)")
+    parser.add_argument("--cascade", action="store_true",
+                        help="scan: rescore borderline-band functions "
+                        "through the tier-2 joint engine (needs "
+                        "serve.cascade.joint_dir); rows record the "
+                        "answering tier and the tier-1 score")
     parser.add_argument("--saliency", choices=("occlusion", "gate"),
                         default="occlusion",
                         help="predict statement ranking: occlusion = per-"
@@ -1351,7 +1356,8 @@ def main(argv: Sequence[str] | None = None) -> dict:
                 cfg, run_dir, targets,
                 ckpt_dir=Path(args.ckpt_dir) if args.ckpt_dir else None,
                 artifact=args.artifact, workers=args.workers,
-                cache_dir=Path(args.cache_dir) if args.cache_dir else None)
+                cache_dir=Path(args.cache_dir) if args.cache_dir else None,
+                cascade=args.cascade)
         return analyze(cfg, run_dir)
     except Exception:
         # crash marker parity: rename log to .log.error (main_cli.py:324-336).

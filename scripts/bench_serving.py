@@ -52,6 +52,17 @@ artifact gains an ``autoscale`` block (``bench.assemble_autoscale_result``)
 gated on the chaos criteria: replacement within the deadline with zero
 join compiles, SLO burn minutes within budget, zero client-visible
 errors beyond the failover window, and every scale decision recorded.
+
+``--cascade`` runs the two-tier escalation stage: a no-cascade baseline
+phase doubles as the tier-1 score oracle (the engine is deterministic),
+the borderline band is placed at the observed scores' 30th/70th
+percentiles — so the expected escalation fraction is the band's exact
+measured mass — and the identical load replays against a cascade-enabled
+server backed by a hermetic tier-2 joint engine. The artifact gains a
+``cascade`` block (``bench.assemble_cascade_result``) gated on: measured
+escalation fraction within ±20% of expected, ZERO degraded answers under
+nominal load, and tier-1 p50 (requests that never escalated) within 10%
+of the baseline phase.
 """
 
 from __future__ import annotations
@@ -120,7 +131,8 @@ def _build_ckpt(cfg, vocabs):
 
 def _make_server(ckpt, vocabs, max_batch: int, max_wait_ms: float,
                  warm_store=None, journal=None, replica_id=None,
-                 latency_window=None, obs=None):
+                 latency_window=None, obs=None, cascade=None,
+                 tier2_engine=None):
     """One ScoreServer replica over a FRESH engine from the shared
     checkpoint (each replica pays — or warm-loads — its own ladder)."""
     from deepdfa_tpu.config import ServeConfig
@@ -135,10 +147,13 @@ def _make_server(ckpt, vocabs, max_batch: int, max_wait_ms: float,
         extra["latency_window"] = latency_window
     if obs is not None:
         extra["obs"] = obs
+    if cascade is not None:
+        extra["cascade"] = cascade
     serve_cfg = ServeConfig(port=0, max_batch=max_batch,
                             max_wait_ms=max_wait_ms, **extra)
     return ScoreServer(engine, vocabs, serve_cfg, replica_id=replica_id,
-                       warm_store=warm_store, journal=journal)
+                       warm_store=warm_store, journal=journal,
+                       tier2_engine=tier2_engine)
 
 
 def _build_fixture(max_batch: int, max_wait_ms: float, corpus_n: int):
@@ -293,6 +308,194 @@ def _run_phase(port: int, bodies: list[str], concurrency: int):
     for t in threads:
         t.join()
     return time.perf_counter() - t0, errors["n"]
+
+
+def _run_phase_collect(port: int, bodies: list[str], concurrency: int):
+    """Closed loop like :func:`_run_phase`, but parses every ``/score``
+    response and records per-request client-side latency. Returns
+    ``(elapsed_s, errors, results)`` where ``results`` is a list of
+    ``(latency_ms, rows)`` — one entry per answered request."""
+    import http.client
+
+    next_i = {"i": 0}
+    lock = threading.Lock()
+    errors = {"n": 0}
+    results: list[tuple[float, list[dict]]] = []
+
+    def worker():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=180)
+        while True:
+            with lock:
+                i = next_i["i"]
+                if i >= len(bodies):
+                    break
+                next_i["i"] = i + 1
+            try:
+                t0 = time.perf_counter()
+                conn.request("POST", "/score", body=bodies[i],
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                payload = resp.read()
+                lat_ms = (time.perf_counter() - t0) * 1e3
+                if resp.status != 200:
+                    with lock:
+                        errors["n"] += 1
+                    continue
+                rows = json.loads(payload).get("results", [])
+                with lock:
+                    results.append((lat_ms, rows))
+            except Exception:
+                with lock:
+                    errors["n"] += 1
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=180)
+        conn.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, errors["n"], results
+
+
+def _build_tier2(max_batch: int):
+    """Hermetic tier-2 joint engine for the cascade stage: tiny-LLM +
+    HashTokenizer, fresh fusion params, text-only (``use_gnn=False`` keeps
+    the bench independent of the demo corpus's graph feature schema — the
+    routing/latency contract under test does not care which branch the
+    fusion head reads). The REAL ``JointEngine.score`` path: tokenize,
+    pad to ``max_batch``, jitted trainer ``eval_step``."""
+    import jax
+    import numpy as np
+
+    from deepdfa_tpu.config import FeatureConfig, GGNNConfig
+    from deepdfa_tpu.llm.dataset import HashTokenizer
+    from deepdfa_tpu.llm.fusion import FusionModel
+    from deepdfa_tpu.llm.joint import JointConfig
+    from deepdfa_tpu.llm.joint_engine import JointEngine
+    from deepdfa_tpu.llm.llama import LlamaModel, tiny_llama
+
+    jcfg = JointConfig(block_size=128)
+    llm_cfg = tiny_llama(vocab_size=512)
+    tokenizer = HashTokenizer(vocab_size=llm_cfg.vocab_size)
+    llm = LlamaModel(llm_cfg)
+    llm_params = llm.init(
+        jax.random.key(0), np.zeros((2, jcfg.block_size), np.int32)
+    )["params"]
+    fusion = FusionModel(
+        gnn_cfg=GGNNConfig(), input_dim=FeatureConfig().input_dim,
+        llm_hidden_size=llm_cfg.hidden_size, use_gnn=False,
+        dropout_rate=0.1, pool="last")
+    fusion_params = JointEngine._template_params(
+        llm, llm_params, fusion, jcfg, 512, 1024)
+    engine = JointEngine(llm, llm_params, fusion, fusion_params, tokenizer,
+                         jcfg, max_batch=max_batch, max_nodes=512,
+                         max_edges=1024)
+    engine.warmup()
+    return engine
+
+
+def _run_cascade(ckpt, vocabs, bodies, args, backend: str,
+                 device_kind: str) -> dict:
+    """The two-phase cascade stage. Phase A is the no-cascade baseline —
+    it doubles as the tier-1 score ORACLE: the engine is deterministic, so
+    phase A's scores are exactly the tier-1 scores phase B will produce,
+    and placing the band at their 30th/70th percentiles makes the expected
+    escalation fraction the band's measured mass (analytic, not guessed).
+    Phase B replays the identical load with the cascade enabled and gates
+    the measured escalation fraction, zero degradations, and the tier-1
+    p50 (client-side latency of requests no row of which escalated)
+    against phase A's same-instrument p50."""
+    import numpy as np
+
+    from bench import assemble_cascade_result
+
+    from deepdfa_tpu.config import CascadeConfig
+
+    # phase A — baseline + oracle
+    server = _make_server(ckpt, vocabs, args.max_batch, args.max_wait_ms)
+    server.warmup()
+    server.start()
+    try:
+        _, err_a, res_a = _run_phase_collect(
+            server.port, bodies, args.concurrency)
+    finally:
+        server.shutdown()
+    scores = [r["vulnerable_probability"] for _, rows in res_a for r in rows
+              if "vulnerable_probability" in r]
+    baseline_p50 = (float(np.percentile([lat for lat, _ in res_a], 50))
+                    if res_a else None)
+    # the band edges land ON score mass points (they are quantiles of the
+    # observed scores); widen by 1e-6 — past the rows' round(prob, 6)
+    # radius — so a boundary score cannot flip membership between the
+    # oracle (rounded rows) and phase B's in_band check (unrounded probs)
+    lo = float(np.quantile(scores, 0.30)) - 1e-6
+    hi = float(np.quantile(scores, 0.70)) + 1e-6
+    lo = min(max(lo, 0.0), 1.0 - 1e-6)
+    hi = min(max(hi, lo + 1e-6), 1.0)
+    expected = float(np.mean([lo <= s <= hi for s in scores]))
+
+    # phase B — same load, cascade on, band at the measured quantiles.
+    # Nominal run: the deadline/queue bounds are generous on purpose —
+    # the gate asserts ZERO degradations, so the bounds must not be the
+    # thing that trips (test_cascade.py owns the degradation paths).
+    tier2 = _build_tier2(args.max_batch)
+    ccfg = CascadeConfig(
+        enabled=True, band_lo=lo, band_hi=hi,
+        tier2_max_batch=args.max_batch, tier2_max_wait_ms=args.max_wait_ms,
+        tier2_max_queue=max(256, 4 * args.requests),
+        tier2_deadline_ms=120_000.0)
+    server = _make_server(ckpt, vocabs, args.max_batch, args.max_wait_ms,
+                          cascade=ccfg, tier2_engine=tier2)
+    server.warmup()
+    server.start()
+    try:
+        _, err_b, res_b = _run_phase_collect(
+            server.port, bodies, args.concurrency)
+    finally:
+        snap = server.shutdown()
+
+    # count tiers CLIENT-SIDE from the rows, not from the server snapshot:
+    # the scan cache replays a repeated body's stored rows (tier
+    # attribution preserved) without re-escalating, so the snapshot's
+    # escalated_total is unique-bodies-only while expected_frac is row
+    # mass over the whole load — rows are the commensurate instrument
+    rows_b = [r for _, rows in res_b for r in rows
+              if "vulnerable_probability" in r]
+    escalated_rows = sum(1 for r in rows_b
+                         if r.get("tier") == 2 or r.get("tier2_degraded"))
+    answered2_rows = sum(1 for r in rows_b if r.get("tier") == 2)
+    t1_lats = [lat for lat, rows in res_b
+               if rows and all(r.get("tier") != 2 and not r.get("tier2_degraded")
+                               for r in rows)]
+    answered = snap.get("cascade_answered") or {}
+    return assemble_cascade_result(
+        backend=backend, device_kind=device_kind, band=(lo, hi),
+        expected_frac=expected,
+        escalated_total=escalated_rows,
+        answered_tier2=answered2_rows,
+        degraded_total=snap.get("cascade_degraded_total", 0),
+        requests_total=len(rows_b),
+        tier1_p50_ms=(float(np.percentile(t1_lats, 50)) if t1_lats else None),
+        baseline_p50_ms=baseline_p50,
+        tier2_p50_ms=snap.get("tier2_latency_p50_ms"),
+        tier2_p99_ms=snap.get("tier2_latency_p99_ms"),
+        errors_total=err_a + err_b,
+        notes={
+            "n_scored_baseline": len(scores),
+            "n_tier1_only_requests": len(t1_lats),
+            "snap_escalated_total": snap.get("cascade_escalated_total", 0),
+            "snap_answered_tier2": answered.get(2, 0),
+            "tier2_queue_wait_p99_ms": snap.get("tier2_queue_wait_p99_ms"),
+            "tier2_dispatch_p99_ms": snap.get("tier2_dispatch_p99_ms"),
+            "tier2_model_rev": tier2.model_rev,
+            "tier2_block_size": tier2.cfg.block_size,
+            "tier2_use_gnn": False,
+        })
 
 
 def _run_fleet(ckpt, vocabs, bodies, args, single_cold_rps: float,
@@ -631,6 +834,12 @@ def main(argv=None) -> dict:
                     dest="replace_deadline_s",
                     help="serve.autoscale.replace_deadline_s for the "
                     "--autoscale stage")
+    ap.add_argument("--cascade", action="store_true",
+                    help="run the two-tier cascade stage: a no-cascade "
+                    "baseline phase doubles as the tier-1 score oracle, "
+                    "then the same load replays with the borderline band "
+                    "at the scores' 30th/70th percentiles feeding a "
+                    "hermetic tier-2 joint engine")
     args = ap.parse_args(argv)
     if args.fleet == 1:
         ap.error("--fleet needs N >= 2 (the baseline IS the single replica)")
@@ -681,6 +890,11 @@ def main(argv=None) -> dict:
                                    warm_store_dir=warm_dir, backend=backend,
                                    device_kind=device_kind)
 
+    cascade = None
+    if args.cascade:
+        cascade = _run_cascade(ckpt, vocabs, bodies, args, backend=backend,
+                               device_kind=device_kind)
+
     tiers = tier_precision = tier_refusal = None
     if args.tier_requests > 0:
         tiers, tier_precision, tier_refusal = _precision_tiers(
@@ -703,6 +917,7 @@ def main(argv=None) -> dict:
         concurrency=args.concurrency,
         fleet=fleet,
         autoscale=autoscale,
+        cascade=cascade,
         notes={
             "cold_requests_per_sec": round(len(bodies) / cold_s, 2),
             "hot_requests_per_sec": round(len(bodies) / hot_s, 2),

@@ -6,6 +6,8 @@ decision loop + kill -9 chaos) + the observability
 suite (``pytest -m obs``: tracing, exposition conformance, drift) + the
 streaming-extraction suite (``pytest -m 'extraction and not slow'``:
 pool exactly-once semantics, cache commit protocol, chaos points) + the
+two-tier cascade suite (``pytest -m 'cascade and not slow'``: band
+routing, tier-2 queue policy, invariant-24 degradation chaos) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
 lock-order, jit-purity/donation, fault-registry, metrics conformance
 static passes) + the perf-regression ledger (``python -m
@@ -95,6 +97,19 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("extraction")
+
+    # the two-tier cascade suite: band routing, tier-2 queue policy, the
+    # invariant-24 degradation contract (chaos points through the real
+    # ScoreServer), tier attribution e2e — fast subset only (the joint
+    # checkpoint restore-parity tests are `slow` and stay in tier-1's
+    # slow lane)
+    print("lint_gate: pytest -m 'cascade and not slow'")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "cascade and not slow",
+         "-q", "tests/test_cascade.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("cascade")
 
     # step 5: the invariant gate — AST passes for atomic-commit,
     # lock-order, jit-purity/donation, fault-registry and metrics

@@ -1,0 +1,706 @@
+"""Two-tier scoring cascade: band routing, tier-2 queue policy, and the
+invariant-24 degradation contract (tier-2 failure may never fail a request
+tier 1 already answered).
+
+Covers the MSIVD serving shape (ROADMAP direction 3): the GGNN
+:class:`~deepdfa_tpu.serve.engine.ScoringEngine` screens every request;
+borderline scores escalate through ``serve/cascade.py`` to the joint
+LLM+GNN :class:`~deepdfa_tpu.llm.joint_engine.JointEngine`. Tier-1 traffic
+runs on the stub-engine idiom of test_serve.py; tier-2 on a recording stub
+with the JointEngine duck type (``score(items)`` + ``model_rev``) — the
+real joint engine's restore→rescore bit-parity is pinned separately at the
+bottom (marked slow: it trains a tiny joint checkpoint first).
+"""
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+pytestmark = pytest.mark.cascade
+
+
+class _StubEngine:
+    """Real ScoringEngine over a stub score_fn (test_serve.py idiom)."""
+
+    def __new__(cls, vocabs=(), max_batch=4, prob=0.5):
+        from deepdfa_tpu.serve import ScoringEngine, serve_buckets
+
+        def score_fn(batch):
+            return np.full(batch.max_graphs, prob, np.float32)
+
+        return ScoringEngine(score_fn, serve_buckets(max_batch),
+                             feat_keys=tuple(vocabs))
+
+
+class _StubTier2:
+    """JointEngine duck type: ``score(items)`` over (text, graph) pairs."""
+
+    def __init__(self, prob=0.9, delay_s=0.0, fail=False):
+        self.prob = prob
+        self.delay_s = delay_s
+        self.fail = fail
+        self.model_rev = "t2-stub"
+        self.calls: list[list[str]] = []
+        self._lock = threading.Lock()
+
+    def score(self, items):
+        if self.fail:
+            raise RuntimeError("tier-2 stub failure")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self._lock:
+            self.calls.append([text for text, _ in items])
+        return np.full(len(items), self.prob, np.float64)
+
+
+@pytest.fixture(scope="module")
+def demo():
+    """(vocabs, sources) from a tiny hermetic corpus — real frontend +
+    real vocabularies, no training (test_serve.py idiom)."""
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    rows = demo_corpus(6, seed=0).to_dict("records")
+    cpgs = {int(r["id"]): add_dependence_edges(parse_source(r["before"]))
+            for r in rows}
+    labels = {int(r["id"]): int(r["vul"]) for r in rows}
+    _, vocabs = CorpusBuilder(FeatureConfig()).build(
+        cpgs, list(cpgs), graph_labels=labels)
+    return vocabs, [r["before"] for r in rows]
+
+
+def _req(port, method, path, body=None, timeout=30):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _post_score(port, source, timeout=30):
+    status, data = _req(port, "POST", "/score",
+                        json.dumps({"source": source}), timeout)
+    return status, json.loads(data)
+
+
+def _cascade_server(demo, *, tier1_prob=0.5, tier2=None, band=(0.4, 0.6),
+                    **cascade_kw):
+    from deepdfa_tpu.config import CascadeConfig, ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, _ = demo
+    ccfg = CascadeConfig(enabled=True, band_lo=band[0], band_hi=band[1],
+                        **cascade_kw)
+    return ScoreServer(
+        _StubEngine(vocabs, prob=tier1_prob), vocabs,
+        ServeConfig(port=0, max_wait_ms=2.0, cascade=ccfg),
+        tier2_engine=tier2 if tier2 is not None else _StubTier2())
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+def test_cascade_config_validation():
+    from deepdfa_tpu.config import CascadeConfig
+
+    with pytest.raises(ValueError, match="band_lo < band_hi"):
+        CascadeConfig(band_lo=0.8, band_hi=0.2)
+    with pytest.raises(ValueError, match="band_lo < band_hi"):
+        CascadeConfig(band_lo=0.5, band_hi=0.5)
+    with pytest.raises(ValueError, match="band_lo < band_hi"):
+        CascadeConfig(band_lo=-0.1, band_hi=0.5)
+    with pytest.raises(ValueError, match="band_lo < band_hi"):
+        CascadeConfig(band_lo=0.5, band_hi=1.1)
+    with pytest.raises(ValueError, match="tier2_max_batch"):
+        CascadeConfig(tier2_max_batch=0)
+    with pytest.raises(ValueError, match="tier2_max_wait_ms"):
+        CascadeConfig(tier2_max_wait_ms=-1.0)
+    with pytest.raises(ValueError, match="tier2_max_queue"):
+        CascadeConfig(tier2_max_queue=0)
+    with pytest.raises(ValueError, match="tier2_deadline_ms"):
+        CascadeConfig(tier2_deadline_ms=0.0)
+
+
+def test_cascade_config_dotted_overrides_and_roundtrip(tmp_path):
+    from deepdfa_tpu.config import CascadeConfig, load_config, to_json
+
+    cfg = load_config(overrides={"serve.cascade.enabled": True,
+                                 "serve.cascade.band_lo": 0.3,
+                                 "serve.cascade.band_hi": 0.7,
+                                 "serve.cascade.tier2_max_batch": 2,
+                                 "serve.cascade.tier2_deadline_ms": 500.0})
+    cc = cfg.serve.cascade
+    assert isinstance(cc, CascadeConfig)
+    assert (cc.enabled, cc.band_lo, cc.band_hi, cc.tier2_max_batch,
+            cc.tier2_deadline_ms) == (True, 0.3, 0.7, 2, 500.0)
+    # JSON round-trip preserves the nested block exactly
+    path = tmp_path / "cfg.json"
+    path.write_text(to_json(cfg))
+    assert load_config(path).serve.cascade == cc
+    # an invalid combination is rejected at construction, not at use
+    with pytest.raises(ValueError, match="band_lo < band_hi"):
+        load_config(overrides={"serve.cascade.band_lo": 0.9,
+                               "serve.cascade.band_hi": 0.1})
+
+
+# ---------------------------------------------------------------------------
+# tier-2 queue policy (unit)
+
+
+def test_router_band_boundaries_inclusive():
+    from deepdfa_tpu.config import CascadeConfig
+    from deepdfa_tpu.serve.cascade import CascadeRouter
+
+    router = CascadeRouter(CascadeConfig(band_lo=0.4, band_hi=0.6),
+                           _StubTier2())
+    assert router.in_band(0.4) and router.in_band(0.6) and router.in_band(0.5)
+    assert not router.in_band(0.39999) and not router.in_band(0.60001)
+    assert router.model_rev == "t2-stub"
+
+
+def test_tier2_batcher_coalesces_and_resolves():
+    from deepdfa_tpu.serve.cascade import Tier2Batcher
+
+    t2 = _StubTier2(prob=0.7)
+    b = Tier2Batcher(t2, max_batch=4, max_wait_ms=20.0, max_queue=8).start()
+    try:
+        futs = [b.submit(f"fn{i}", None) for i in range(3)]
+        assert [f.result(timeout=10) for f in futs] == [0.7] * 3
+        # one window: the size-or-deadline batcher coalesced all three
+        assert len(t2.calls) == 1 and t2.calls[0] == ["fn0", "fn1", "fn2"]
+    finally:
+        b.stop(drain=True, timeout=5)
+
+
+def test_tier2_batcher_queue_full_and_drain_refusal():
+    from deepdfa_tpu.serve.cascade import Tier2Batcher, Tier2QueueFull
+
+    t2 = _StubTier2(delay_s=0.5)
+    b = Tier2Batcher(t2, max_batch=1, max_wait_ms=1.0, max_queue=1).start()
+    try:
+        first = b.submit("fn0", None)
+        # the dispatcher is busy with fn0 for 0.5s; the queue holds one —
+        # the next submits hit capacity
+        deadline = time.monotonic() + 2.0
+        with pytest.raises(Tier2QueueFull, match="capacity"):
+            while time.monotonic() < deadline:
+                b.submit("overflow", None)
+        assert first.result(timeout=10) == 0.9
+    finally:
+        b.stop(drain=True, timeout=10)
+    with pytest.raises(RuntimeError, match="draining"):
+        b.submit("late", None)
+
+
+def test_tier2_batcher_engine_failure_fails_window_only():
+    from deepdfa_tpu.serve.cascade import Tier2Batcher
+
+    t2 = _StubTier2(fail=True)
+    b = Tier2Batcher(t2, max_batch=2, max_wait_ms=1.0, max_queue=8).start()
+    try:
+        fut = b.submit("fn0", None)
+        with pytest.raises(RuntimeError, match="tier-2 stub failure"):
+            fut.result(timeout=10)
+        t2.fail = False  # the dispatcher thread survived the poisoned window
+        assert b.submit("fn1", None).result(timeout=10) == 0.9
+    finally:
+        b.stop(drain=True, timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# server e2e: band routing + tier attribution
+
+
+def test_server_in_band_answers_tier2(demo):
+    _, sources = demo
+    t2 = _StubTier2(prob=0.9)
+    srv = _cascade_server(demo, tier1_prob=0.5, tier2=t2,
+                          band=(0.4, 0.6)).start()
+    try:
+        status, body = _post_score(srv.port, sources[0])
+        assert status == 200
+        row = body["results"][0]
+        assert row["tier"] == 2
+        assert row["tier1_score"] == 0.5
+        assert row["vulnerable_probability"] == 0.9
+        assert "tier2_degraded" not in row
+        assert t2.calls == [[sources[0]]]  # escalation carried the source
+    finally:
+        snap = srv.shutdown()
+    assert snap["cascade_escalated_total"] == 1
+    assert snap["cascade_degraded_total"] == 0
+    assert snap["cascade_answered"] == {2: 1}
+    assert snap["tier2_latency_p99_ms"] is not None
+
+
+def test_server_out_of_band_stays_tier1(demo):
+    _, sources = demo
+    t2 = _StubTier2()
+    srv = _cascade_server(demo, tier1_prob=0.25, tier2=t2,
+                          band=(0.4, 0.6)).start()
+    try:
+        status, body = _post_score(srv.port, sources[0])
+        assert status == 200
+        row = body["results"][0]
+        assert row["tier"] == 1
+        assert row["tier1_score"] == 0.25
+        assert row["vulnerable_probability"] == 0.25
+        assert not t2.calls  # confident traffic never touches the LLM
+    finally:
+        snap = srv.shutdown()
+    assert snap["cascade_escalated_total"] == 0
+    assert snap["cascade_answered"] == {1: 1}
+
+
+def test_server_without_cascade_rows_carry_no_tier(demo):
+    from deepdfa_tpu.config import ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, sources = demo
+    srv = ScoreServer(_StubEngine(vocabs), vocabs,
+                      ServeConfig(port=0, max_wait_ms=2.0)).start()
+    try:
+        status, body = _post_score(srv.port, sources[0])
+        assert status == 200
+        row = body["results"][0]
+        assert "tier" not in row and "tier1_score" not in row
+        status, health = _req(srv.port, "GET", "/healthz")
+        assert json.loads(health)["cascade"] is False
+    finally:
+        srv.shutdown()
+
+
+def test_server_cascade_enabled_requires_engine_or_joint_dir(demo):
+    from deepdfa_tpu.config import CascadeConfig, ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, _ = demo
+    with pytest.raises(ValueError, match="needs a tier-2 engine"):
+        ScoreServer(_StubEngine(vocabs), vocabs,
+                    ServeConfig(port=0,
+                                cascade=CascadeConfig(enabled=True)))
+
+
+# ---------------------------------------------------------------------------
+# invariant 24: every tier-2 failure degrades to the tier-1 answer
+
+
+def test_server_tier2_engine_failure_degrades(demo):
+    _, sources = demo
+    srv = _cascade_server(demo, tier2=_StubTier2(fail=True),
+                          band=(0.4, 0.6)).start()
+    try:
+        status, body = _post_score(srv.port, sources[0])
+        assert status == 200  # never a 5xx
+        row = body["results"][0]
+        assert row["tier"] == 1
+        assert row["tier2_degraded"] is True
+        assert row["vulnerable_probability"] == 0.5  # tier-1 answer stands
+        status, health = _req(srv.port, "GET", "/healthz")
+        assert status == 200 and json.loads(health)["status"] == "ok"
+    finally:
+        snap = srv.shutdown()
+    assert snap["cascade_degraded_total"] == 1
+    assert snap["cascade_answered"] == {1: 1}
+
+
+def test_server_tier2_deadline_blown_degrades(demo):
+    _, sources = demo
+    srv = _cascade_server(demo, tier2=_StubTier2(delay_s=1.0),
+                          band=(0.4, 0.6), tier2_deadline_ms=50.0).start()
+    try:
+        status, body = _post_score(srv.port, sources[0])
+        assert status == 200
+        row = body["results"][0]
+        assert row["tier"] == 1 and row["tier2_degraded"] is True
+        assert row["vulnerable_probability"] == 0.5
+    finally:
+        snap = srv.shutdown()
+    assert snap["cascade_degraded_total"] == 1
+
+
+def test_server_tier2_queue_full_degrades_not_503(demo):
+    _, sources = demo
+    # slow tier-2, queue depth 1, batch 1: a multi-function request's
+    # escalations overflow the queue — overflow rows degrade, the rest
+    # answer tier 2, the response is still one 200
+    srv = _cascade_server(demo, tier2=_StubTier2(delay_s=0.4),
+                          band=(0.4, 0.6), tier2_max_batch=1,
+                          tier2_max_wait_ms=1.0, tier2_max_queue=1,
+                          tier2_deadline_ms=30_000.0).start()
+    try:
+        status, body = _post_score(srv.port, "\n".join(sources[:4]),
+                                   timeout=60)
+        assert status == 200
+        rows = body["results"]
+        degraded = [r for r in rows if r.get("tier2_degraded")]
+        answered2 = [r for r in rows if r.get("tier") == 2]
+        assert degraded, rows  # at least one overflow degraded
+        assert answered2, rows  # admitted escalations still answered
+        assert all(r["vulnerable_probability"] == 0.5 for r in degraded)
+    finally:
+        snap = srv.shutdown()
+    assert snap["cascade_degraded_total"] == len(degraded)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the declared fault points, through the real HTTP surface
+
+
+@pytest.mark.faults
+def test_chaos_tier2_timeout_keeps_tier1_answer(demo):
+    from deepdfa_tpu.resilience import faults
+
+    _, sources = demo
+    srv = _cascade_server(demo, band=(0.4, 0.6)).start()
+    try:
+        with faults.installed("cascade.tier2_timeout@1"):
+            status, body = _post_score(srv.port, sources[0])
+            assert status == 200
+            row = body["results"][0]
+            assert row["tier"] == 1 and row["tier2_degraded"] is True
+            assert row["vulnerable_probability"] == 0.5
+            status, health = _req(srv.port, "GET", "/healthz")
+            assert status == 200 and json.loads(health)["status"] == "ok"
+        # fault disarmed: the next borderline request answers tier 2
+        status, body = _post_score(srv.port, sources[1])
+        assert status == 200 and body["results"][0]["tier"] == 2
+    finally:
+        snap = srv.shutdown()
+    assert snap["cascade_degraded_total"] == 1
+    assert not any(code >= 500 for code in snap["responses_total"])
+
+
+@pytest.mark.faults
+def test_chaos_escalation_drop_keeps_tier1_answer(demo):
+    from deepdfa_tpu.resilience import faults
+
+    _, sources = demo
+    t2 = _StubTier2()
+    srv = _cascade_server(demo, tier2=t2, band=(0.4, 0.6)).start()
+    try:
+        with faults.installed("cascade.escalation_drop@1"):
+            status, body = _post_score(srv.port, sources[0])
+            assert status == 200
+            row = body["results"][0]
+            assert row["tier"] == 1 and row["tier2_degraded"] is True
+            assert not t2.calls  # dropped at enqueue: tier 2 never saw it
+            status, health = _req(srv.port, "GET", "/healthz")
+            assert status == 200 and json.loads(health)["status"] == "ok"
+        status, body = _post_score(srv.port, sources[1])
+        assert status == 200 and body["results"][0]["tier"] == 2
+    finally:
+        snap = srv.shutdown()
+    assert snap["cascade_degraded_total"] == 1
+    assert not any(code >= 500 for code in snap["responses_total"])
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+
+
+def test_metrics_and_slo_expose_cascade_families(demo):
+    _, sources = demo
+    srv = _cascade_server(demo, band=(0.4, 0.6)).start()
+    try:
+        assert _post_score(srv.port, sources[0])[0] == 200
+        status, text = _req(srv.port, "GET", "/metrics")
+        body = text.decode()
+        assert status == 200
+        for family in ("deepdfa_serve_cascade_escalated_total",
+                       "deepdfa_serve_cascade_degraded_total",
+                       'deepdfa_serve_cascade_answered_total{tier="2"}',
+                       "deepdfa_serve_tier2_queue_depth",
+                       "deepdfa_serve_tier1_latency_ms",
+                       "deepdfa_serve_tier2_latency_ms",
+                       "deepdfa_serve_tier2_queue_wait_ms",
+                       "deepdfa_serve_tier2_dispatch_ms"):
+            assert family in body, family
+        status, text = _req(srv.port, "GET", "/slo")
+        slo = text.decode()
+        assert status == 200
+        assert "tier2_latency_p99" in slo and "tier2_success" in slo
+        status, health = _req(srv.port, "GET", "/healthz")
+        h = json.loads(health)
+        assert h["cascade"] is True and h["tier2_model_rev"] == "t2-stub"
+    finally:
+        srv.shutdown()
+
+
+def test_escalation_spans_reach_the_tracer(demo):
+    _, sources = demo
+    srv = _cascade_server(demo, band=(0.4, 0.6)).start()
+    try:
+        assert _post_score(srv.port, sources[0])[0] == 200
+    finally:
+        srv.shutdown()
+    names = {s.name for s in srv.tracer.spans()}
+    assert {"cascade.escalate", "tier2.queue.wait",
+            "tier2.engine.dispatch"} <= names
+
+
+# ---------------------------------------------------------------------------
+# scan --cascade
+
+
+def test_scan_cascade_tier_attribution(demo, tmp_path):
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.scan import scan_paths
+
+    vocabs, _ = demo
+    rows = demo_corpus(3, seed=0).to_dict("records")
+    for i, r in enumerate(rows):
+        (tmp_path / f"f{i}.c").write_text(r["before"])
+    engine = _StubEngine(vocabs, prob=0.5)
+    t2 = _StubTier2(prob=0.88)
+    rep = scan_paths([tmp_path], vocabs, engine=engine, tier2=t2,
+                     tier2_band=(0.4, 0.6), n_workers=1, cache_dir=None)
+    scored = [r for r in rep["results"] if "vulnerable_probability" in r]
+    assert scored
+    assert all(r["tier"] == 2 and r["tier1_score"] == 0.5
+               and r["vulnerable_probability"] == 0.88 for r in scored)
+    assert rep["cascade"] == {"band": [0.4, 0.6], "n_tier2": len(scored),
+                              "n_degraded": 0, "tier2_model_rev": "t2-stub"}
+    # tier-2 items carried the owning file's source text
+    assert all(text for call in t2.calls for text in call)
+
+    # out of band: every row stays tier 1, tier 2 never runs
+    rep = scan_paths([tmp_path], vocabs, engine=engine, tier2=_StubTier2(),
+                     tier2_band=(0.8, 0.9), n_workers=1, cache_dir=None)
+    scored = [r for r in rep["results"] if "vulnerable_probability" in r]
+    assert all(r["tier"] == 1 and r["vulnerable_probability"] == 0.5
+               for r in scored)
+    assert rep["cascade"]["n_tier2"] == 0
+
+
+def test_scan_cascade_degrades_on_tier2_failure(demo, tmp_path):
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.scan import scan_paths
+
+    vocabs, _ = demo
+    rows = demo_corpus(2, seed=0).to_dict("records")
+    for i, r in enumerate(rows):
+        (tmp_path / f"f{i}.c").write_text(r["before"])
+    rep = scan_paths([tmp_path], vocabs, engine=_StubEngine(vocabs, prob=0.5),
+                     tier2=_StubTier2(fail=True), tier2_band=(0.4, 0.6),
+                     n_workers=1, cache_dir=None)
+    scored = [r for r in rep["results"] if "vulnerable_probability" in r]
+    assert scored  # the scan never aborts on tier-2 failure
+    assert all(r["tier"] == 1 and r["tier2_degraded"]
+               and r["vulnerable_probability"] == 0.5 for r in scored)
+    assert rep["cascade"]["n_degraded"] == len(scored)
+
+
+def test_scan_command_cascade_requires_scores_and_joint_dir(tmp_path):
+    from deepdfa_tpu.config import load_config
+    from deepdfa_tpu.scan import scan_command
+
+    (tmp_path / "a.c").write_text("int f(void) { return 1; }\n")
+    cfg = load_config(overrides={"data.sample": True})
+    # both checks fire before shard/vocab resolution touches the filesystem
+    with pytest.raises(ValueError, match="needs tier-1 scores"):
+        scan_command(cfg, tmp_path, [str(tmp_path)], workers=1,
+                     cache_dir=None, cascade=True)
+    with pytest.raises(ValueError, match="needs a tier-2 checkpoint"):
+        scan_command(cfg, tmp_path, [str(tmp_path)],
+                     ckpt_dir=tmp_path / "nonexistent_ckpt", workers=1,
+                     cache_dir=None, cascade=True)
+
+
+# ---------------------------------------------------------------------------
+# bench contract (device-free)
+
+
+@pytest.mark.perf_contract
+def test_cascade_bench_schema_and_gates():
+    from bench import assemble_cascade_result
+
+    good = dict(backend="cpu", device_kind="cpu", band=(0.3, 0.7),
+                expected_frac=0.4, escalated_total=40, answered_tier2=40,
+                degraded_total=0, requests_total=100, tier1_p50_ms=10.0,
+                baseline_p50_ms=10.0, tier2_p50_ms=80.0, tier2_p99_ms=150.0,
+                errors_total=0)
+    r = assemble_cascade_result(**good)
+    assert r["ok"] is True
+    assert r["metric"] == "cascade_escalated_frac"
+    assert r["escalated_frac"] == 0.4 and r["expected_frac"] == 0.4
+    assert r["escalation_ok"] and r["t1_regression_ok"]
+    assert "git_rev" in r and "schema_version" in r
+
+    # escalation fraction outside ±20% of the expected band mass
+    assert assemble_cascade_result(**{**good, "escalated_total": 60})["ok"] is False
+    assert assemble_cascade_result(**{**good, "escalated_total": 20})["ok"] is False
+    # within ±20% passes
+    assert assemble_cascade_result(**{**good, "escalated_total": 45,
+                                      "answered_tier2": 45})["ok"] is True
+    # nominal load must produce zero degradations
+    assert assemble_cascade_result(**{**good, "degraded_total": 1})["ok"] is False
+    # every escalation must be answered by tier 2
+    assert assemble_cascade_result(**{**good, "answered_tier2": 39})["ok"] is False
+    # tier-1 p50 regression beyond 10% fails; at exactly 10% passes
+    assert assemble_cascade_result(**{**good, "tier1_p50_ms": 11.01})["ok"] is False
+    assert assemble_cascade_result(**{**good, "tier1_p50_ms": 11.0})["ok"] is True
+    # errors always fail
+    assert assemble_cascade_result(**{**good, "errors_total": 1})["ok"] is False
+
+
+@pytest.mark.perf_contract
+def test_serve_result_ands_cascade_ok():
+    from bench import assemble_cascade_result, assemble_serve_result
+
+    base = dict(backend="cpu", device_kind="cpu", requests_per_sec=50.0,
+                p50_ms=10.0, p99_ms=90.0, mean_batch_occupancy=0.7,
+                cache_hit_rate=0.5, cache_hits=10, requests_total=20,
+                errors_total=0)
+    cascade = assemble_cascade_result(
+        backend="cpu", device_kind="cpu", band=(0.3, 0.7), expected_frac=0.4,
+        escalated_total=40, answered_tier2=40, degraded_total=0,
+        requests_total=100, tier1_p50_ms=10.0, baseline_p50_ms=10.0,
+        tier2_p50_ms=80.0, tier2_p99_ms=150.0, errors_total=0)
+    r = assemble_serve_result(**base, cascade=cascade)
+    assert r["ok"] is True and r["cascade"]["ok"] is True
+    bad = dict(cascade, ok=False)
+    assert assemble_serve_result(**base, cascade=bad)["ok"] is False
+    # absent block: gate unchanged
+    assert assemble_serve_result(**base)["cascade"] is None
+    assert assemble_serve_result(**base)["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# joint engine: restore → rescore parity (the real tier 2)
+
+
+def test_newest_epoch_dir_numeric_sort(tmp_path):
+    from deepdfa_tpu.llm.joint_engine import newest_epoch_dir
+
+    assert newest_epoch_dir(tmp_path) is None
+    for name in ("epoch_0", "epoch_9", "epoch_10"):
+        (tmp_path / name).mkdir()
+    assert newest_epoch_dir(tmp_path).name == "epoch_10"  # not epoch_9
+
+
+def test_joint_engine_missing_checkpoint_raises(tmp_path):
+    from deepdfa_tpu.llm.joint_engine import JointEngine
+
+    with pytest.raises(FileNotFoundError, match="no epoch_"):
+        JointEngine.from_run_dir(tmp_path)
+
+
+@pytest.fixture(scope="module")
+def joint_ckpt(tmp_path_factory):
+    """A tiny trained joint checkpoint + its training-side eval results."""
+    import jax
+
+    from deepdfa_tpu.config import GGNNConfig
+    from deepdfa_tpu.data.synthetic import random_dataset
+    from deepdfa_tpu.llm.dataset import GraphJoin, HashTokenizer, encode_functions
+    from deepdfa_tpu.llm.fusion import FusionModel
+    from deepdfa_tpu.llm.joint import JointConfig, JointTrainer
+    from deepdfa_tpu.llm.llama import LlamaModel, tiny_llama
+
+    input_dim = 52
+    llm_cfg = tiny_llama(vocab_size=320)
+    llm = LlamaModel(llm_cfg)
+    rng = np.random.default_rng(0)
+    n = 8
+    labels = rng.integers(0, 2, size=n)
+    funcs = [("void f(){ memcpy(dst, src, n); }" if y
+              else "void f(){ int a = 1; }") for y in labels]
+    examples = encode_functions(
+        funcs, labels.tolist(), HashTokenizer(vocab_size=320), 16,
+        indices=range(n))
+    graphs = random_dataset(n, seed=1, input_dim=input_dim, mean_nodes=6)
+    for i, g in enumerate(graphs):
+        g.gid = i
+    gnn_cfg = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2)
+    fusion = FusionModel(gnn_cfg=gnn_cfg, input_dim=input_dim,
+                         llm_hidden_size=llm_cfg.hidden_size,
+                         dropout_rate=0.1)
+    llm_params = llm.init(jax.random.key(0),
+                          np.zeros((2, 16), np.int32))["params"]
+    jcfg = JointConfig(epochs=1, train_batch_size=4, eval_batch_size=4,
+                       block_size=16, seed=0)
+    run_dir = tmp_path_factory.mktemp("joint_ckpt")
+    trainer = JointTrainer(
+        llm=llm, llm_params=llm_params, fusion=fusion, cfg=jcfg,
+        join=GraphJoin.from_list(graphs, max_nodes=512, max_edges=1024),
+        run_dir=run_dir)
+    state = trainer.train(examples, examples)
+    loss, probs, ev_labels = trainer._run_eval(state.params, examples)
+    return {"run_dir": run_dir, "jcfg": jcfg, "gnn_cfg": gnn_cfg,
+            "input_dim": input_dim, "state": state, "funcs": funcs,
+            "graphs": graphs, "probs": probs, "labels": ev_labels}
+
+
+@pytest.mark.slow
+def test_joint_engine_restore_is_bit_identical(joint_ckpt):
+    import jax
+
+    from deepdfa_tpu.llm.joint_engine import JointEngine
+
+    eng = JointEngine.from_run_dir(
+        joint_ckpt["run_dir"], jcfg=joint_ckpt["jcfg"],
+        gnn_cfg=joint_ckpt["gnn_cfg"], input_dim=joint_ckpt["input_dim"],
+        vocab_size=320, max_batch=4, max_nodes=512, max_edges=1024)
+    jax.tree.map(np.testing.assert_array_equal,
+                 joint_ckpt["state"].params, eng.fusion_params)
+    # the rev scheme matches tier 1's: a content hash of the trained tree
+    assert isinstance(eng.model_rev, str) and len(eng.model_rev) == 16
+
+
+@pytest.mark.slow
+def test_joint_engine_rescore_matches_training_eval(joint_ckpt):
+    """Restore→rescore parity is definitional: JointEngine.score runs the
+    trainer's own jitted eval_step, so the restored checkpoint reproduces
+    the training-side eval probabilities bit for bit."""
+    from deepdfa_tpu.llm.joint_engine import JointEngine
+
+    eng = JointEngine.from_run_dir(
+        joint_ckpt["run_dir"], jcfg=joint_ckpt["jcfg"],
+        gnn_cfg=joint_ckpt["gnn_cfg"], input_dim=joint_ckpt["input_dim"],
+        vocab_size=320, max_batch=4, max_nodes=512, max_edges=1024)
+    got = eng.score(list(zip(joint_ckpt["funcs"][:4],
+                             joint_ckpt["graphs"][:4])))
+    want = joint_ckpt["probs"][:4, 1].astype(np.float64)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
+def test_best_threshold_sweep_deterministic(joint_ckpt):
+    from deepdfa_tpu.llm.joint import best_threshold_sweep
+
+    probs, labels = joint_ckpt["probs"][:, 1], joint_ckpt["labels"]
+    a = best_threshold_sweep(probs, labels)
+    b = best_threshold_sweep(np.array(probs, copy=True),
+                             np.array(labels, copy=True))
+    assert a == b  # pure function of (probs, labels, grid)
+    t, f1 = a
+    assert 0.0 < t < 1.0 and 0.0 <= f1 <= 1.0
+
+
+def test_best_threshold_sweep_tie_breaks_low():
+    from deepdfa_tpu.llm.joint import best_threshold_sweep
+
+    # every threshold in (0.2, 0.8] classifies perfectly — the sweep must
+    # deterministically keep the LOWEST winning threshold on the grid
+    probs = np.array([0.1, 0.2, 0.8, 0.9])
+    labels = np.array([0, 0, 1, 1])
+    t, f1 = best_threshold_sweep(probs, labels)
+    assert f1 == 1.0
+    assert t == pytest.approx(0.21)

@@ -327,3 +327,31 @@ def test_autoscale_direction_flows_into_verdicts(tmp_path):
     ok, rows = Ledger.from_paths([slow]).check()
     (row,) = [r for r in rows if r["metric"] == "replace_latency_s"]
     assert row["verdict"] == "regression" and ok is False
+
+
+def test_cascade_series_are_explicitly_declared():
+    """Satellite pin (PR 14): the cascade stage's series are DECLARED.
+    ``escalated_frac`` is the one the heuristic would get WRONG — no
+    latency/error token in the name, but the fraction drifting up means
+    confident traffic is leaking into the expensive tier (the two-sided
+    band-mass check lives in the bench gate; the ledger watches the
+    upward creep)."""
+    for metric in ("tier2_p99_ms", "degraded_total", "escalated_frac"):
+        assert EXPLICIT_SERIES[("cascade", metric)] is True, metric
+        assert lower_is_better(metric, "cascade") is True, metric
+
+
+def test_cascade_direction_flows_into_verdicts(tmp_path):
+    """An escalated_frac JUMP under the cascade stage must go red end to
+    end — the serve artifact nests the cascade block one level down, so
+    this also pins that the walker assigns stage="cascade" there."""
+    for i in range(4):
+        _art(tmp_path, f"BENCH_t{i:02d}.json", emitted=1000 + i,
+             cascade={"escalated_frac": 0.40, "degraded_total": 0})
+    _art(tmp_path, "BENCH_t99.json", emitted=2000,
+         cascade={"escalated_frac": 0.55, "degraded_total": 0})
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    (row,) = [r for r in rows if r["metric"] == "escalated_frac"]
+    assert row["stage"] == "cascade"
+    assert row["lower_is_better"] is True
+    assert row["verdict"] == "regression" and ok is False
