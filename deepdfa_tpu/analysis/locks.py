@@ -33,7 +33,7 @@ PASS_NAME = "locks"
 
 # modules this pass analyzes: the threaded serving/observability planes
 SCOPE = ("/serve/", "/obs/", "/resilience/", "joern_session", "prefetch",
-         "lock", "thread", "autoscal", "extract")
+         "lock", "thread", "autoscal", "extract", "frontend")
 
 _SAFE_ATTR_CTORS = {
     "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",

@@ -434,6 +434,37 @@ class CascadeConfig:
 
 
 @dataclass(frozen=True)
+class FrontendConfig:
+    """Frontend encode pool knobs (``serve/frontend.py``; CLI: ``--set
+    serve.frontend.*``): cold-request ``encode_source`` work runs on a
+    pool of warm encode workers instead of inline on the GIL-bound
+    request-handler thread. ``mode="process"`` spawns vocab-warm child
+    processes (true parallelism past the GIL; the spawn handshake carries
+    the vocab content hash and a mismatch fails fast), ``"thread"`` keeps
+    the sessions in-process (cheap, test-friendly), ``"inline"`` disables
+    the pool entirely. Pool death or unavailability always degrades to
+    inline encode — never a new 5xx (standing invariant 25)."""
+
+    mode: str = "inline"  # process | thread | inline
+    workers: int = 2
+    max_queue: int = 256  # bounded encode queue — beyond it, QueueFullError
+    spawn_timeout_s: float = 120.0  # child ready-handshake budget
+    encode_timeout_s: float = 120.0  # per-item reply budget (process mode)
+
+    def __post_init__(self):
+        if self.mode not in ("process", "thread", "inline"):
+            raise ValueError("mode must be 'process', 'thread' or 'inline'")
+        if self.workers < 1:
+            raise ValueError("workers must be >= 1")
+        if self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        if self.spawn_timeout_s <= 0:
+            raise ValueError("spawn_timeout_s must be > 0")
+        if self.encode_timeout_s <= 0:
+            raise ValueError("encode_timeout_s must be > 0")
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online scoring service knobs (``deepdfa_tpu/serve``; CLI:
     ``--set serve.*``): the micro-batching window, admission control, the
@@ -478,6 +509,8 @@ class ServeConfig:
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     # two-tier GGNN -> joint-LLM scoring cascade (serve/cascade.py)
     cascade: CascadeConfig = field(default_factory=CascadeConfig)
+    # frontend encode pool (serve/frontend.py): cold-path encode workers
+    frontend: FrontendConfig = field(default_factory=FrontendConfig)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -574,6 +607,7 @@ _NESTED: dict[tuple[str, str], type] = {
     ("ServeConfig", "obs"): ObsConfig,
     ("ServeConfig", "autoscale"): AutoscaleConfig,
     ("ServeConfig", "cascade"): CascadeConfig,
+    ("ServeConfig", "frontend"): FrontendConfig,
 }
 
 

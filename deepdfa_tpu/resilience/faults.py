@@ -72,6 +72,8 @@ KNOWN_POINTS = (
     "extract.cache_corrupt",
     "cascade.tier2_timeout",
     "cascade.escalation_drop",
+    "frontend.worker_crash",
+    "frontend.spawn_fail",
 )
 
 # One line per point; keys must equal KNOWN_POINTS (the analysis faults
@@ -136,6 +138,14 @@ POINT_DOCS = {
         "drop one borderline escalation at enqueue — the request keeps its "
         "tier-1 answer with tier2_degraded: true, never a 5xx "
         "(serve/cascade.py)"),
+    "frontend.worker_crash": (
+        "kill one frontend encode worker mid-task — its in-flight source "
+        "is re-queued and completed exactly once by a survivor; total pool "
+        "death degrades requests to inline encode (serve/frontend.py)"),
+    "frontend.spawn_fail": (
+        "fail one frontend encode-session spawn — the supervisor retries "
+        "with backoff; a pool that cannot spawn at all degrades to inline "
+        "encode, never a 5xx (serve/frontend.py)"),
 }
 
 

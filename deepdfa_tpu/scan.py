@@ -51,23 +51,20 @@ def collect_c_files(paths: Sequence[str | Path]) -> list[Path]:
     return out
 
 
-class _EncodeSession:
-    """The pool's 'session' for in-process encoding: one vocab closure.
-    Real Joern extraction swaps in a JoernSession/ProcessSession factory;
-    the supervision contract (close(), SESSION_ERRORS) is identical."""
+def _session_factory(vocabs, frontend):
+    """The scan's encode sessions come from the SAME factory the online
+    :class:`~deepdfa_tpu.serve.frontend.FrontendPool` uses — offline and
+    online frontends share one pool implementation, so mode (process vs
+    thread), the vocab-hash spawn handshake, and timeout semantics
+    cannot drift between the two surfaces."""
+    from deepdfa_tpu.config import FrontendConfig
+    from deepdfa_tpu.serve.frontend import encode_session_factory
 
-    def __init__(self, vocabs):
-        self._vocabs = vocabs
-
-    def encode(self, code: str):
-        from deepdfa_tpu.pipeline import encode_source
-
-        # keep_cpg=False: cache entries hold (name, Graph, node_ids) only —
-        # small, picklable, and exactly what scoring needs
-        return encode_source(code, self._vocabs, keep_cpg=False)
-
-    def close(self) -> None:
-        pass
+    if frontend is None or frontend.mode == "inline":
+        # encode must still run on the pool's worker threads — "inline"
+        # only means no child processes, i.e. thread-mode sessions
+        frontend = FrontendConfig(mode="thread")
+    return encode_session_factory(vocabs, frontend)
 
 
 def _score_functions(engine, rows: list[dict], graphs: list) -> None:
@@ -134,6 +131,7 @@ def scan_paths(
     n_workers: int = 4,
     cache_dir: str | Path | None = None,
     attempts_per_item: int = 2,
+    frontend=None,
 ) -> dict:
     """Scan ``paths``; returns the report dict (also what ``scan.json``
     records). Per-file failures are error rows; nothing aborts the scan."""
@@ -146,7 +144,7 @@ def scan_paths(
         # a re-vocabed corpus must MISS rather than serve stale encodings
         cache = ExtractCache(cache_dir, salt=vocab_content_hash(vocabs))
     pool = ExtractionPool(
-        lambda wid: _EncodeSession(vocabs),
+        _session_factory(vocabs, frontend),
         n_workers=max(1, min(n_workers, max(len(sources), 1))),
         attempts_per_item=attempts_per_item,
         cache=cache,
@@ -259,7 +257,8 @@ def scan_command(cfg, run_dir: Path, targets: Sequence[str], *,
         targets, vocabs, engine=engine, tier2=tier2,
         tier2_band=(ccfg.band_lo, ccfg.band_hi), n_workers=workers,
         cache_dir=cache_dir if cache_dir is not None
-        else run_dir / "extract_cache")
+        else run_dir / "extract_cache",
+        frontend=cfg.serve.frontend)
     atomic_write_text(run_dir / "scan.json", json.dumps(report, indent=2))
     print(json.dumps({k: v for k, v in report.items() if k != "results"},
                      sort_keys=True), flush=True)

@@ -38,6 +38,14 @@ from .engine import (
     ServeBucket,
     serve_buckets,
 )
+from .frontend import (
+    ENCODE_ITEM_ERRORS,
+    FrontendPool,
+    FrontendProcessSession,
+    ThreadEncodeSession,
+    VocabHashMismatch,
+    encode_session_factory,
+)
 from .metrics import LatencyReservoir, ServeMetrics
 from .router import Backend, FleetRouter, HashRing, RouterMetrics
 from .server import ScoreServer, build_server, serve_command
@@ -58,6 +66,12 @@ __all__ = [
     "ScoringEngine",
     "ServeBucket",
     "serve_buckets",
+    "ENCODE_ITEM_ERRORS",
+    "FrontendPool",
+    "FrontendProcessSession",
+    "ThreadEncodeSession",
+    "VocabHashMismatch",
+    "encode_session_factory",
     "LatencyReservoir",
     "ServeMetrics",
     "Backend",

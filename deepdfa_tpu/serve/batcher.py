@@ -213,6 +213,7 @@ class MicroBatcher:
         t1 = time.time()
         if self.metrics is not None:
             self.metrics.dispatch.observe((t1 - t0) * 1e3)
+            self.metrics.record_dispatch_interval(t0, t1)
         if tracer is not None:
             tracer.record("engine.dispatch", t0, t1, parent=first_ctx,
                           n_graphs=n_real, n_batches=len(batches),

@@ -102,6 +102,18 @@ class ServeMetrics:
         self.tier2_latency = LatencyReservoir(latency_window)
         self.tier2_queue_wait = LatencyReservoir(latency_window)
         self.tier2_dispatch = LatencyReservoir(latency_window)
+        # frontend encode pool (serve/frontend.py): queue-depth gauge,
+        # degraded-to-inline counter (pool unavailable → inline encode,
+        # invariant 25 — NOT an error), and the encode / queue-wait
+        # reservoirs behind the /metrics p50-p99 gauges
+        self.frontend_queue_depth = 0
+        self.frontend_inline_total = 0
+        self.frontend_encode = LatencyReservoir(latency_window)
+        self.frontend_queue_wait = LatencyReservoir(latency_window)
+        # wall-clock (start, end) of recent engine dispatches — the bench
+        # intersects these with the frontend pool's encode intervals to
+        # measure the encode↔dispatch overlap fraction
+        self.dispatch_intervals: deque = deque(maxlen=4096)
         self.warmup: dict | None = None  # last engine warmup report
         # attachment points set by the server: the request tracer and the
         # score-drift sentinel both render through /metrics when present;
@@ -130,6 +142,15 @@ class ServeMetrics:
             if code >= 400:
                 self.errors_total += 1
         self.latency.observe(latency_ms)
+
+    def record_dispatch_interval(self, t0: float, t1: float) -> None:
+        """One engine dispatch's wall-clock span (fed by the batcher)."""
+        with self._lock:
+            self.dispatch_intervals.append((float(t0), float(t1)))
+
+    def dispatch_interval_list(self) -> list[tuple[float, float]]:
+        with self._lock:
+            return list(self.dispatch_intervals)
 
     def observe_answered(self, tier: int) -> None:
         """One served /score row attributed to the tier that scored it."""
@@ -185,6 +206,8 @@ class ServeMetrics:
                 "cascade_degraded_total": self.cascade_degraded_total,
                 "cascade_answered": dict(self.cascade_answered),
                 "tier2_queue_depth": self.tier2_queue_depth,
+                "frontend_queue_depth": self.frontend_queue_depth,
+                "frontend_inline_total": self.frontend_inline_total,
             }
         snap["padding_efficiency"] = self.padding_efficiency()
         snap["mean_batch_occupancy"] = (
@@ -202,6 +225,12 @@ class ServeMetrics:
         snap["tier2_latency_p99_ms"] = self.tier2_latency.quantile(0.99)
         snap["tier2_queue_wait_p99_ms"] = self.tier2_queue_wait.quantile(0.99)
         snap["tier2_dispatch_p99_ms"] = self.tier2_dispatch.quantile(0.99)
+        snap["frontend_encode_p50_ms"] = self.frontend_encode.quantile(0.50)
+        snap["frontend_encode_p99_ms"] = self.frontend_encode.quantile(0.99)
+        snap["frontend_queue_wait_p50_ms"] = (
+            self.frontend_queue_wait.quantile(0.50))
+        snap["frontend_queue_wait_p99_ms"] = (
+            self.frontend_queue_wait.quantile(0.99))
         return snap
 
     def render(self, cache_stats: dict | None = None) -> str:
@@ -260,6 +289,14 @@ class ServeMetrics:
         reg.gauge("tier2_queue_depth",
                   "Escalations waiting in the tier-2 queue").set(
             snap["tier2_queue_depth"])
+        reg.gauge("frontend_queue_depth",
+                  "Sources waiting in the frontend encode queue").set(
+            snap["frontend_queue_depth"])
+        reg.counter("frontend_inline_total",
+                    "Cold requests encoded inline because the frontend "
+                    "pool was unavailable (degrade-to-inline, invariant "
+                    "25 — never a 5xx)").set(
+            snap["frontend_inline_total"])
         for family, help_, reservoir in (
                 ("latency_ms", "End-to-end /score latency", self.latency),
                 ("queue_wait_ms", "Time a graph waited in the micro-batch "
@@ -273,7 +310,12 @@ class ServeMetrics:
                 ("tier2_queue_wait_ms", "Time an escalation waited in the "
                                         "tier-2 queue", self.tier2_queue_wait),
                 ("tier2_dispatch_ms", "Joint-engine dispatch wall time per "
-                                      "tier-2 window", self.tier2_dispatch)):
+                                      "tier-2 window", self.tier2_dispatch),
+                ("frontend_encode_ms", "Frontend pool encode wall time per "
+                                       "source", self.frontend_encode),
+                ("frontend_queue_wait_ms", "Time a source waited in the "
+                                           "frontend encode queue",
+                 self.frontend_queue_wait)):
             fam = reg.gauge(family, f"{help_} (windowed quantiles)",
                             labels=("quantile",))
             for q in (0.50, 0.99):

@@ -55,6 +55,7 @@ from deepdfa_tpu.resilience import faults
 from .batcher import MicroBatcher, QueueFullError
 from .cache import ScanCache
 from .engine import OversizeGraphError, ScoringEngine
+from .frontend import ENCODE_ITEM_ERRORS, FrontendPool
 from .metrics import ServeMetrics
 
 __all__ = ["ScoreServer", "build_server", "serve_command", "main"]
@@ -71,7 +72,8 @@ class ScoreServer:
                  cfg: ServeConfig | None = None, cache: ScanCache | None = None,
                  metrics: ServeMetrics | None = None,
                  replica_id: str | None = None, warm_store=None,
-                 journal=None, tier2_engine=None):
+                 journal=None, tier2_engine=None, frontend_pool=None,
+                 vocab_source=None):
         self.cfg = cfg or ServeConfig()
         self.engine = engine
         self.vocabs = vocabs
@@ -141,6 +143,21 @@ class ScoreServer:
             self.cascade = CascadeRouter(
                 cascade_cfg, tier2_engine,
                 metrics=self.metrics, tracer=self.tracer).start()
+        # frontend encode pool (serve/frontend.py): cold-request encode on
+        # supervised workers past the GIL; inline mode (the default) means
+        # no pool at all. A process-mode vocab-hash mismatch raises out of
+        # start() here — serve startup fails fast rather than scoring with
+        # divergent vocabularies. An injected pool (the bench, scan) is
+        # the caller's to stop.
+        self._owns_frontend = frontend_pool is None
+        if frontend_pool is not None:
+            self.frontend = frontend_pool
+        else:
+            self.frontend = FrontendPool.from_config(
+                vocabs, self.cfg.frontend, metrics=self.metrics,
+                tracer=self.tracer, vocab_source=vocab_source)
+            if self.frontend is not None:
+                self.frontend.start()
         self._draining = threading.Event()
         self._stop_requested = threading.Event()
         self._stopped = threading.Event()
@@ -206,6 +223,8 @@ class ScoreServer:
         """Refuse new scores, drain queue + in-flight handlers, close."""
         self._draining.set()
         self._stop_requested.set()
+        if self.frontend is not None and self._owns_frontend:
+            self.frontend.stop(drain=drain, timeout=self.cfg.drain_timeout_s)
         self.batcher.stop(drain=drain, timeout=self.cfg.drain_timeout_s)
         if self.cascade is not None:
             self.cascade.stop(drain=drain, timeout=self.cfg.drain_timeout_s)
@@ -302,6 +321,9 @@ class ScoreServer:
             if sp is not None:
                 sp.attrs["result_hit"] = bool(
                     entry is not None and entry.results is not None)
+                sp.attrs["encode_hit"] = bool(
+                    entry is not None and entry.results is None
+                    and entry.encoded is not None)
         if entry is not None and entry.results is not None:
             return 200, {"results": entry.results, "cached": True}
 
@@ -309,7 +331,7 @@ class ScoreServer:
             encoded = entry.encoded  # frontend skipped: encode-level hit
         else:
             try:
-                encoded = encode_source(source, self.vocabs, keep_cpg=False)
+                encoded = self._frontend_encode(source, key)
             except Exception as exc:  # noqa: BLE001 — frontend failure = 422
                 return 422, {"error": f"{type(exc).__name__}: {exc}"}
             self.cache.store(key, encoded=encoded)
@@ -406,6 +428,38 @@ class ScoreServer:
         self.cache.store(key, results=rows)
         return 200, {"results": rows, "cached": False}
 
+    def _frontend_encode(self, source: str, key: str):
+        """Encode one cold source. With a pool: submit → await under the
+        request deadline, so the encode runs on a supervised worker and
+        overlaps the batcher's device dispatches. ANY pool-level failure
+        — backpressure (``QueueFullError``), draining, pool death, a
+        blown wait — **degrades to inline encode** (standing invariant
+        25): pool trouble must never become a new 5xx and ``/healthz``
+        stays green. Only :data:`~.frontend.ENCODE_ITEM_ERRORS` propagate
+        — the item itself failed to encode, which is the caller's 422."""
+        pool = self.frontend
+        if pool is not None:
+            try:
+                fut = pool.submit(source, key=key)
+            except Exception as exc:  # noqa: BLE001 — unavailability
+                self._frontend_degrade(exc)
+            else:
+                try:
+                    return fut.result(timeout=REQUEST_TIMEOUT_S)
+                except ENCODE_ITEM_ERRORS:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — pool trouble
+                    self._frontend_degrade(exc)
+        with self._span("frontend.encode", mode="inline"):
+            return encode_source(source, self.vocabs, keep_cpg=False)
+
+    def _frontend_degrade(self, exc: Exception) -> None:
+        """Invariant 25: the request proceeds on inline encode; the
+        degradation is counted and flight-recorded, never surfaced."""
+        self.metrics.inc("frontend_inline_total")
+        self.flight.record("frontend.degraded",
+                           reason=f"{type(exc).__name__}: {exc}")
+
     def _cascade_degrade(self, row: dict, exc: Exception) -> None:
         """Invariant 24: tier-2 failure keeps the tier-1 answer. The row is
         marked, the degradation counted and journaled — never a 5xx."""
@@ -455,7 +509,12 @@ def _make_handler(server: ScoreServer):
                             "cascade": server.cascade is not None,
                             "tier2_model_rev": (
                                 server.cascade.model_rev
-                                if server.cascade is not None else None)})
+                                if server.cascade is not None else None),
+                            "frontend": (
+                                {"mode": server.frontend.cfg.mode,
+                                 "alive": server.frontend.alive}
+                                if server.frontend is not None
+                                else {"mode": "inline", "alive": True})})
             elif self.path == "/metrics":
                 self._send(200, server.metrics.render(server.cache.stats()),
                            content_type="text/plain; version=0.0.4")
@@ -537,7 +596,7 @@ def build_server(cfg: ExperimentConfig, run_dir: Path | None = None,
 
         warm_store = WarmStore(cfg.serve.warm_store_dir)
     return ScoreServer(engine, vocabs, cfg.serve, warm_store=warm_store,
-                       journal=journal)
+                       journal=journal, vocab_source=shard_dir)
 
 
 def serve_command(cfg: ExperimentConfig, run_dir: Path | None = None,

@@ -53,6 +53,18 @@ gated on the chaos criteria: replacement within the deadline with zero
 join compiles, SLO burn minutes within budget, zero client-visible
 errors beyond the failover window, and every scale decision recorded.
 
+``--frontend`` runs the encode-pool stage: an inline-frontend baseline
+phase and a pool-enabled phase drive the same-shaped cold (unique-body)
+load, then a chaos phase kills the pool mid-load. The artifact gains a
+``frontend`` block (``bench.assemble_frontend_result``): pool vs inline
+cold throughput (the ≥ 0.75×/worker scaling gate binds only when
+``host_cpus >= workers`` — a 1-CPU host records the honest ratio with
+``scaling_ok: null``), the measured encode↔dispatch overlap fraction
+(must be > 0: the pool actually hid frontend work behind device
+dispatches), encode/queue-wait percentiles, and the degradation gates —
+zero errors with the pool dead, inline fallback counter > 0, /healthz
+green (standing invariant 25).
+
 ``--cascade`` runs the two-tier escalation stage: a no-cascade baseline
 phase doubles as the tier-1 score oracle (the engine is deterministic),
 the borderline band is placed at the observed scores' 30th/70th
@@ -132,7 +144,7 @@ def _build_ckpt(cfg, vocabs):
 def _make_server(ckpt, vocabs, max_batch: int, max_wait_ms: float,
                  warm_store=None, journal=None, replica_id=None,
                  latency_window=None, obs=None, cascade=None,
-                 tier2_engine=None):
+                 tier2_engine=None, frontend=None):
     """One ScoreServer replica over a FRESH engine from the shared
     checkpoint (each replica pays — or warm-loads — its own ladder)."""
     from deepdfa_tpu.config import ServeConfig
@@ -149,6 +161,8 @@ def _make_server(ckpt, vocabs, max_batch: int, max_wait_ms: float,
         extra["obs"] = obs
     if cascade is not None:
         extra["cascade"] = cascade
+    if frontend is not None:
+        extra["frontend"] = frontend
     serve_cfg = ServeConfig(port=0, max_batch=max_batch,
                             max_wait_ms=max_wait_ms, **extra)
     return ScoreServer(engine, vocabs, serve_cfg, replica_id=replica_id,
@@ -498,6 +512,121 @@ def _run_cascade(ckpt, vocabs, bodies, args, backend: str,
         })
 
 
+def _run_frontend(ckpt, vocabs, base_sources, args, backend: str,
+                  device_kind: str) -> dict:
+    """The frontend encode-pool stage, three phases on cold (unique-body)
+    load so every request pays the full frontend:
+
+    A. **inline baseline** — a default (``mode="inline"``) server, cold
+       replay → ``inline_requests_per_sec``;
+    B. **pool** — a pool-enabled server, same-shaped cold load →
+       ``pool_requests_per_sec``, the pool's encode intervals intersected
+       with the batcher's dispatch intervals (same wall clock) →
+       ``overlap_frac``, and the encode/queue-wait reservoirs. The
+       ≥ 0.75×N scaling gate only binds when the host actually has the
+       cores (``host_cpus >= workers``) — on a 1-CPU host the artifact
+       records the honest ratio with ``scaling_ok: null``;
+    C. **degradation chaos** — the pool is killed (``stop(drain=False)``)
+       mid-load on the SAME server; every remaining request must still
+       answer 200 via inline fallback (``frontend_inline_total`` > 0
+       proves the fallback ran) and /healthz stays green — standing
+       invariant 25, measured through real HTTP."""
+    import http.client
+    import os
+
+    from bench import assemble_frontend_result, overlap_fraction
+
+    from deepdfa_tpu.config import FrontendConfig
+
+    n = args.requests
+
+    def _bodies(offset: int) -> list[str]:
+        return [json.dumps({"source": _uniq_source(
+                    base_sources[i % len(base_sources)], offset + i)})
+                for i in range(n)]
+
+    # phase A — inline baseline (the default ServeConfig frontend)
+    server = _make_server(ckpt, vocabs, args.max_batch, args.max_wait_ms)
+    server.warmup()
+    server.start()
+    try:
+        inline_s, err_a = _run_phase(
+            server.port, _bodies(100_000), args.concurrency)
+    finally:
+        server.shutdown()
+
+    fcfg = FrontendConfig(mode=args.frontend_mode,
+                          workers=args.frontend_workers)
+    server = _make_server(ckpt, vocabs, args.max_batch, args.max_wait_ms,
+                          frontend=fcfg)
+    server.warmup()
+    server.start()
+    pool_report = deg = None
+    health_green = False
+    try:
+        # phase B — pool-fronted cold load
+        pool_s, err_b = _run_phase(
+            server.port, _bodies(200_000), args.concurrency)
+        enc_intervals = server.frontend.encode_intervals()
+        dis_intervals = server.metrics.dispatch_interval_list()
+
+        # phase C — kill the pool mid-load; the rest must answer inline
+        deg_bodies = _bodies(300_000)
+        deg = {"elapsed": None, "errors": len(deg_bodies)}
+
+        def _deg_phase():
+            s, e = _run_phase(server.port, deg_bodies, args.concurrency)
+            deg.update(elapsed=s, errors=e)
+
+        t = threading.Thread(target=_deg_phase, daemon=True)
+        t.start()
+        time.sleep(0.05)  # let the first requests enter through the pool
+        server.frontend.stop(drain=False)
+        t.join(timeout=600.0)
+
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            health = json.loads(resp.read())
+            health_green = (resp.status == 200
+                            and health.get("status") == "ok")
+        finally:
+            conn.close()
+        pool_report = server.frontend.report()
+    finally:
+        snap = server.shutdown()
+
+    overlap = overlap_fraction(enc_intervals, dis_intervals)
+    return assemble_frontend_result(
+        backend=backend, device_kind=device_kind, mode=fcfg.mode,
+        n_workers=fcfg.workers, host_cpus=os.cpu_count(),
+        inline_rps=(n / inline_s if inline_s > 0 else None),
+        pool_rps=(n / pool_s if pool_s > 0 else None),
+        encode_p50_ms=snap.get("frontend_encode_p50_ms"),
+        encode_p99_ms=snap.get("frontend_encode_p99_ms"),
+        queue_wait_ms=snap.get("frontend_queue_wait_p50_ms"),
+        overlap_frac=overlap,
+        requests_total=2 * n,
+        errors_total=err_a + err_b,
+        degraded_requests_total=len(deg_bodies),
+        degraded_errors_total=deg["errors"],
+        degraded_inline_total=snap.get("frontend_inline_total", 0),
+        degraded_health_green=health_green,
+        notes={
+            "inline_elapsed_s": round(inline_s, 3),
+            "pool_elapsed_s": round(pool_s, 3),
+            "degraded_elapsed_s": (None if deg["elapsed"] is None
+                                   else round(deg["elapsed"], 3)),
+            "encode_intervals": len(enc_intervals),
+            "dispatch_intervals": len(dis_intervals),
+            "queue_wait_p99_ms": snap.get("frontend_queue_wait_p99_ms"),
+            "pool_report": pool_report,
+            "healthz_frontend": health.get("frontend"),
+        })
+
+
 def _run_fleet(ckpt, vocabs, bodies, args, single_cold_rps: float,
                warm_store_dir, backend: str, device_kind: str,
                baseline_warm: dict) -> dict:
@@ -834,6 +963,18 @@ def main(argv=None) -> dict:
                     dest="replace_deadline_s",
                     help="serve.autoscale.replace_deadline_s for the "
                     "--autoscale stage")
+    ap.add_argument("--frontend", action="store_true",
+                    help="run the frontend encode-pool stage: inline "
+                    "baseline vs pool cold throughput, encode-dispatch "
+                    "overlap fraction, and a pool-kill degradation phase "
+                    "(every request answered via inline fallback, "
+                    "/healthz green)")
+    ap.add_argument("--frontend-workers", type=int, default=2,
+                    dest="frontend_workers",
+                    help="serve.frontend.workers for the --frontend stage")
+    ap.add_argument("--frontend-mode", default="process",
+                    choices=("process", "thread"), dest="frontend_mode",
+                    help="serve.frontend.mode for the --frontend stage")
     ap.add_argument("--cascade", action="store_true",
                     help="run the two-tier cascade stage: a no-cascade "
                     "baseline phase doubles as the tier-1 score oracle, "
@@ -895,6 +1036,11 @@ def main(argv=None) -> dict:
         cascade = _run_cascade(ckpt, vocabs, bodies, args, backend=backend,
                                device_kind=device_kind)
 
+    frontend = None
+    if args.frontend:
+        frontend = _run_frontend(ckpt, vocabs, base_sources, args,
+                                 backend=backend, device_kind=device_kind)
+
     tiers = tier_precision = tier_refusal = None
     if args.tier_requests > 0:
         tiers, tier_precision, tier_refusal = _precision_tiers(
@@ -918,6 +1064,7 @@ def main(argv=None) -> dict:
         fleet=fleet,
         autoscale=autoscale,
         cascade=cascade,
+        frontend=frontend,
         notes={
             "cold_requests_per_sec": round(len(bodies) / cold_s, 2),
             "hot_requests_per_sec": round(len(bodies) / hot_s, 2),

@@ -8,6 +8,9 @@ streaming-extraction suite (``pytest -m 'extraction and not slow'``:
 pool exactly-once semantics, cache commit protocol, chaos points) + the
 two-tier cascade suite (``pytest -m 'cascade and not slow'``: band
 routing, tier-2 queue policy, invariant-24 degradation chaos) + the
+frontend encode-pool suite (``pytest -m 'frontend and not slow'``:
+bounded-queue backpressure, worker-crash exactly-once re-queue,
+invariant-25 degrade-to-inline through the real server) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
 lock-order, jit-purity/donation, fault-registry, metrics conformance
 static passes) + the perf-regression ledger (``python -m
@@ -110,6 +113,18 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("cascade")
+
+    # the frontend encode-pool suite: pool mechanics, worker-crash
+    # exactly-once re-queue through the real ScoreServer, the invariant-25
+    # degrade-to-inline contract — fast subset only (the process-mode
+    # spawn tests are `slow` and stay in tier-1's slow lane)
+    print("lint_gate: pytest -m 'frontend and not slow'")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "frontend and not slow",
+         "-q", "tests/test_frontend.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("frontend")
 
     # step 5: the invariant gate — AST passes for atomic-commit,
     # lock-order, jit-purity/donation, fault-registry and metrics

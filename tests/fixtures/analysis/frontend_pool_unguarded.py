@@ -1,0 +1,30 @@
+"""Seeded violation: frontend-pool-shaped unguarded shared counters.
+
+``_encoded`` and ``_crashed`` are written from the pool's encode worker
+threads and read by ``report()`` on the caller's thread with no common
+lock — exactly the race the real ``deepdfa_tpu/serve/frontend.py``
+guards with its one accounting lock. The unguarded-state pass must flag
+both attributes.
+"""
+
+import threading
+
+
+class LooseFrontendPool:
+    def __init__(self, n_workers: int = 2):
+        self._encoded = 0
+        self._crashed = []
+        self._threads = [
+            threading.Thread(target=self._worker, args=(i,), daemon=True)
+            for i in range(n_workers)
+        ]
+
+    def _worker(self, worker_id: int):
+        try:
+            self._encoded = self._encoded + 1
+        except Exception:
+            self._crashed = self._crashed + [worker_id]
+
+    def report(self) -> dict:
+        return {"encoded": self._encoded,
+                "crashed_workers": list(self._crashed)}

@@ -46,6 +46,7 @@ FIXTURES = REPO / "tests" / "fixtures" / "analysis"
 EXPECTED = {
     "autoscaler_unguarded.py": {"unguarded-state"},
     "extraction_pool_unguarded.py": {"unguarded-state"},
+    "frontend_pool_unguarded.py": {"unguarded-state"},
     "checkpoint_torn_write.py": {"atomic-commit"},
     "serve_lock_cycle.py": {"lock-order", "unguarded-state"},
     "jit_impure.py": {"jit-purity"},

@@ -1,0 +1,658 @@
+"""Frontend encode pool: the serving cold path past the GIL.
+
+Pins the ``serve/frontend.py`` contract the roadmap's standing invariant
+25 depends on: a pool of supervised encode workers (thread-mode in most
+tests — cheap and deterministic; process-mode spawn semantics are pinned
+in the slow tests at the bottom), bounded-queue backpressure, work
+stealing, the ``frontend.worker_crash`` exactly-once re-queue (invariant
+23's pool semantics, proven through the REAL ScoreServer over HTTP), and
+the degradation contract: pool death or shutdown mid-load must never
+produce a new 5xx — every request falls back to inline encode and
+``/healthz`` stays green.
+"""
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+pytestmark = pytest.mark.frontend
+
+
+class _StubEngine:
+    """Real ScoringEngine over a stub score_fn (test_serve.py idiom)."""
+
+    def __new__(cls, vocabs=(), max_batch=4, prob=0.5):
+        from deepdfa_tpu.serve import ScoringEngine, serve_buckets
+
+        def score_fn(batch):
+            return np.full(batch.max_graphs, prob, np.float32)
+
+        return ScoringEngine(score_fn, serve_buckets(max_batch),
+                             feat_keys=tuple(vocabs))
+
+
+@pytest.fixture(scope="module")
+def demo():
+    """(vocabs, sources) from a tiny hermetic corpus — real frontend +
+    real vocabularies, no training (test_serve.py idiom)."""
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    rows = demo_corpus(6, seed=0).to_dict("records")
+    cpgs = {int(r["id"]): add_dependence_edges(parse_source(r["before"]))
+            for r in rows}
+    labels = {int(r["id"]): int(r["vul"]) for r in rows}
+    _, vocabs = CorpusBuilder(FeatureConfig()).build(
+        cpgs, list(cpgs), graph_labels=labels)
+    return vocabs, [r["before"] for r in rows]
+
+
+def _req(port, method, path, body=None, timeout=30):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, r.read()
+    finally:
+        conn.close()
+
+
+def _post_score(port, source, timeout=30):
+    status, data = _req(port, "POST", "/score",
+                        json.dumps({"source": source}), timeout)
+    return status, json.loads(data)
+
+
+def _pool(vocabs, mode="thread", workers=2, max_queue=256, **pool_kw):
+    from deepdfa_tpu.config import FrontendConfig
+    from deepdfa_tpu.resilience.retry import RetryPolicy
+    from deepdfa_tpu.serve import FrontendPool
+
+    pool_kw.setdefault("spawn_policy",
+                       RetryPolicy(attempts=2, base_delay=0.0, jitter=0.0))
+    pool_kw.setdefault("sleep", lambda _s: None)
+    return FrontendPool(
+        vocabs, FrontendConfig(mode=mode, workers=workers,
+                               max_queue=max_queue), **pool_kw)
+
+
+def _frontend_server(demo, mode="thread", workers=2):
+    from deepdfa_tpu.config import FrontendConfig, ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, _ = demo
+    return ScoreServer(
+        _StubEngine(vocabs), vocabs,
+        ServeConfig(port=0, max_wait_ms=2.0,
+                    frontend=FrontendConfig(mode=mode, workers=workers)))
+
+
+class _BlockingSession:
+    """Encode session whose every encode blocks until released — the
+    deterministic way to keep a worker busy / a queue deep."""
+
+    def __init__(self, release: threading.Event, entered: threading.Event):
+        self.release = release
+        self.entered = entered
+
+    def encode(self, source):
+        self.entered.set()
+        assert self.release.wait(timeout=30.0)
+        return [source]
+
+    def close(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+def test_frontend_config_validation():
+    from deepdfa_tpu.config import FrontendConfig
+
+    with pytest.raises(ValueError, match="mode"):
+        FrontendConfig(mode="fork")
+    with pytest.raises(ValueError, match="workers"):
+        FrontendConfig(workers=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        FrontendConfig(max_queue=0)
+    with pytest.raises(ValueError, match="spawn_timeout_s"):
+        FrontendConfig(spawn_timeout_s=0.0)
+    with pytest.raises(ValueError, match="encode_timeout_s"):
+        FrontendConfig(encode_timeout_s=-1.0)
+
+
+def test_frontend_config_dotted_overrides():
+    from deepdfa_tpu.config import load_config
+
+    cfg = load_config(overrides={"serve.frontend.mode": "thread",
+                                 "serve.frontend.workers": 3})
+    assert cfg.serve.frontend.mode == "thread"
+    assert cfg.serve.frontend.workers == 3
+    # the default is inline: existing serve configs build NO pool
+    assert load_config().serve.frontend.mode == "inline"
+
+
+def test_from_config_inline_means_no_pool(demo):
+    from deepdfa_tpu.config import FrontendConfig
+    from deepdfa_tpu.serve import FrontendPool
+
+    vocabs, _ = demo
+    assert FrontendPool.from_config(vocabs, None) is None
+    assert FrontendPool.from_config(vocabs, FrontendConfig()) is None
+    with pytest.raises(ValueError, match="inline"):
+        FrontendPool(vocabs, FrontendConfig(mode="inline"))
+
+
+# ---------------------------------------------------------------------------
+# pool mechanics (thread mode)
+
+
+def test_pool_encode_matches_inline(demo):
+    from deepdfa_tpu.pipeline import encode_source
+
+    vocabs, sources = demo
+    pool = _pool(vocabs, workers=2).start()
+    try:
+        futures = [pool.submit(src) for src in sources[:4]]
+        for src, fut in zip(sources[:4], futures):
+            got = fut.result(timeout=60)
+            want = encode_source(src, vocabs, keep_cpg=False)
+            assert [e.name for e in got] == [e.name for e in want]
+            assert all(g.graph.n_nodes == w.graph.n_nodes
+                       for g, w in zip(got, want) if w.graph is not None)
+    finally:
+        pool.stop()
+    rep = pool.report()
+    assert rep["submitted"] == 4 and rep["encoded"] == 4
+    assert rep["vocab_hash"]
+    # every completed encode left a wall-clock interval for the bench's
+    # overlap measurement
+    assert len(pool.encode_intervals()) == 4
+
+
+def test_pool_item_error_is_extraction_item_error(demo):
+    from deepdfa_tpu.data.extraction import ExtractionItemError
+    from deepdfa_tpu.serve import ENCODE_ITEM_ERRORS
+
+    vocabs, _ = demo
+    pool = _pool(vocabs, workers=1).start()
+    try:
+        fut = pool.submit("int broken({{{{")
+        with pytest.raises(ENCODE_ITEM_ERRORS):
+            fut.result(timeout=60)
+        with pytest.raises(ExtractionItemError):
+            pool.submit("int broken({{{{").result(timeout=60)
+    finally:
+        pool.stop()
+    # an item error completes the item: the session survives
+    assert pool.report()["encoded"] == 0
+    assert pool.report()["restarts"] == 0
+
+
+def test_pool_backpressure_queue_full(demo):
+    from deepdfa_tpu.serve import QueueFullError
+
+    vocabs, _ = demo
+    release, entered = threading.Event(), threading.Event()
+    pool = _pool(vocabs, workers=1, max_queue=2)
+    pool._factory = lambda wid=0: _BlockingSession(release, entered)
+    pool.start()
+    try:
+        first = pool.submit("a")  # picked up, blocks the worker
+        assert entered.wait(timeout=10)
+        pool.submit("b")
+        pool.submit("c")
+        assert pool.queue_depth() == 2
+        with pytest.raises(QueueFullError):
+            pool.submit("d")
+        release.set()
+        assert first.result(timeout=10) == ["a"]
+    finally:
+        release.set()
+        pool.stop()
+
+
+def test_pool_submit_lifecycle_errors(demo):
+    vocabs, _ = demo
+    pool = _pool(vocabs, workers=1)
+    with pytest.raises(RuntimeError, match="not accepting"):
+        pool.submit("int f(void) { return 0; }")
+    pool.start()
+    pool.stop()
+    with pytest.raises(RuntimeError, match="not accepting"):
+        pool.submit("int f(void) { return 0; }")
+
+
+def test_pool_steals_from_stalled_worker(demo):
+    """One slow item stalls ONE worker; the other drains its queue from
+    the back (cold work first) — nothing waits behind the stall."""
+    vocabs, _ = demo
+    release, entered = threading.Event(), threading.Event()
+    done = threading.Event()
+
+    class _Sess:
+        def encode(self, source):
+            if source == "slow":
+                entered.set()
+                assert release.wait(timeout=30.0)
+            return [source]
+
+        def close(self):
+            pass
+
+    pool = _pool(vocabs, workers=2, max_queue=64)
+    pool._factory = lambda wid=0: _Sess()
+    pool.start()
+    try:
+        # round-robin: "slow" lands on worker 0 and blocks it; the rest
+        # of worker 0's queue must still complete via worker 1's steal
+        futures = [pool.submit("slow")]
+        assert entered.wait(timeout=10)
+        futures += [pool.submit(f"fast{i}") for i in range(6)]
+        for fut in futures[1:]:
+            assert fut.result(timeout=30)
+        done.set()
+        release.set()
+        assert futures[0].result(timeout=30) == ["slow"]
+    finally:
+        release.set()
+        pool.stop()
+    assert pool.report()["steals"] > 0
+
+
+def test_pool_stop_drain_false_fails_pending(demo):
+    vocabs, _ = demo
+    release, entered = threading.Event(), threading.Event()
+    pool = _pool(vocabs, workers=1, max_queue=64)
+    pool._factory = lambda wid=0: _BlockingSession(release, entered)
+    pool.start()
+    in_flight = pool.submit("a")
+    assert entered.wait(timeout=10)
+    queued = [pool.submit(f"q{i}") for i in range(3)]
+    release.set()
+    pool.stop(drain=False)
+    # queued futures fail fast (the server's cue to encode inline); the
+    # in-flight item finishes normally — exactly once, never abandoned
+    for fut in queued:
+        with pytest.raises(RuntimeError, match="shutting down"):
+            fut.result(timeout=10)
+    assert in_flight.result(timeout=10) == ["a"]
+
+
+def test_pool_exactly_once_completion_guard(demo):
+    """The invariant-23 bug detector itself: double-completing one task
+    must raise, not silently double-count."""
+    from deepdfa_tpu.serve.frontend import _FrontendTask
+
+    vocabs, _ = demo
+    pool = _pool(vocabs, workers=1)
+    task = _FrontendTask("k", "src", None)
+    pool._complete(task, result=[1])
+    with pytest.raises(RuntimeError, match="completed twice"):
+        pool._complete(task, result=[1])
+
+
+# ---------------------------------------------------------------------------
+# chaos: spawn failure + worker crash (the faults-conformance references:
+# frontend.spawn_fail@1, frontend.worker_crash@1)
+
+
+@pytest.mark.faults
+def test_spawn_fail_is_retried_by_the_supervisor(demo):
+    from deepdfa_tpu.resilience import faults
+
+    vocabs, sources = demo
+    with faults.installed("frontend.spawn_fail@1"):
+        pool = _pool(vocabs, workers=1).start()
+        try:
+            # first spawn attempt dies on the injected fault; the
+            # supervisor's spawn retry brings the session up anyway
+            got = pool.submit(sources[0]).result(timeout=60)
+        finally:
+            pool.stop()
+        assert faults.counters()["fires"]["frontend.spawn_fail"] == 1
+    assert got
+
+
+@pytest.mark.faults
+def test_spawn_fail_exhausted_quarantines_the_item(demo):
+    from deepdfa_tpu.resilience import faults
+    from deepdfa_tpu.resilience.supervisor import QuarantinedError
+
+    vocabs, sources = demo
+    with faults.installed("frontend.spawn_fail"):  # EVERY spawn fails
+        pool = _pool(vocabs, workers=1).start()
+        try:
+            fut = pool.submit(sources[0])
+            # QuarantinedError is an ENCODE_ITEM_ERRORS member: the server
+            # answers 422 rather than retrying inline — an item that kills
+            # sessions repeatedly must not get a shot at the parent process
+            with pytest.raises(QuarantinedError):
+                fut.result(timeout=60)
+        finally:
+            pool.stop()
+
+
+@pytest.mark.faults
+def test_worker_crash_requeues_exactly_once_through_http(demo):
+    """THE acceptance chaos test: frontend.worker_crash kills one worker
+    mid-task through the real ScoreServer; the in-flight source is
+    re-queued and completed exactly once by the survivor — every request
+    still answers 200 with its full row set, nothing double-scores."""
+    from deepdfa_tpu.resilience import faults
+
+    vocabs, sources = demo
+    srv = _frontend_server(demo, workers=2)
+    srv.start()
+    try:
+        with faults.installed("frontend.worker_crash@1"):
+            for i, src in enumerate(sources):
+                status, body = _post_score(srv.port, src + f"\n// {i}\n")
+                assert status == 200, body
+                assert body["results"]
+        rep = srv.frontend.report()
+        assert rep["requeued"] == 1  # the crashed worker's in-flight item
+        assert rep["crashed_workers"] and rep["alive"] == 1
+        # the re-queued item completed exactly once: every submitted task
+        # is accounted for, and the _complete guard would have raised on a
+        # double completion (killing the worker and failing its requests)
+        assert rep["encoded"] == rep["submitted"]
+        snap = srv.metrics.snapshot()
+        assert not any(int(c) >= 500
+                       for c in (snap.get("responses_total") or {}))
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.faults
+def test_pool_death_degrades_to_inline_over_http(demo):
+    """Invariant 25 under total pool death: the LAST worker crashes with
+    requests queued — those requests and every later one still answer 200
+    (inline fallback), the degradation is counted, /healthz stays green
+    with the pool honestly reported dead."""
+    from deepdfa_tpu.resilience import faults
+
+    vocabs, sources = demo
+    srv = _frontend_server(demo, workers=1)
+    srv.start()
+    try:
+        with faults.installed("frontend.worker_crash@1"):
+            for i, src in enumerate(sources[:4]):
+                status, body = _post_score(srv.port, src + f"\n// d{i}\n")
+                assert status == 200, body
+                assert all("vulnerable_probability" in r or "error" in r
+                           for r in body["results"])
+        assert srv.frontend.alive is False
+        snap = srv.metrics.snapshot()
+        assert snap["frontend_inline_total"] >= 1
+        assert not any(int(c) >= 500
+                       for c in (snap.get("responses_total") or {}))
+        status, raw = _req(srv.port, "GET", "/healthz")
+        health = json.loads(raw)
+        assert status == 200 and health["status"] == "ok"
+        assert health["frontend"] == {"mode": "thread", "alive": False}
+    finally:
+        srv.shutdown()
+
+
+def test_pool_shutdown_midload_degrades_to_inline_over_http(demo):
+    """The degradation contract with an explicit mid-load kill: requests
+    before the kill ride the pool, requests after it encode inline — the
+    client can't tell the difference (all 200, zero 5xx)."""
+    vocabs, sources = demo
+    srv = _frontend_server(demo, workers=2)
+    srv.start()
+    try:
+        for i, src in enumerate(sources[:2]):
+            status, _ = _post_score(srv.port, src + f"\n// pre{i}\n")
+            assert status == 200
+        srv.frontend.stop(drain=False)  # the mid-load pool kill
+        for i, src in enumerate(sources[2:5]):
+            status, body = _post_score(srv.port, src + f"\n// post{i}\n")
+            assert status == 200, body
+        snap = srv.metrics.snapshot()
+        assert snap["frontend_inline_total"] >= 3
+        assert not any(int(c) >= 500
+                       for c in (snap.get("responses_total") or {}))
+        status, raw = _req(srv.port, "GET", "/healthz")
+        assert status == 200 and json.loads(raw)["status"] == "ok"
+    finally:
+        srv.shutdown()
+
+
+def test_per_item_encode_failure_stays_422(demo):
+    """An unparseable source through the pool is still the ITEM's 422 —
+    never silently degraded to a second inline attempt."""
+    srv = _frontend_server(demo, workers=1)
+    srv.start()
+    try:
+        status, body = _post_score(srv.port, "int broken({{{{")
+        assert status == 422
+        assert "ExtractionItemError" in body["error"]
+        # and it was NOT counted as a pool degradation
+        assert srv.metrics.snapshot()["frontend_inline_total"] == 0
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# observability: encode-hit stamping, metrics families, spans
+
+
+def test_encode_hit_counter_and_span_attr(demo):
+    """A request that raced an engine fault leaves ``encoded`` behind; its
+    retry must skip the frontend (cache encode hit), bump the
+    ``encode_hits`` counter, and stamp ``encode_hit`` on the cache.lookup
+    span — the trace answers 'did this request pay the frontend?'."""
+    from deepdfa_tpu.resilience import faults
+
+    vocabs, sources = demo
+    srv = _frontend_server(demo, workers=1)
+    srv.start()
+    try:
+        with faults.installed("serve.engine_raises@1"):
+            status, _ = _post_score(srv.port, sources[0])
+            assert status == 500  # scored batch died; encoded was cached
+        status, body = _post_score(srv.port, sources[0])
+        assert status == 200 and body["cached"] is False
+        assert srv.cache.stats()["encode_hits"] == 1
+        lookups = [s for s in srv.tracer.spans() if s.name == "cache.lookup"]
+        assert [s.attrs["encode_hit"] for s in lookups] == [False, True]
+        assert all(s.attrs["result_hit"] is False for s in lookups)
+        _, raw = _req(srv.port, "GET", "/metrics")
+        assert b"cache_encode_hits_total 1" in raw
+    finally:
+        srv.shutdown()
+
+
+def test_metrics_expose_frontend_families(demo):
+    vocabs, sources = demo
+    srv = _frontend_server(demo, workers=1)
+    srv.start()
+    try:
+        status, _ = _post_score(srv.port, sources[0])
+        assert status == 200
+        _, raw = _req(srv.port, "GET", "/metrics")
+        text = raw.decode()
+        for family in ("frontend_queue_depth", "frontend_inline_total",
+                       "frontend_encode_ms", "frontend_queue_wait_ms"):
+            assert family in text, family
+        snap = srv.metrics.snapshot()
+        assert snap["frontend_encode_p50_ms"] is not None
+        assert snap["frontend_queue_wait_p50_ms"] is not None
+        # the encode ran on a worker thread but its span joined the
+        # request's trace (the ctx handoff through the task)
+        enc = [s for s in srv.tracer.spans() if s.name == "frontend.encode"]
+        req = [s for s in srv.tracer.spans() if s.name == "server.request"]
+        assert enc and req and enc[0].trace_id == req[0].trace_id
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# shared frontend: the offline scan rides the same session factory
+
+
+def test_scan_uses_the_shared_session_factory(demo, tmp_path):
+    from deepdfa_tpu.config import FrontendConfig
+    from deepdfa_tpu.scan import scan_paths
+
+    vocabs, sources = demo
+    for i, src in enumerate(sources[:3]):
+        (tmp_path / f"f{i}.c").write_text(src)
+    report = scan_paths([tmp_path], vocabs, n_workers=2, cache_dir=None,
+                        frontend=FrontendConfig(mode="thread", workers=2))
+    assert report["n_files"] == 3
+    assert report["n_functions"] >= 3 and report["n_errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# vocab-hash handshake + process mode (spawn cost → slow)
+
+
+def test_vocab_mismatch_fails_pool_start_fast(demo):
+    """Eager prespawn: a process-mode pool whose worker would encode with
+    divergent vocabularies fails start() — serve startup dies loudly
+    instead of scoring garbage per-request."""
+    from deepdfa_tpu.config import FrontendConfig
+    from deepdfa_tpu.serve import FrontendPool, VocabHashMismatch
+
+    vocabs, _ = demo
+    pool = FrontendPool(vocabs, FrontendConfig(mode="process", workers=2))
+
+    def _mismatch(worker_id=0):
+        raise VocabHashMismatch("worker hash deadbeef != serving hash")
+
+    pool._factory = _mismatch
+    with pytest.raises(VocabHashMismatch):
+        pool.start()
+    assert not pool._prespawned  # nothing half-spawned left behind
+
+
+@pytest.mark.slow
+def test_process_session_roundtrip_and_hash_handshake(demo):
+    from deepdfa_tpu.config import FrontendConfig
+    from deepdfa_tpu.pipeline import encode_source, vocab_content_hash
+    from deepdfa_tpu.serve import (
+        FrontendProcessSession,
+        VocabHashMismatch,
+        encode_session_factory,
+    )
+
+    vocabs, sources = demo
+    factory = encode_session_factory(
+        vocabs, FrontendConfig(mode="process", workers=1))
+    sess = factory(0)
+    try:
+        assert sess.vocab_hash == vocab_content_hash(vocabs)
+        got = sess.encode(sources[0])
+        want = encode_source(sources[0], vocabs, keep_cpg=False)
+        assert [e.name for e in got] == [e.name for e in want]
+        from deepdfa_tpu.data.extraction import ExtractionItemError
+
+        with pytest.raises(ExtractionItemError):
+            sess.encode("int broken({{{{")
+    finally:
+        sess.close()
+
+    # the handshake rejects a child whose vocab hash disagrees
+    with pytest.raises(VocabHashMismatch):
+        FrontendProcessSession(vocabs, expect_hash="0" * 16)
+
+
+@pytest.mark.slow
+def test_process_pool_through_http(demo):
+    """End-to-end process mode: spawned children warm-load the vocabs and
+    serve real HTTP requests past the GIL."""
+    vocabs, sources = demo
+    srv = _frontend_server(demo, mode="process", workers=1)
+    srv.start()
+    try:
+        for src in sources[:2]:
+            status, body = _post_score(srv.port, src, timeout=180)
+            assert status == 200, body
+            assert body["results"]
+        assert srv.frontend.report()["encoded"] >= 2
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# bench contract: the frontend block's gates without standing up a server
+
+
+def test_overlap_fraction_math():
+    from bench import overlap_fraction
+
+    # encode [0,2] ∪ [3,4]; dispatch [1,3.5]: overlap = 1 + 0.5 over 3s
+    assert overlap_fraction([(0.0, 2.0), (3.0, 4.0)],
+                            [(1.0, 3.5)]) == pytest.approx(0.5)
+    assert overlap_fraction([(0.0, 1.0)], [(2.0, 3.0)]) == 0.0
+    assert overlap_fraction([], [(0.0, 1.0)]) is None
+    # overlapping encode intervals are unioned, not double-counted
+    assert overlap_fraction([(0.0, 2.0), (1.0, 2.0)],
+                            [(0.0, 2.0)]) == pytest.approx(1.0)
+
+
+def test_assemble_frontend_result_gates():
+    from bench import FRONTEND_MIN_SCALING, assemble_frontend_result
+
+    def _block(**kw):
+        base = dict(backend="cpu", device_kind="cpu", mode="process",
+                    n_workers=2, host_cpus=8, inline_rps=10.0, pool_rps=16.0,
+                    encode_p50_ms=40.0, encode_p99_ms=80.0,
+                    queue_wait_ms=1.0, overlap_frac=0.4,
+                    requests_total=128, errors_total=0,
+                    degraded_requests_total=64, degraded_errors_total=0,
+                    degraded_inline_total=30, degraded_health_green=True)
+        base.update(kw)
+        return assemble_frontend_result(**base)
+
+    good = _block()
+    assert good["ok"] and good["scaling_ok"] and good["overlap_ok"]
+    assert good["scaling_vs_inline"] == pytest.approx(1.6)
+    assert good["min_scaling_per_worker"] == FRONTEND_MIN_SCALING
+
+    # 1-CPU host: the scaling gate abstains (null) but everything else
+    # still binds — the honest-measurement rule from the extraction bench
+    starved = _block(host_cpus=1, pool_rps=9.0)
+    assert starved["scaling_ok"] is None and starved["ok"]
+
+    # enough cores + sub-floor scaling: the gate fails
+    assert _block(pool_rps=10.0)["scaling_ok"] is False
+    assert not _block(pool_rps=10.0)["ok"]
+    # structural gates are unconditional
+    assert not _block(overlap_frac=0.0)["ok"]
+    assert not _block(overlap_frac=None)["ok"]
+    assert not _block(errors_total=1)["ok"]
+    assert not _block(degraded_errors_total=2)["ok"]
+    assert not _block(degraded_inline_total=0)["ok"]
+    assert not _block(degraded_health_green=False)["ok"]
+
+
+def test_assemble_serve_result_ands_frontend_block():
+    from bench import assemble_serve_result
+
+    kw = dict(backend="cpu", device_kind="cpu", requests_per_sec=10.0,
+              p50_ms=5.0, p99_ms=9.0, mean_batch_occupancy=0.8,
+              cache_hit_rate=0.5, cache_hits=4, requests_total=8,
+              errors_total=0)
+    assert assemble_serve_result(**kw)["ok"]
+    assert assemble_serve_result(**kw, frontend={"ok": True})["ok"]
+    out = assemble_serve_result(**kw, frontend={"ok": False})
+    assert out["ok"] is False and out["frontend"] == {"ok": False}

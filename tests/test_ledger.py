@@ -355,3 +355,32 @@ def test_cascade_direction_flows_into_verdicts(tmp_path):
     assert row["stage"] == "cascade"
     assert row["lower_is_better"] is True
     assert row["verdict"] == "regression" and ok is False
+
+
+def test_frontend_series_are_explicitly_declared():
+    """Satellite pin (PR 15): the frontend stage's series are DECLARED.
+    ``overlap_frac`` is the one the heuristic would get WRONG — no
+    rate/throughput token in the name, but the encode↔dispatch overlap
+    fraction dropping means the pool stopped hiding frontend work behind
+    device dispatches, which is the whole point of the pool."""
+    for metric in ("encode_p50_ms", "encode_p99_ms", "queue_wait_ms"):
+        assert EXPLICIT_SERIES[("frontend", metric)] is True, metric
+        assert lower_is_better(metric, "frontend") is True, metric
+    assert EXPLICIT_SERIES[("frontend", "overlap_frac")] is False
+    assert lower_is_better("overlap_frac", "frontend") is False
+
+
+def test_frontend_direction_flows_into_verdicts(tmp_path):
+    """An overlap_frac COLLAPSE under the frontend stage must go red end
+    to end — the serve artifact nests the frontend block one level down,
+    so this also pins that the walker assigns stage="frontend" there."""
+    for i in range(4):
+        _art(tmp_path, f"BENCH_t{i:02d}.json", emitted=1000 + i,
+             frontend={"overlap_frac": 0.6, "encode_p50_ms": 40.0})
+    _art(tmp_path, "BENCH_t99.json", emitted=2000,
+         frontend={"overlap_frac": 0.05, "encode_p50_ms": 40.0})
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    (row,) = [r for r in rows if r["metric"] == "overlap_frac"]
+    assert row["stage"] == "frontend"
+    assert row["lower_is_better"] is False
+    assert row["verdict"] == "regression" and ok is False
