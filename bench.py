@@ -1060,6 +1060,48 @@ def assemble_extraction_result(n_functions, n_workers, host_cpus,
     }
 
 
+def assemble_interproc_result(n_functions, n_call_edges, supergraph_build_ms,
+                              solve_ms, functions_per_sec, parity_ok,
+                              n_cross_findings, error=None):
+    """ONE-line block for the ``interproc`` stage
+    (``scripts/bench_extraction.py --interproc``): supergraph construction
+    cost plus the interprocedural taint solve per backend over a seeded
+    multi-function corpus. ``solve_ms`` maps backend name → milliseconds
+    and is flattened to ``solve_<backend>_ms`` keys so the ledger walker
+    picks each up as its own series. Gates: the zero-call-edge parity
+    property held during the run (``parity_ok`` — correctness is a
+    precondition of any perf number), and the seeded cross-function flows
+    were actually found (``n_cross_findings >= 1`` — a solver that is fast
+    because it found nothing is not a result)."""
+    ok = (error is None and parity_ok is True and n_cross_findings >= 1
+          and all(v is not None for v in solve_ms.values()))
+    return {
+        "metric": "interproc_supergraph_build_ms",
+        "value": (None if supergraph_build_ms is None
+                  else round(supergraph_build_ms, 3)),
+        "unit": "ms",
+        "backend": "cpu",
+        "device_kind": "host",
+        "interproc": {
+            "supergraph_build_ms": (
+                None if supergraph_build_ms is None
+                else round(supergraph_build_ms, 3)),
+            **{f"solve_{name}_ms": (None if ms is None else round(ms, 3))
+               for name, ms in sorted(solve_ms.items())},
+            "functions_per_sec": (
+                None if functions_per_sec is None
+                else round(functions_per_sec, 1)),
+        },
+        "n_functions": n_functions,
+        "n_call_edges": n_call_edges,
+        "n_cross_findings": n_cross_findings,
+        "parity_ok": parity_ok,
+        "error": error,
+        "ok": ok,
+        **_provenance_fields(),
+    }
+
+
 def bench_fused_train(corpus, n_batches: int, k: int,
                       dtype: str = "bfloat16", trials: int = 3):
     """The ``ggnn_fused_train`` stage: chained TRAIN steps (fwd + backward +

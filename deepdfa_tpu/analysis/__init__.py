@@ -5,7 +5,7 @@ DeepDFA's premise is that abstracted dataflow analysis finds bug classes
 pattern-matching misses; this package turns that discipline on the repo
 itself. One shared :class:`~deepdfa_tpu.analysis.model.ProjectModel`
 (module ASTs, import map, lite call graph, lock/thread/jit-entry
-indexes) feeds five passes, each emitting
+indexes) feeds six passes, each emitting
 :class:`~deepdfa_tpu.analysis.findings.Finding` records:
 
 =========  ==============================================================
@@ -18,6 +18,9 @@ jax        host-impure constructs reachable from jit entries; donated
 faults     fault points declared exactly once in ``faults.KNOWN_POINTS``,
            fired somewhere, chaos-tested, and mirrored in the generated
            README table (invariant 5)
+faultcov   every POINT_DOCS point ARMED (``faults.install/installed`` or
+           ``DEEPDFA_FAULTS``) by at least one test under ``tests/`` —
+           mention-in-a-string doesn't count (invariant 5, sharpened)
 metrics    ``deepdfa_*`` naming + exposition only through
            ``obs/registry.py`` (invariant 16)
 =========  ==============================================================
@@ -32,7 +35,7 @@ from __future__ import annotations
 import time
 from pathlib import Path
 
-from . import atomic, faultpoints, locks, metrics_pass, purity
+from . import atomic, faultcov, faultpoints, locks, metrics_pass, purity
 from .baseline import Baseline, DEFAULT_BASELINE_NAME
 from .findings import INVARIANT_IDS, Finding
 from .model import ProjectModel
@@ -48,6 +51,7 @@ PASSES = {
     "locks": locks.run,
     "jax": purity.run,
     "faults": faultpoints.run,
+    "faultcov": faultcov.run,
     "metrics": metrics_pass.run,
 }
 
@@ -58,7 +62,7 @@ def repo_root() -> Path:
 
 
 def run_passes(model: ProjectModel, passes=None):
-    """Run ``passes`` (default: all five) over ``model``.
+    """Run ``passes`` (default: all six) over ``model``.
 
     Returns ``(findings, stats)`` where stats maps pass name →
     ``{"findings": n, "seconds": wall}`` plus a ``"model"`` row with file

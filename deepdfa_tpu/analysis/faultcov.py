@@ -1,0 +1,147 @@
+"""Pass 6 — fault-point *arming* coverage (ROADMAP invariant 5, sharpened).
+
+The faults pass (:mod:`deepdfa_tpu.analysis.faultpoints`) checks that every
+declared point is *mentioned* in some ``pytest -m faults`` file — a regex
+over string literals. That is necessary but weak: a point named inside a
+docstring, a parse-only test, or a commented-out spec counts as covered
+while no test ever arms it. This pass closes the gap with the stronger
+contract: every point in ``faults.POINT_DOCS`` must be **armed** — passed
+to :func:`faults.install` / :func:`faults.installed` (string spec or dict
+form) or set through the ``DEEPDFA_FAULTS`` environment variable — by at
+least one test under ``tests/``.
+
+Detection is AST-based, never regex-over-text:
+
+- calls whose name ends in ``install`` / ``installed`` with a constant
+  string first argument → the argument is parsed with the real
+  :func:`faults.parse_spec` grammar (``point@1,2``, ``:p=``, ``;``-sep);
+- the same calls with a dict-literal first argument → the constant keys
+  are the armed points (``faults.installed({"joern.die": spec})``);
+- any call carrying a constant ``"DEEPDFA_FAULTS"`` argument followed by
+  a constant string (``monkeypatch.setenv``, ``env.setdefault``, ...) and
+  subscript stores ``env["DEEPDFA_FAULTS"] = "<spec>"`` → spec-parsed;
+- string constants assigned and *then* passed to install are out of reach
+  of a local analysis and intentionally don't count — arming must be
+  visible at the call site for the schedule to be reviewable.
+
+Findings carry the ``fault-coverage`` invariant id; suppressions go
+through ``analysis_baseline.json`` like every other pass. When the scanned
+tree does not contain ``resilience/faults.py`` (fixture trees) the pass is
+a no-op — coverage of the canonical registry is a property of this repo's
+``tests/``, not of arbitrary scanned code.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .findings import Finding
+from .model import ProjectModel
+
+PASS_NAME = "faultcov"
+
+_ARM_TAILS = ("install", "installed")
+
+
+def _spec_points(text: str) -> set[str]:
+    """Point names armed by one spec string, via the real grammar; a
+    malformed spec arms nothing (parse errors are the faults pass's
+    business, not coverage)."""
+    from deepdfa_tpu.resilience.faults import parse_spec
+
+    try:
+        return set(parse_spec(text))
+    except (ValueError, TypeError):
+        return set()
+
+
+def _dict_keys(node: ast.Dict) -> set[str]:
+    return {k.value for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+
+
+def _armed_in_tree(tree: ast.Module, env_var: str) -> set[str]:
+    """Every point the file arms, by the three detection shapes above."""
+    armed: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = ""
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if name in _ARM_TAILS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    armed |= _spec_points(arg.value)
+                elif isinstance(arg, ast.Dict):
+                    armed |= _dict_keys(arg)
+            # setenv("DEEPDFA_FAULTS", "<spec>") and friends: any call where
+            # a constant env_var argument is followed by a constant string
+            consts = [a.value for a in node.args
+                      if isinstance(a, ast.Constant) and isinstance(a.value, str)]
+            for i, v in enumerate(consts[:-1]):
+                if v == env_var:
+                    armed |= _spec_points(consts[i + 1])
+        elif isinstance(node, ast.Assign):
+            # env["DEEPDFA_FAULTS"] = "<spec>"
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.slice, ast.Constant)
+                        and tgt.slice.value == env_var
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    armed |= _spec_points(node.value.value)
+    return armed
+
+
+def armed_points(tests_dir: Path, env_var: str) -> dict[str, set[str]]:
+    """{test rel name: armed points} for every parseable tests/*.py."""
+    out: dict[str, set[str]] = {}
+    for path in sorted(tests_dir.glob("*.py")):
+        try:
+            tree = ast.parse(path.read_text())
+        except (SyntaxError, OSError):
+            continue
+        got = _armed_in_tree(tree, env_var)
+        if got:
+            out[path.name] = got
+    return out
+
+
+def run(model: ProjectModel) -> list[Finding]:
+    findings: list[Finding] = []
+    in_tree = any(rel.endswith("resilience/faults.py") for rel in model.modules)
+    if not in_tree:
+        return findings  # fixture tree: the contract binds this repo only
+    from deepdfa_tpu.resilience import faults
+
+    tests_dir = model.repo_root / "tests"
+    if not tests_dir.is_dir():
+        return findings
+    armed: set[str] = set()
+    for pts in armed_points(tests_dir, faults.ENV_VAR).values():
+        armed |= pts
+    faults_rel = next(rel for rel in model.modules
+                      if rel.endswith("resilience/faults.py"))
+    docs_line = 1
+    tree = model.modules[faults_rel].tree
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "POINT_DOCS"
+                for t in node.targets):
+            docs_line = node.lineno
+    for point in faults.POINT_DOCS:
+        if point not in armed:
+            findings.append(Finding(
+                file=faults_rel, line=docs_line,
+                invariant_id="fault-coverage", pass_name=PASS_NAME,
+                message=(
+                    f"fault point {point!r} is never ARMED by any test "
+                    "under tests/ — no faults.install/installed call or "
+                    "DEEPDFA_FAULTS assignment carries it; mentioning the "
+                    "point is not enough, a test must schedule it "
+                    "(invariant 5)"),
+            ))
+    return findings

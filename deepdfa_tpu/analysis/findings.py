@@ -16,6 +16,10 @@ vocabulary, mapped to the ROADMAP's standing invariants):
 ``fault-registry``  fault point not declared in ``faults.KNOWN_POINTS``,
                     declared but never fired, chaos-uncovered, or drifted
                     from the generated README table (invariant 5)
+``fault-coverage``  declared fault point never *armed* — no
+                    ``faults.install``/``installed`` call or
+                    ``DEEPDFA_FAULTS`` assignment in any test under
+                    ``tests/`` schedules it (invariant 5, sharpened)
 ``metrics``         metric family outside ``deepdfa_*`` naming or exposition
                     rendered outside ``obs/registry.py`` (invariant 16)
 ==================  ========================================================
@@ -34,6 +38,7 @@ INVARIANT_IDS = (
     "jit-purity",
     "donation",
     "fault-registry",
+    "fault-coverage",
     "metrics",
 )
 

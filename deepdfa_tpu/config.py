@@ -37,7 +37,33 @@ SINGLE_SUBKEYS = {"api": False, "datatype": True, "literal": False, "operator": 
 #   taint    — 0 untouched / 1 uses tainted var / 2 introduces taint.
 DFA_FAMILIES = ("live_out", "uninit", "taint")
 DFA_LIVE_OUT_CLIP = 16
-DFA_FEATURE_DIMS = {"live_out": DFA_LIVE_OUT_CLIP + 1, "uninit": 2, "taint": 3}
+
+# Interprocedural feature families (cpg/interproc.py), enabled by
+# ``FeatureConfig.interproc_families`` — separate flag so per-function
+# checkpoints keep their embed widths:
+#   ireach — reaching definitions owned by a DIFFERENT method (call-site
+#            parameter bindings count as the caller's), clipped;
+#   itaint — the taint code under root-seeded interprocedural taint:
+#            0/1/2 like ``taint``, escalated to 3 on nodes only a
+#            cross-call-boundary flow can taint.
+IDFA_FAMILIES = ("ireach", "itaint")
+IDFA_REACH_CLIP = 8
+DFA_FEATURE_DIMS = {
+    "live_out": DFA_LIVE_OUT_CLIP + 1, "uninit": 2, "taint": 3,
+    "ireach": IDFA_REACH_CLIP + 1, "itaint": 4,
+}
+
+
+def active_dfa_families(dataflow: bool, interproc: bool) -> tuple[str, ...]:
+    """The static-analysis families a (data, model) flag pair turns on, in
+    embedding order — the single place models/builders consult so the
+    concat layout can never skew between them."""
+    fams: tuple[str, ...] = ()
+    if dataflow:
+        fams += DFA_FAMILIES
+    if interproc:
+        fams += IDFA_FAMILIES
+    return fams
 
 
 @dataclass(frozen=True)
@@ -57,6 +83,10 @@ class FeatureConfig:
     # vocabulary subkeys; propagated to GGNNConfig.dataflow_families by
     # ExperimentConfig so the model widens its input in lockstep
     dataflow_families: bool = False
+    # emit the interprocedural families (IDFA_FAMILIES: ireach/itaint from
+    # cpg/interproc.py); propagated to GGNNConfig.interproc_families the
+    # same way — independent of dataflow_families
+    interproc_families: bool = False
 
     def __post_init__(self):
         for k in self.subkeys:
@@ -131,6 +161,9 @@ class GGNNConfig:
     # hidden_dim-sized embedding table per family, concatenated after the
     # subkey embeddings — usually set via FeatureConfig.dataflow_families
     dataflow_families: bool = False
+    # widen with the interprocedural families (IDFA_FAMILIES) the same way
+    # — usually set via FeatureConfig.interproc_families
+    interproc_families: bool = False
     # fused-layout backward tier: auto (Pallas training kernel when
     # fits_vmem_train admits the bucket, else XLA recompute) | pallas | xla
     bwd_kernel: str = "auto"
@@ -141,8 +174,8 @@ class GGNNConfig:
         all four subkey embeddings (``ggnn.py:47-64``), plus one hidden_dim
         slice per static-analysis family when enabled."""
         mult = len(ALL_SUBKEYS) if self.concat_all_absdf else 1
-        if self.dataflow_families:
-            mult += len(DFA_FAMILIES)
+        mult += len(active_dfa_families(self.dataflow_families,
+                                        self.interproc_families))
         return 2 * self.hidden_dim * mult
 
 
@@ -558,6 +591,10 @@ class ExperimentConfig:
         if self.data.feature.dataflow_families and not self.model.dataflow_families:
             object.__setattr__(
                 self, "model", dataclasses.replace(self.model, dataflow_families=True)
+            )
+        if self.data.feature.interproc_families and not self.model.interproc_families:
+            object.__setattr__(
+                self, "model", dataclasses.replace(self.model, interproc_families=True)
             )
 
     @property

@@ -22,6 +22,24 @@ invariants explicitly and returns structured :class:`Diagnostic` records:
   opaque call);
 - ``no-method`` (error) — a CPG with no METHOD node at all.
 
+The call-graph contract (the interprocedural layer,
+:mod:`deepdfa_tpu.cpg.interproc`): supergraph construction is total — a
+malformed callee reference degrades to a summarized external, never a
+KeyError — and THESE checks are where the degradation surfaces as
+quarantine-compatible rows:
+
+- ``call-ref-malformed`` (error) — a CALL carrying ARGUMENT children but
+  an empty callee name: neither resolvable to a METHOD nor summarizable
+  by name;
+- ``call-ref-ambiguous`` (warning) — two METHODs share one name, so call
+  resolution (lowest METHOD id) is arbitrary;
+- ``call-arity`` (warning) — a resolved call whose ARGUMENT count differs
+  from the callee's METHOD_PARAMETER_IN count (the supergraph binds the
+  common prefix and leaves the rest unconstrained);
+- ``call-no-return`` (warning) — a resolved callee METHOD without a
+  METHOD_RETURN child (the supergraph links parameters but cannot route
+  the return value).
+
 ``severity`` is ``"error"`` for invariants whose violation corrupts
 features (ingestion drops the graph) and ``"warning"`` for oddities worth
 surfacing but survivable. :func:`validate_corpus` aggregates per-dataset
@@ -190,7 +208,69 @@ def validate_cpg(cpg: CPG) -> list[Diagnostic]:
                 node=n.id,
             ))
 
+    diags.extend(_call_ref_diagnostics(cpg))
+
     diags.sort(key=lambda d: (d.severity != "error", d.check))
+    return diags
+
+
+def _call_ref_diagnostics(cpg: CPG) -> list[Diagnostic]:
+    """The call-graph contract: every shape supergraph construction
+    degrades on becomes a diagnostic row here (same resolution rules as
+    ``cpg.callgraph.build_callgraph`` — by METHOD name, lowest id wins)."""
+    from deepdfa_tpu.cpg.callgraph import build_callgraph
+
+    diags: list[Diagnostic] = []
+    cg = build_callgraph(cpg)
+    for name in cg.ambiguous:
+        diags.append(Diagnostic(
+            "call-ref-ambiguous", "warning",
+            f"method name {name!r} is defined by multiple METHOD nodes — "
+            "call resolution picks the lowest id; rename or split the CPG",
+            node=cg.methods.get(name),
+        ))
+    warned_no_return: set[int] = set()
+    for site in cg.sites:
+        call = cpg.nodes.get(site.call)
+        if call is None:
+            continue
+        if not site.name and cpg.arguments(site.call):
+            diags.append(Diagnostic(
+                "call-ref-malformed", "error",
+                f"call {site.call} has ARGUMENT children but an empty "
+                "callee name — not resolvable, not summarizable",
+                node=site.call,
+            ))
+            continue
+        if site.callee is None:
+            continue  # summarized external: by design, not a diagnostic
+        callee = cpg.nodes.get(site.callee)
+        n_params = sum(
+            1 for d in cpg.successors(site.callee, "AST")
+            if d in cpg.nodes and cpg.nodes[d].label == "METHOD_PARAMETER_IN"
+        )
+        n_args = len(cpg.arguments(site.call))
+        if n_args != n_params:
+            diags.append(Diagnostic(
+                "call-arity", "warning",
+                f"call {site.call} passes {n_args} argument(s) but method "
+                f"{callee.name!r} declares {n_params} parameter(s) — the "
+                "supergraph binds only the common prefix",
+                node=site.call,
+            ))
+        has_return = any(
+            d in cpg.nodes and cpg.nodes[d].label == "METHOD_RETURN"
+            for d in cpg.successors(site.callee, "AST")
+        )
+        if not has_return and site.callee not in warned_no_return:
+            warned_no_return.add(site.callee)
+            diags.append(Diagnostic(
+                "call-no-return", "warning",
+                f"resolved callee METHOD {site.callee} ({callee.name!r}) "
+                "has no METHOD_RETURN child — the supergraph cannot route "
+                "its return value",
+                node=site.callee,
+            ))
     return diags
 
 

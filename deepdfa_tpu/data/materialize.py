@@ -203,6 +203,17 @@ class CorpusBuilder:
                     feat_ids[f"_DFA_{fam}"] = {
                         n: min(max(int(v), 0), dim - 1) for n, v in values.items()
                     }
+            if self.feature.interproc_families:
+                # interprocedural families run per-graph: a corpus graph is
+                # one parse unit (a file's functions), so the supergraph is
+                # built over that unit only — no cross-graph call resolution
+                from deepdfa_tpu.cpg.interproc import interproc_node_features
+
+                for fam, values in interproc_node_features(cpg).items():
+                    dim = DFA_FEATURE_DIMS[fam]
+                    feat_ids[f"_DFA_{fam}"] = {
+                        n: min(max(int(v), 0), dim - 1) for n, v in values.items()
+                    }
             g = graph_from_cpg(
                 cpg,
                 gid,

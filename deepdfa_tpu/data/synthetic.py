@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from deepdfa_tpu.config import ALL_SUBKEYS, DFA_FAMILIES, DFA_FEATURE_DIMS
+from deepdfa_tpu.config import ALL_SUBKEYS, DFA_FEATURE_DIMS, active_dfa_families
 from deepdfa_tpu.data.graphs import Graph
 
 __all__ = ["random_graph", "random_dataset"]
@@ -26,6 +26,7 @@ def random_graph(
     vul: bool | None = None,
     def_rate: float = 0.35,
     dataflow_families: bool = False,
+    interproc_families: bool = False,
 ) -> Graph:
     n = max(3, int(rng.lognormal(mean=np.log(mean_nodes), sigma=0.6)))
     # CFG backbone: a chain with branch/merge shortcuts, like real control flow.
@@ -46,13 +47,13 @@ def random_graph(
     ids = rng.integers(1, input_dim, size=n, dtype=np.int32)
     feats["_ABS_DATAFLOW"] = np.where(is_def, ids, 0).astype(np.int32)
 
-    if dataflow_families:
-        # static-analysis families (config.DFA_FAMILIES): values drawn from
-        # each family's closed range, like preprocess emits them
-        for fam in DFA_FAMILIES:
-            feats[f"_DFA_{fam}"] = rng.integers(
-                0, DFA_FEATURE_DIMS[fam], size=n, dtype=np.int32
-            )
+    for fam in active_dfa_families(dataflow_families, interproc_families):
+        # static-analysis families (config.DFA_FAMILIES / IDFA_FAMILIES):
+        # values drawn from each family's closed range, like preprocess
+        # emits them
+        feats[f"_DFA_{fam}"] = rng.integers(
+            0, DFA_FEATURE_DIMS[fam], size=n, dtype=np.int32
+        )
 
     if vul is None:
         vul = bool(rng.random() < 0.06)
@@ -81,6 +82,7 @@ def random_dataset(
     mean_nodes: int = 50,
     vul_rate: float = 0.06,
     dataflow_families: bool = False,
+    interproc_families: bool = False,
 ) -> list[Graph]:
     rng = np.random.default_rng(seed)
     out = []
@@ -89,6 +91,7 @@ def random_dataset(
             rng, input_dim=input_dim, mean_nodes=mean_nodes,
             vul=bool(rng.random() < vul_rate),
             dataflow_families=dataflow_families,
+            interproc_families=interproc_families,
         )
         g.gid = i
         out.append(g)

@@ -34,7 +34,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepdfa_tpu.config import ALL_SUBKEYS, DFA_FAMILIES, DFA_FEATURE_DIMS, GGNNConfig
+from deepdfa_tpu.config import (
+    ALL_SUBKEYS,
+    DFA_FEATURE_DIMS,
+    GGNNConfig,
+    active_dfa_families,
+)
 from deepdfa_tpu.data.graphs import BatchedGraphs
 from deepdfa_tpu.ops.segment import gather, segment_softmax, segment_sum
 
@@ -217,10 +222,12 @@ class GGNN(nn.Module):
                 self.input_dim, embed_dim, dtype=self.compute_dtype, name="embed"
             )
             hidden_dim = cfg.hidden_dim
-        if cfg.dataflow_families:
-            # static-analysis families (liveness/uninit/taint): small closed
-            # value sets, one hidden_dim-wide table each, concatenated after
-            # the subkey embeddings (widths from config.DFA_FEATURE_DIMS)
+        fams = active_dfa_families(cfg.dataflow_families, cfg.interproc_families)
+        if fams:
+            # static-analysis families (liveness/uninit/taint, plus the
+            # interprocedural ireach/itaint): small closed value sets, one
+            # hidden_dim-wide table each, concatenated after the subkey
+            # embeddings (widths from config.DFA_FEATURE_DIMS)
             self.dfa_embeddings = {
                 fam: nn.Embed(
                     DFA_FEATURE_DIMS[fam],
@@ -228,10 +235,10 @@ class GGNN(nn.Module):
                     dtype=self.compute_dtype,
                     name=f"embed_dfa_{fam}",
                 )
-                for fam in DFA_FAMILIES
+                for fam in fams
             }
-            embed_dim += cfg.hidden_dim * len(DFA_FAMILIES)
-            hidden_dim += cfg.hidden_dim * len(DFA_FAMILIES)
+            embed_dim += cfg.hidden_dim * len(fams)
+            hidden_dim += cfg.hidden_dim * len(fams)
         # factory hook: GGNNFused swaps in the Pallas VMEM-resident conv
         # under the same "ggnn" scope, keeping the parameter tree identical
         self.ggnn = self._conv(hidden_dim)
@@ -261,12 +268,14 @@ class GGNN(nn.Module):
         # same fused-gather trick as the subkey tables: the family tables
         # differ in row count but share the hidden width, so they stack along
         # axis 0 with cumulative row offsets into the ids.
+        fams = active_dfa_families(self.cfg.dataflow_families,
+                                   self.cfg.interproc_families)
         table = jnp.concatenate(
-            [self.dfa_embeddings[fam].embedding for fam in DFA_FAMILIES], axis=0
+            [self.dfa_embeddings[fam].embedding for fam in fams], axis=0
         ).astype(self.compute_dtype)
         ids_cols = []
         offset = 0
-        for fam in DFA_FAMILIES:
+        for fam in fams:
             ids_cols.append(batch.node_feats[f"_DFA_{fam}"] + offset)
             offset += DFA_FEATURE_DIMS[fam]
         ids = jnp.stack(ids_cols, axis=-1)
@@ -294,7 +303,7 @@ class GGNN(nn.Module):
             out = out.reshape(*ids.shape[:-1], -1)
         else:
             out = self.embedding(batch.node_feats["_ABS_DATAFLOW"])
-        if self.cfg.dataflow_families:
+        if self.cfg.dataflow_families or self.cfg.interproc_families:
             out = jnp.concatenate([out, self._embed_dfa(batch)], axis=-1)
         return out
 

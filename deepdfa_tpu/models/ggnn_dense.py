@@ -35,7 +35,12 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from deepdfa_tpu.config import ALL_SUBKEYS, DFA_FAMILIES, DFA_FEATURE_DIMS, GGNNConfig
+from deepdfa_tpu.config import (
+    ALL_SUBKEYS,
+    DFA_FEATURE_DIMS,
+    GGNNConfig,
+    active_dfa_families,
+)
 from deepdfa_tpu.data.dense import DenseBatch
 from deepdfa_tpu.models.ggnn import GRUCell
 
@@ -134,7 +139,8 @@ class GGNNDense(nn.Module):
                 self.input_dim, embed_dim, dtype=self.compute_dtype, name="embed"
             )
             hidden_dim = cfg.hidden_dim
-        if cfg.dataflow_families:
+        fams = active_dfa_families(cfg.dataflow_families, cfg.interproc_families)
+        if fams:
             # lockstep with GGNN.setup — same table names/shapes so the
             # parameter trees stay checkpoint-interchangeable
             self.dfa_embeddings = {
@@ -144,10 +150,10 @@ class GGNNDense(nn.Module):
                     dtype=self.compute_dtype,
                     name=f"embed_dfa_{fam}",
                 )
-                for fam in DFA_FAMILIES
+                for fam in fams
             }
-            embed_dim += cfg.hidden_dim * len(DFA_FAMILIES)
-            hidden_dim += cfg.hidden_dim * len(DFA_FAMILIES)
+            embed_dim += cfg.hidden_dim * len(fams)
+            hidden_dim += cfg.hidden_dim * len(fams)
         self.ggnn = GatedGraphConvDense(
             out_feats=hidden_dim,
             n_steps=cfg.n_steps,
@@ -169,12 +175,15 @@ class GGNNDense(nn.Module):
 
     def _embed_dfa(self, batch: DenseBatch) -> jnp.ndarray:
         # lockstep with GGNN._embed_dfa, shapes [G, n] instead of [N]
+        fams = active_dfa_families(
+            self.cfg.dataflow_families, self.cfg.interproc_families
+        )
         table = jnp.concatenate(
-            [self.dfa_embeddings[fam].embedding for fam in DFA_FAMILIES], axis=0
+            [self.dfa_embeddings[fam].embedding for fam in fams], axis=0
         ).astype(self.compute_dtype)
         ids_cols = []
         offset = 0
-        for fam in DFA_FAMILIES:
+        for fam in fams:
             ids_cols.append(batch.node_feats[f"_DFA_{fam}"] + offset)
             offset += DFA_FEATURE_DIMS[fam]
         ids = jnp.stack(ids_cols, axis=-1)
@@ -199,7 +208,7 @@ class GGNNDense(nn.Module):
             out = out.reshape(*ids.shape[:-1], -1)
         else:
             out = self.embedding(batch.node_feats["_ABS_DATAFLOW"])
-        if self.cfg.dataflow_families:
+        if self.cfg.dataflow_families or self.cfg.interproc_families:
             out = jnp.concatenate([out, self._embed_dfa(batch)], axis=-1)
         return out
 

@@ -62,13 +62,14 @@ class GGNNMegabatch(GGNN):
 
     def setup(self):
         cfg = self.cfg
-        if not cfg.concat_all_absdf or cfg.dataflow_families:
+        if not cfg.concat_all_absdf or cfg.dataflow_families or cfg.interproc_families:
             raise ValueError(
                 "layout=megabatch supports the concat-subkey abstract-"
                 "dataflow config only (concat_all_absdf=True, "
-                "dataflow_families=False) — the whole-model kernel's embed "
-                "prologue hard-codes the stacked-table gather; use "
-                "layout=segment/fused for other embedding configs"
+                "dataflow_families=False, interproc_families=False) — the "
+                "whole-model kernel's embed prologue hard-codes the "
+                "stacked-table gather; use layout=segment/fused for other "
+                "embedding configs"
             )
         if cfg.label_style != "graph" or cfg.encoder_mode:
             raise ValueError(

@@ -116,6 +116,16 @@ EXPLICIT_SERIES: dict[tuple[str, str], bool] = {
     ("frontend", "encode_p99_ms"): True,
     ("frontend", "queue_wait_ms"): True,
     ("frontend", "overlap_frac"): False,
+    # the interproc stage (scripts/bench_extraction.py --interproc):
+    # supergraph construction and the per-backend interprocedural taint
+    # solves go down; corpus throughput through the whole pipeline
+    # (build + solve) goes up. "_ms" suffixes would trip the heuristic
+    # anyway — declared so the directions are contractual, not inferred.
+    ("interproc", "supergraph_build_ms"): True,
+    ("interproc", "solve_sets_ms"): True,
+    ("interproc", "solve_bitvec_ms"): True,
+    ("interproc", "solve_native_ms"): True,
+    ("interproc", "functions_per_sec"): False,
 }
 
 

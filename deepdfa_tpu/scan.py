@@ -121,6 +121,48 @@ def _cascade_rescore(tier2, band, rows: list[dict], graphs: list,
         row["vulnerable_probability"] = round(float(p), 6)
 
 
+def _interproc_report(sources: list[tuple[str, str]]) -> dict:
+    """Whole-unit interprocedural pass over the scanned sources: parse each
+    file, merge the per-file CPGs into ONE graph (so calls resolve across
+    file boundaries too), build the call-graph supergraph, and run the
+    cross-function taint differential (``cpg.interproc``). Findings are the
+    taint flows a per-function scan provably cannot see — the source API is
+    in the caller, the sink in the callee. Per-file parse failures degrade
+    to error rows; this never aborts the scan."""
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.cpg.interproc import (
+        build_supergraph, cross_function_taint, merge_cpgs)
+
+    cpgs, errors = [], []
+    for name, code in sources:
+        try:
+            cpgs.append(parse_source(code))
+        except Exception as exc:  # noqa: BLE001 — one error row per file
+            errors.append({"file": name, "error": f"{type(exc).__name__}: {exc}"})
+    if not cpgs:
+        return {"n_files_parsed": 0, "errors": errors, "findings": [],
+                "attribution": {}, "call_edges": 0, "functions": 0}
+    merged, _ = merge_cpgs(cpgs)
+    try:
+        sg = build_supergraph(merged)
+        cross = cross_function_taint(sg)
+    except Exception as exc:  # noqa: BLE001 — degrade, never abort
+        logger.warning("scan --interproc: supergraph pass failed (%s: %s)",
+                       type(exc).__name__, exc)
+        errors.append({"file": "<merged>",
+                       "error": f"{type(exc).__name__}: {exc}"})
+        return {"n_files_parsed": len(cpgs), "errors": errors, "findings": [],
+                "attribution": {}, "call_edges": 0, "functions": 0}
+    return {
+        "n_files_parsed": len(cpgs),
+        "errors": errors,
+        "findings": cross["findings"],
+        "attribution": cross["attribution"],
+        "call_edges": sg.n_call_edges,
+        "functions": len(sg.callgraph.methods),
+    }
+
+
 def scan_paths(
     paths: Sequence[str | Path],
     vocabs,
@@ -132,6 +174,7 @@ def scan_paths(
     cache_dir: str | Path | None = None,
     attempts_per_item: int = 2,
     frontend=None,
+    interproc: bool = False,
 ) -> dict:
     """Scan ``paths``; returns the report dict (also what ``scan.json``
     records). Per-file failures are error rows; nothing aborts the scan."""
@@ -191,6 +234,8 @@ def scan_paths(
         "pool": pool.report(),
         "cache": cache.stats() if cache is not None else None,
     }
+    if interproc:
+        report["interproc"] = _interproc_report(sources)
     if tier2 is not None:
         report["cascade"] = {
             "band": [float(tier2_band[0]), float(tier2_band[1])],
@@ -210,7 +255,7 @@ def scan_paths(
 def scan_command(cfg, run_dir: Path, targets: Sequence[str], *,
                  ckpt_dir: Path | None = None, artifact: str | None = None,
                  workers: int = 4, cache_dir: Path | None = None,
-                 cascade: bool = False) -> dict:
+                 cascade: bool = False, interproc: bool = False) -> dict:
     """The CLI entry: resolve vocabs from the config's shard dir, build a
     scoring engine when a checkpoint/artifact is given (scan still runs
     encode-only without one), write ``scan.json`` atomically."""
@@ -258,7 +303,7 @@ def scan_command(cfg, run_dir: Path, targets: Sequence[str], *,
         tier2_band=(ccfg.band_lo, ccfg.band_hi), n_workers=workers,
         cache_dir=cache_dir if cache_dir is not None
         else run_dir / "extract_cache",
-        frontend=cfg.serve.frontend)
+        frontend=cfg.serve.frontend, interproc=interproc)
     atomic_write_text(run_dir / "scan.json", json.dumps(report, indent=2))
     print(json.dumps({k: v for k, v in report.items() if k != "results"},
                      sort_keys=True), flush=True)

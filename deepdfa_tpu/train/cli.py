@@ -1254,6 +1254,13 @@ def main(argv: Sequence[str] | None = None) -> dict:
                         "through the tier-2 joint engine (needs "
                         "serve.cascade.joint_dir); rows record the "
                         "answering tier and the tier-1 score")
+    parser.add_argument("--interproc", action="store_true",
+                        help="scan: additionally score the target as ONE "
+                        "unit — merge every file's CPG, build the call-"
+                        "graph supergraph, and report cross-function taint "
+                        "flows (source API in the caller, sink in the "
+                        "callee) with per-function attribution in "
+                        "scan.json['interproc']")
     parser.add_argument("--saliency", choices=("occlusion", "gate"),
                         default="occlusion",
                         help="predict statement ranking: occlusion = per-"
@@ -1357,7 +1364,7 @@ def main(argv: Sequence[str] | None = None) -> dict:
                 ckpt_dir=Path(args.ckpt_dir) if args.ckpt_dir else None,
                 artifact=args.artifact, workers=args.workers,
                 cache_dir=Path(args.cache_dir) if args.cache_dir else None,
-                cascade=args.cascade)
+                cascade=args.cascade, interproc=args.interproc)
         return analyze(cfg, run_dir)
     except Exception:
         # crash marker parity: rename log to .log.error (main_cli.py:324-336).

@@ -127,6 +127,91 @@ def _pool_bench(args) -> dict:
     return result
 
 
+def _interproc_corpus(n_chains: int) -> list[str]:
+    """``n_chains`` three-function translation units, each a seeded
+    cross-function taint chain: the source API fires in ``root_j``, the
+    buffer rides two calls down, and the sink runs in ``leaf_j`` — the
+    flow only the supergraph can connect."""
+    units = []
+    for j in range(n_chains):
+        units.append(f"""
+int leaf_{j}(char *data) {{ char local[64]; strcpy(local, data); return local[0]; }}
+int mid_{j}(char *buf) {{ int r; r = leaf_{j}(buf); return r; }}
+int root_{j}(void) {{ char buf[64]; int r; gets(buf); r = mid_{j}(buf); return r; }}
+""")
+    return units
+
+
+def _interproc_bench(args) -> dict:
+    """The ``interproc`` ledger stage: supergraph construction + the
+    qualified interprocedural taint solve per backend over the seeded
+    chain corpus, gated on (a) zero-call-edge parity holding on a
+    single-function control corpus and (b) every seeded chain actually
+    producing cross-function findings."""
+    from bench import assemble_interproc_result
+    from deepdfa_tpu.cpg import analyses
+    from deepdfa_tpu.cpg.frontend import parse_function, parse_source
+    from deepdfa_tpu.cpg.interproc import (
+        build_supergraph,
+        cross_function_taint,
+        merge_cpgs,
+        solve_interproc_analysis,
+        solve_interproc_taint,
+    )
+
+    units = _interproc_corpus(args.chains)
+    merged, _ = merge_cpgs([parse_source(u) for u in units])
+    n_functions = sum(1 for n in merged.nodes.values() if n.label == "METHOD")
+
+    # correctness gate 1: zero-call-edge parity on a single-function
+    # control corpus (the tests/test_interproc.py property, sampled)
+    parity_ok = True
+    for src in _corpus(8):
+        cpg = parse_function(src)
+        for name in ("reaching_defs", "taint"):
+            ref = analyses.solve_analysis(name, cpg, backend="bitvec")
+            for backend in ("bitvec", "native"):
+                got = solve_interproc_analysis(name, cpg, backend=backend)
+                if (got.in_facts != ref.in_facts
+                        or got.out_facts != ref.out_facts):
+                    parity_ok = False
+
+    reps = max(1, args.reps)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sg = build_supergraph(merged)
+    build_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    solve_ms = {}
+    for backend in ("sets", "bitvec", "native"):
+        solver = analyses._BACKENDS[backend]
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            solve_interproc_taint(sg, solver=solver)
+        solve_ms[backend] = (time.perf_counter() - t0) / reps * 1e3
+
+    # correctness gate 2: every seeded chain is caught, attributed to root
+    cross = cross_function_taint(sg)
+    chains_caught = sum(1 for j in range(args.chains)
+                        if f"leaf_{j}" in cross["attribution"])
+
+    fps = n_functions / ((build_ms + solve_ms["native"]) / 1e3)
+    result = assemble_interproc_result(
+        n_functions=n_functions,
+        n_call_edges=sg.n_call_edges,
+        supergraph_build_ms=build_ms,
+        solve_ms=solve_ms,
+        functions_per_sec=fps,
+        parity_ok=parity_ok,
+        n_cross_findings=len(cross["findings"]),
+    )
+    result["n_chains"] = args.chains
+    result["chains_caught"] = chains_caught
+    result["reps"] = reps
+    print(json.dumps(result))
+    return result
+
+
 def main(argv=None) -> dict:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=300)
@@ -137,10 +222,19 @@ def main(argv=None) -> dict:
     ap.add_argument("--pool-workers", type=int, default=8)
     ap.add_argument("--cache-dir", default=None,
                     help="--pool: cache dir (default: a fresh temp dir)")
+    ap.add_argument("--interproc", action="store_true",
+                    help="run the interprocedural supergraph + solver stage "
+                    "over a seeded cross-function taint corpus")
+    ap.add_argument("--chains", type=int, default=12,
+                    help="--interproc: number of 3-function taint chains")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="--interproc: timing repetitions per measurement")
     args = ap.parse_args(argv)
 
     if args.pool:
         return _pool_bench(args)
+    if args.interproc:
+        return _interproc_bench(args)
 
     import pandas as pd
 

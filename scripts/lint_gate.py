@@ -11,11 +11,15 @@ routing, tier-2 queue policy, invariant-24 degradation chaos) + the
 frontend encode-pool suite (``pytest -m 'frontend and not slow'``:
 bounded-queue backpressure, worker-crash exactly-once re-queue,
 invariant-25 degrade-to-inline through the real server) + the
+interprocedural-dataflow suite (``pytest -m 'interproc and not slow'``:
+call-graph/supergraph construction, the cross-function taint catch, the
+zero-call-edge solver parity property) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
-lock-order, jit-purity/donation, fault-registry, metrics conformance
-static passes) + the perf-regression ledger (``python -m
-deepdfa_tpu.obs.ledger --check .``: the committed bench artifacts judged
-against their own per-device-kind history) in one command.
+lock-order, jit-purity/donation, fault-registry, fault-arming coverage,
+metrics conformance static passes) + the perf-regression ledger
+(``python -m deepdfa_tpu.obs.ledger --check .``: the committed bench
+artifacts judged against their own per-device-kind history) in one
+command.
 
 No step touches an accelerator, compiles XLA, or takes more than a few
 seconds, so this is safe to run on every commit: ruff catches the syntax/
@@ -126,8 +130,21 @@ def main() -> int:
     if proc.returncode != 0:
         failures.append("frontend")
 
+    # the interprocedural-dataflow suite: call graph + supergraph
+    # construction, the cross-function taint catch on the seeded fixture,
+    # zero-call-edge solver parity across all three backends — pure
+    # host-side solver logic, pre-commit cadence
+    print("lint_gate: pytest -m 'interproc and not slow'")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "interproc and not slow",
+         "-q", "tests/test_interproc.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("interproc")
+
     # step 5: the invariant gate — AST passes for atomic-commit,
-    # lock-order, jit-purity/donation, fault-registry and metrics
+    # lock-order, jit-purity/donation, fault-registry, fault-arming
+    # coverage (every POINT_DOCS point armed by a test) and metrics
     # conformance; nonzero on any finding not in analysis_baseline.json
     print("lint_gate: python -m deepdfa_tpu.analysis --json "
           "deepdfa_tpu/ scripts/")

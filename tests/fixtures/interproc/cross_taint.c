@@ -1,0 +1,17 @@
+/* Seeded cross-function taint: the source API fires in f (gets), the
+   tainted buffer is PASSED to g, and the sink runs in g — no source API
+   is ever called inside g, so a per-function taint analysis of g sees
+   nothing. Only the call-graph supergraph can connect the flow. */
+
+void g(char *data) {
+    char local[64];
+    strcpy(local, data);
+    system(local);
+}
+
+int f(void) {
+    char buf[64];
+    gets(buf);
+    g(buf);
+    return 0;
+}

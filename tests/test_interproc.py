@@ -1,0 +1,331 @@
+"""Interprocedural dataflow: call-graph + supergraph + cross-function
+reaching-defs/taint (``cpg/callgraph.py``, ``cpg/interproc.py``).
+
+The two acceptance properties this file pins:
+
+- **cross-function catch**: the seeded fixture's vulnerability (source API
+  in ``f``, sink in ``g``) is provably invisible to per-function
+  source-API taint — every node of ``g`` codes 0 intraprocedurally — and
+  is found, with attribution back to ``f``, by the supergraph analysis
+  and by ``deepdfa-tpu scan --interproc``;
+- **zero-call-edge parity**: on a CPG with no resolved call edges the
+  interprocedural solutions are bit-equal to the PR 1 intraprocedural
+  ``solve_analysis`` fixpoints, on every realworld fixture, across all
+  three solver backends.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.cpg.analyses import (
+    DEFAULT_TAINT_SOURCES,
+    _taint_static,
+    solve_analysis,
+)
+from deepdfa_tpu.cpg.callgraph import build_callgraph
+from deepdfa_tpu.cpg.frontend import parse_source
+from deepdfa_tpu.cpg.interproc import (
+    IPROC_ANALYSES,
+    _outer_taint_solve,
+    build_supergraph,
+    cross_function_taint,
+    interproc_node_features,
+    interproc_taint_node_codes,
+    merge_cpgs,
+    solve_interproc_analysis,
+)
+from deepdfa_tpu.cpg.schema import CPG, Node
+from deepdfa_tpu.cpg.validate import validate_cpg
+
+pytestmark = pytest.mark.interproc
+
+FIXTURE = Path(__file__).parent / "fixtures" / "interproc" / "cross_taint.c"
+REALWORLD = sorted(
+    (Path(__file__).parent / "fixtures" / "realworld").glob("*.c"))
+
+TWO_FN = """
+int helper(int a, int b) { int s; s = a + b; return s; }
+int top(int x) { int y; y = helper(x, 1); return y; }
+"""
+
+
+# ------------------------------------------------------------- call graph
+
+
+def test_callgraph_resolves_direct_calls_and_summarizes_externals():
+    cpg = parse_source(TWO_FN)
+    cg = build_callgraph(cpg)
+    by_name = {n.id: n.name for n in cpg.nodes.values() if n.label == "METHOD"}
+    edges = {(by_name[f], by_name[g]) for f, g in cg.edges}
+    assert edges == {("top", "helper")}
+    assert cg.n_call_edges == 1
+    # 'top' is a root (nobody calls it); 'helper' is not
+    root_names = {by_name[m] for m in cg.root_methods()}
+    assert root_names == {"top"}
+
+
+def test_callgraph_externals_and_ambiguity_never_raise():
+    cpg = parse_source(
+        "int f(void){ int x; x = unknown_lib(3); return x; }")
+    cg = build_callgraph(cpg)
+    assert cg.n_call_edges == 0
+    assert "unknown_lib" in cg.external
+    # two METHODs sharing a name: resolution degrades to lowest-id + a
+    # recorded ambiguity, never an exception
+    nodes = list(cpg.nodes.values())
+    nid = max(cpg.nodes) + 1
+    nodes.append(Node(id=nid, label="METHOD", name="f", code="f"))
+    dup = CPG(nodes, list(cpg.edges))
+    cg2 = build_callgraph(dup)
+    assert "f" in cg2.ambiguous
+
+
+# ------------------------------------------------------------- supergraph
+
+
+def test_supergraph_links_params_and_returns():
+    cpg = parse_source(TWO_FN)
+    sg = build_supergraph(cpg)
+    assert sg.n_call_edges == 1
+    # helper(a, b): one binding per parameter, chained call -> b1 -> b2 -> METHOD
+    assert len(sg.param_binds) == 2
+    assert len(sg.return_binds) == 1
+    # the base CPG is untouched and fully embedded
+    assert set(cpg.nodes) <= set(sg.cpg.nodes)
+    assert set(cpg.edges) <= set(sg.cpg.edges)
+    # every node (bindings included) has an owner METHOD
+    for b, (_, fmid, gmid) in sg.param_binds.items():
+        assert sg.owner[b] == fmid  # bindings belong to the CALLER
+        assert sg.method_names[gmid] == "helper"
+
+
+def test_supergraph_total_on_malformed_graphs():
+    """Dangling callee refs / empty names degrade, never KeyError."""
+    cpg = parse_source(TWO_FN)
+    nodes = list(cpg.nodes.values())
+    edges = list(cpg.edges)
+    # an empty-name CALL with an ARGUMENT child, wired into the CFG
+    some_cfg = next(s for s, d, e in edges if e == "CFG")
+    nid = max(cpg.nodes) + 1
+    nodes.append(Node(id=nid, label="CALL", name="", code="(*fp)(x)"))
+    nodes.append(Node(id=nid + 1, label="IDENTIFIER", name="x", code="x",
+                      order=1))
+    edges += [(nid, nid + 1, "AST"), (nid, nid + 1, "ARGUMENT"),
+              (some_cfg, nid, "CFG")]
+    bad = CPG(nodes, edges)
+    sg = build_supergraph(bad)  # must not raise
+    assert sg.n_call_edges == 1  # the well-formed edge still links
+    diags = validate_cpg(bad)
+    assert any(d.check == "call-ref-malformed" and d.severity == "error"
+               for d in diags)
+
+
+def test_validate_reports_ambiguous_and_arity_rows():
+    cpg = parse_source(TWO_FN)
+    nodes = list(cpg.nodes.values())
+    nid = max(cpg.nodes) + 1
+    nodes.append(Node(id=nid, label="METHOD", name="helper", code="helper"))
+    dup = CPG(nodes, list(cpg.edges))
+    checks = {d.check for d in validate_cpg(dup)}
+    assert "call-ref-ambiguous" in checks
+
+    # drop one of helper's parameters: the resolved call now over-passes
+    trimmed = [
+        n for n in cpg.nodes.values()
+        if not (n.label == "METHOD_PARAMETER_IN" and n.name == "b")
+    ]
+    kept = {n.id for n in trimmed}
+    arity = CPG(trimmed, [(s, d, e) for s, d, e in cpg.edges
+                          if s in kept and d in kept])
+    assert any(d.check == "call-arity" for d in validate_cpg(arity))
+    build_supergraph(arity)  # binds the common prefix, never raises
+
+
+# --------------------------------------- acceptance: cross-function catch
+
+
+def test_cross_function_vuln_missed_per_function_caught_interproc():
+    """The seeded fixture: ``gets`` fires in f, the sink runs in g. Under
+    per-function source-API taint every node of g codes 0 (no source is
+    called inside g — scoring g alone cannot see the flow). The supergraph
+    analysis finds tainted nodes in g and attributes them to f."""
+    cpg = parse_source(FIXTURE.read_text())
+    sg = build_supergraph(cpg)
+    assert sg.n_call_edges == 1
+
+    # per-function baseline: source-API-only taint (no parameter seeds) —
+    # the strongest per-function analysis that identifies actual source
+    # flows, i.e. what per-function scoring of g has available
+    facts, gen, kill, dv, dr = _taint_static(cpg, DEFAULT_TAINT_SOURCES)
+    stripped = {
+        n: (set() if cpg.nodes[n].label == "METHOD" else s)
+        for n, s in gen.items()
+    }
+    from deepdfa_tpu.cpg.analyses import solve_bitvec
+    intra = _outer_taint_solve(cpg, (facts, stripped, kill, dv, dr),
+                               solve_bitvec)
+    g_mid = next(n.id for n in cpg.nodes.values()
+                 if n.label == "METHOD" and n.name == "g")
+    g_nodes = {g_mid} | set(cpg.ast_descendants(g_mid))
+    for n in g_nodes & set(intra.in_facts):
+        assert not intra.in_facts[n], "per-function taint must NOT reach g"
+        assert not intra.out_facts[n]
+
+    res = cross_function_taint(sg)
+    assert res["findings"], "interproc must catch the seeded flow"
+    assert all(f["function"] == "g" for f in res["findings"])
+    assert all(f["sources"] == ["f"] for f in res["findings"])
+    assert res["attribution"] == {"g": ["f"]}
+    # the sink statement itself is among the caught nodes
+    codes = {cpg.nodes[f["node"]].code for f in res["findings"]}
+    assert "strcpy(local, data)" in codes
+
+
+def test_scan_interproc_report_merges_files_and_degrades():
+    """The scan surface: two FILES (source in one, sink in the other) —
+    merge_cpgs + supergraph resolve the call across the file boundary; an
+    unparseable file is one error row, never an abort."""
+    from deepdfa_tpu.scan import _interproc_report
+
+    sink = "void g(char *data) { char local[64]; strcpy(local, data); }\n"
+    src = "int f(void) { char buf[64]; gets(buf); g(buf); return 0; }\n"
+    report = _interproc_report([
+        ("sink.c", sink), ("src.c", src), ("broken.c", "int f( {{{"),
+    ])
+    assert report["n_files_parsed"] == 2
+    assert len(report["errors"]) == 1
+    assert report["errors"][0]["file"] == "broken.c"
+    assert report["call_edges"] == 1
+    assert report["findings"]
+    assert report["attribution"] == {"g": ["f"]}
+
+
+def test_merge_cpgs_disjoint_ids_and_dangling_drop():
+    a = parse_source("int f(void){ return 1; }")
+    b = parse_source("int g(void){ return 2; }")
+    merged, maps = merge_cpgs([a, b])
+    assert len(merged.nodes) == len(a.nodes) + len(b.nodes)
+    assert set(maps[0].values()).isdisjoint(set(maps[1].values()))
+    # dangling edge in an input is dropped, not KeyError
+    bad = CPG(list(a.nodes.values()), list(a.edges) + [(1, 999999, "CFG")])
+    merged2, _ = merge_cpgs([bad])
+    assert all(d != 999999 for _, d, _ in merged2.edges)
+
+
+# ------------------------------------------- acceptance: zero-edge parity
+
+
+@pytest.mark.parametrize("path", REALWORLD, ids=lambda p: p.stem)
+@pytest.mark.parametrize("backend", ("sets", "bitvec", "native"))
+@pytest.mark.parametrize("name", IPROC_ANALYSES)
+def test_zero_call_edge_parity(name, backend, path):
+    """On a CPG with zero resolved call edges the interprocedural solution
+    is BIT-EQUAL to the intraprocedural one — the supergraph adds no
+    machinery when there is nothing to link."""
+    cpg = parse_source(path.read_text())
+    assert build_supergraph(cpg).n_call_edges == 0, path.stem
+    ref = solve_analysis(name, cpg, backend=backend)
+    got = solve_interproc_analysis(name, cpg, backend=backend)
+    assert got.in_facts == ref.in_facts, (name, backend, path.stem)
+    assert got.out_facts == ref.out_facts, (name, backend, path.stem)
+
+
+def test_backends_agree_on_the_interproc_fixture():
+    cpg = parse_source(FIXTURE.read_text())
+    for name in IPROC_ANALYSES:
+        ref = solve_interproc_analysis(name, cpg, backend="sets")
+        for backend in ("bitvec", "native"):
+            got = solve_interproc_analysis(name, cpg, backend=backend)
+            assert got.in_facts == ref.in_facts, (name, backend)
+            assert got.out_facts == ref.out_facts, (name, backend)
+
+
+def test_solve_interproc_analysis_rejects_unknown():
+    cpg = parse_source(TWO_FN)
+    with pytest.raises(ValueError, match="unknown interprocedural"):
+        solve_interproc_analysis("liveness", cpg)
+
+
+# ------------------------------------------------------- feature families
+
+
+def test_interproc_node_features_ranges_and_escalation():
+    cpg = parse_source(FIXTURE.read_text())
+    fams = interproc_node_features(cpg)
+    assert set(fams) == {"ireach", "itaint"}
+    assert all(v >= 0 for v in fams["ireach"].values())
+    assert all(v in (0, 1, 2, 3) for v in fams["itaint"].values())
+    # cross-boundary flow: some node escalates to the itaint=3 code, and
+    # some node in the callee sees foreign (caller-owned) definitions
+    assert 3 in fams["itaint"].values()
+    assert max(fams["ireach"].values()) >= 1
+
+
+def test_interproc_features_collapse_on_single_function():
+    """Zero call edges: ireach all-zero, itaint == the PR 1 taint codes."""
+    from deepdfa_tpu.cpg.analyses import taint_node_codes
+
+    cpg = parse_source(REALWORLD[0].read_text())
+    fams = interproc_node_features(cpg)
+    assert set(fams["ireach"].values()) <= {0}
+    assert fams["itaint"] == taint_node_codes(cpg)
+
+
+def test_corpus_builder_emits_interproc_families():
+    from deepdfa_tpu.config import DFA_FEATURE_DIMS, FeatureConfig, IDFA_FAMILIES
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    cpgs = {0: parse_source(FIXTURE.read_text()),
+            1: parse_source(REALWORLD[0].read_text())}
+    builder = CorpusBuilder(FeatureConfig(limit_subkeys=50, limit_all=50,
+                                          interproc_families=True))
+    graphs, _ = builder.build(cpgs, train_ids=[0],
+                              vuln_lines={0: {8}, 1: set()})
+    assert graphs
+    for g in graphs:
+        for fam in IDFA_FAMILIES:
+            arr = np.asarray(g.node_feats[f"_DFA_{fam}"])
+            assert arr.shape[0] == g.n_nodes
+            assert arr.min() >= 0 and arr.max() < DFA_FEATURE_DIMS[fam]
+
+
+def test_ggnn_forward_with_interproc_families():
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import GGNNConfig, IDFA_FAMILIES
+    from deepdfa_tpu.data.graphs import BucketSpec, GraphBatcher
+    from deepdfa_tpu.data.synthetic import random_dataset
+    from deepdfa_tpu.models.ggnn import GGNN
+
+    cfg = GGNNConfig(interproc_families=True, hidden_dim=8, n_steps=2,
+                     num_output_layers=2)
+    graphs = random_dataset(8, seed=3, input_dim=64, interproc_families=True)
+    batch = next(GraphBatcher([BucketSpec(9, 1024, 2048)]).batches(graphs))
+    model = GGNN(cfg=cfg, input_dim=64)
+    jb = jax.tree.map(jnp.asarray, batch)
+    params = model.init(jax.random.key(0), jb)["params"]
+    for fam in IDFA_FAMILIES:
+        assert f"embed_dfa_{fam}" in params
+    out = np.asarray(model.apply({"params": params}, jb))
+    assert np.isfinite(out).all()
+
+
+def test_config_out_dim_and_link():
+    from deepdfa_tpu.config import (
+        DataConfig, ExperimentConfig, FeatureConfig, GGNNConfig,
+    )
+
+    cfg = ExperimentConfig(
+        data=DataConfig(feature=FeatureConfig(interproc_families=True)),
+        model=GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2),
+    )
+    assert cfg.model.interproc_families is True
+    assert cfg.model.out_dim == 2 * 8 * (4 + 2)  # 4 subkeys + 2 IDFA fams
+    both = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2,
+                      dataflow_families=True, interproc_families=True)
+    assert both.out_dim == 2 * 8 * (4 + 3 + 2)
+
+

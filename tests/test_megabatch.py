@@ -277,6 +277,7 @@ def test_make_model_dispatches_megabatch_and_rejects_variants():
         (dataclasses.replace(cfg, aggregation="union_relu"), "sum"),
         (dataclasses.replace(cfg, label_style="node"), "graph-level"),
         (dataclasses.replace(cfg, dataflow_families=True), "concat-subkey"),
+        (dataclasses.replace(cfg, interproc_families=True), "concat-subkey"),
     ]:
         with pytest.raises(ValueError, match=match):
             GGNNMegabatch(cfg=bad, input_dim=INPUT_DIM).init(

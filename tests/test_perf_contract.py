@@ -44,6 +44,10 @@ def test_every_new_assembler_carries_provenance():
             n_functions=8, n_workers=2, host_cpus=8, serial_fps=10.0,
             pool_fps=18.0, warm_hit_rate=1.0, warm_extracted=0, n_results=8,
             quarantined=0),
+        bench.assemble_interproc_result(
+            n_functions=30, n_call_edges=20, supergraph_build_ms=4.0,
+            solve_ms={"bitvec": 3.0}, functions_per_sec=700.0,
+            parity_ok=True, n_cross_findings=5),
     ]
     for art in arts:
         assert PROVENANCE_KEYS <= set(art), art["metric"]
@@ -96,6 +100,58 @@ def test_extraction_lost_item_or_error_is_not_ok():
     art = bench.assemble_extraction_result(
         **_extraction_kwargs(error="pool wedged"))
     assert art["ok"] is False and art["error"] == "pool wedged"
+
+
+# -------------------------------------------------------------- interproc
+
+
+def _interproc_kwargs(**over):
+    kw = dict(n_functions=30, n_call_edges=20, supergraph_build_ms=4.2,
+              solve_ms={"sets": 12.0, "bitvec": 3.5, "native": 1.25},
+              functions_per_sec=800.0, parity_ok=True, n_cross_findings=10)
+    kw.update(over)
+    return kw
+
+
+def test_interproc_schema_and_ledger_stage_block():
+    art = bench.assemble_interproc_result(**_interproc_kwargs())
+    assert art["ok"] is True
+    assert art["metric"] == "interproc_supergraph_build_ms"
+    assert art["unit"] == "ms" and art["device_kind"] == "host"
+    # the nested stage block the ledger ingests as stage "interproc":
+    # one series per backend solve, flattened
+    assert art["interproc"] == {
+        "supergraph_build_ms": 4.2, "solve_sets_ms": 12.0,
+        "solve_bitvec_ms": 3.5, "solve_native_ms": 1.25,
+        "functions_per_sec": 800.0}
+
+
+def test_interproc_parity_is_a_gate():
+    """Correctness precedes perf: a run whose zero-call-edge parity check
+    failed must not land a green artifact however fast it solved."""
+    art = bench.assemble_interproc_result(**_interproc_kwargs(parity_ok=False))
+    assert art["ok"] is False
+
+
+def test_interproc_no_findings_or_error_is_not_ok():
+    # a solver that found none of the seeded cross-function flows is
+    # broken, not fast
+    art = bench.assemble_interproc_result(
+        **_interproc_kwargs(n_cross_findings=0))
+    assert art["ok"] is False
+    art = bench.assemble_interproc_result(
+        **_interproc_kwargs(error="native lib unavailable",
+                            solve_ms={"sets": 12.0, "bitvec": 3.5,
+                                      "native": None}))
+    assert art["ok"] is False
+
+
+def test_interproc_series_directions_declared():
+    from deepdfa_tpu.obs.ledger import lower_is_better
+
+    assert lower_is_better("supergraph_build_ms", "interproc")
+    assert lower_is_better("solve_native_ms", "interproc")
+    assert not lower_is_better("functions_per_sec", "interproc")
 
 
 # ------------------------------------------------------------- fused train

@@ -96,6 +96,25 @@ def test_installed_restores_previous_arming():
         faults.clear()
 
 
+def test_ckpt_crash_point_armed_through_real_save(tmp_path):
+    """In-process arming of ``ckpt.crash_between_state_and_meta`` through a
+    real CheckpointManager.save — the subprocess chaos drill
+    (``chaos_train.py``) arms it at hit 1 and dies; here the schedule says
+    hit 2, so the ONE save consumes hit 1 without firing and the atomic
+    commit completes. Asserts the point is genuinely wired (hit counted)
+    and the commit protocol finished (meta.json present)."""
+    from deepdfa_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path)
+    with faults.installed("ckpt.crash_between_state_and_meta@2"):
+        assert mgr.save(1, {"w": np.zeros(2)})
+        counts = faults.counters()
+    assert counts["hits"]["ckpt.crash_between_state_and_meta"] == 1
+    assert counts["fires"].get("ckpt.crash_between_state_and_meta", 0) == 0
+    assert (tmp_path / "00000001" / "meta.json").exists()
+    assert mgr.steps == [1]
+
+
 # ---------------------------------------------------------------------------
 # retry
 
