@@ -1102,6 +1102,61 @@ def assemble_interproc_result(n_functions, n_call_edges, supergraph_build_ms,
     }
 
 
+def assemble_hier_result(n_functions, n_call_edges, cold_unit_score_ms,
+                         warm_unit_score_ms, embed_cache_hit_rate,
+                         level1_recompute, fallback_dispatches,
+                         level1_dispatches_cold, unit_score, error=None):
+    """ONE-line block for the ``hier`` stage (``scripts/bench_hier.py``):
+    whole-unit hierarchical scoring over a seeded multi-function corpus,
+    cold (empty embedding cache) then warm (same content re-scored).
+    Warm-pass numbers are the headline: ``unit_score_ms`` is the warm
+    latency, ``level1_recompute`` the warm-pass function re-embeds and
+    ``embed_cache_hit_rate`` the warm-pass cache hit fraction. Gates:
+    (a) ``fallback_dispatches == 0`` across BOTH passes — the whole point
+    of the hierarchical path is that whole-program scoring never leaves
+    the fused megabatch kernels; (b) ``level1_recompute == 0`` warm — a
+    content-addressed cache that re-embeds unchanged functions is not a
+    cache; (c) the warm hit rate covers every function; (d) warm at least
+    broke even (``warm_speedup >= 1``); (e) the unit score survived both
+    passes bit-identically (checked by the caller, passed as a finite
+    ``unit_score`` — None means the scores diverged or scoring failed)."""
+    speedup = (None if not warm_unit_score_ms or cold_unit_score_ms is None
+               else cold_unit_score_ms / warm_unit_score_ms)
+    ok = (error is None and unit_score is not None
+          and fallback_dispatches == 0 and level1_recompute == 0
+          and level1_dispatches_cold >= 1
+          and embed_cache_hit_rate is not None
+          and embed_cache_hit_rate >= 1.0
+          and speedup is not None and speedup >= 1.0)
+    return {
+        "metric": "hier_unit_score_ms",
+        "value": (None if warm_unit_score_ms is None
+                  else round(warm_unit_score_ms, 3)),
+        "unit": "ms",
+        "backend": "cpu",
+        "device_kind": "host",
+        "hier": {
+            "unit_score_ms": (None if warm_unit_score_ms is None
+                              else round(warm_unit_score_ms, 3)),
+            "unit_score_cold_ms": (None if cold_unit_score_ms is None
+                                   else round(cold_unit_score_ms, 3)),
+            "embed_cache_hit_rate": (
+                None if embed_cache_hit_rate is None
+                else round(embed_cache_hit_rate, 3)),
+            "level1_recompute": level1_recompute,
+            "fallback_dispatches": fallback_dispatches,
+            "warm_speedup": None if speedup is None else round(speedup, 2),
+        },
+        "n_functions": n_functions,
+        "n_call_edges": n_call_edges,
+        "level1_dispatches_cold": level1_dispatches_cold,
+        "unit_score": unit_score,
+        "error": error,
+        "ok": ok,
+        **_provenance_fields(),
+    }
+
+
 def bench_fused_train(corpus, n_batches: int, k: int,
                       dtype: str = "bfloat16", trials: int = 3):
     """The ``ggnn_fused_train`` stage: chained TRAIN steps (fwd + backward +

@@ -557,7 +557,8 @@ def cross_function_taint(
 # ------------------------------------------------------------ feature view
 
 
-def interproc_node_features(cpg: CPG) -> dict[str, dict[int, int]]:
+def interproc_node_features(cpg: CPG, sg: Supergraph | None = None
+                            ) -> dict[str, dict[int, int]]:
     """``{"ireach": {node: count}, "itaint": {node: code}}`` over the base
     CPG's nodes — the ``_DFA_ireach``/``_DFA_itaint`` feature families.
 
@@ -568,10 +569,15 @@ def interproc_node_features(cpg: CPG) -> dict[str, dict[int, int]]:
     nodes only a cross-boundary flow can taint. On a single-function CPG
     (zero call edges) ireach is all-zero and itaint equals ``_DFA_taint``
     — the families strictly extend, never perturb, the PR 1 ones.
+
+    ``sg``: an already-built supergraph of ``cpg`` — callers that hold one
+    (the scan's interproc pass, the hierarchical scorer's summary builder)
+    pass it to skip the rebuild; semantics are identical.
     """
     from deepdfa_tpu.cpg.analyses import solve_native
 
-    sg = build_supergraph(cpg)
+    if sg is None:
+        sg = build_supergraph(cpg)
     rd_sol = solve_native(interproc_reaching_definitions(sg))
     ireach: dict[int, int] = {}
     for n, in_facts in rd_sol.in_facts.items():

@@ -193,13 +193,17 @@ def demo_corpus(
     100 non-vul in the reference's sample mode). ``style="hard"`` uses the
     dataflow-hard generator (identical feature histograms across classes);
     ``chain_depth=L`` additionally pins the def→def CFG distance (the
-    union-vs-sum separation corpus, dataset name ``demo_chain{L}``)."""
+    union-vs-sum separation corpus, dataset name ``demo_order{L}`` —
+    "order" as in the def→def distance parameter, NOT a depth benchmark:
+    the graph label stays locally decidable near the sink, so the knob
+    does not force L-hop reasoning; the node-level RD task is the depth
+    probe of record)."""
     import functools
 
     rng = np.random.default_rng(seed)
     if chain_depth is not None:
         gen = functools.partial(generate_hard_function, chain_depth=chain_depth)
-        dataset = f"demo_chain{chain_depth}"
+        dataset = f"demo_order{chain_depth}"
     elif style == "hard":
         gen, dataset = generate_hard_function, "demo_hard"
     else:

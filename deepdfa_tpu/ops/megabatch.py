@@ -65,7 +65,9 @@ __all__ = [
     "fits_vmem_megabatch",
     "pack_megabatches",
     "fused_ggnn_model",
+    "fused_ggnn_encoder",
     "megabatch_reference",
+    "megabatch_encoder_reference",
 ]
 
 
@@ -307,7 +309,7 @@ def _model_kernel(table_ref, ids_ref, snd_ref, rcv_ref, gidx_ref, mask_ref,
                   ew_ref, eb_ref, xw_ref, xb_ref, hw_ref, hb_ref,
                   gw_ref, gb_ref, *rest, n_nodes: int, n_edges: int,
                   n_sub: int, embed_w: int, width: int, n_steps: int,
-                  gp: int, n_layers: int):
+                  gp: int, n_layers: int, encoder: bool = False):
     """One grid step of the whole-model forward. Grid ``(n_steps + 1,)``,
     executed sequentially on TPU, so the node-state scratch persists across
     the prologue, every message round, and the epilogue:
@@ -401,6 +403,12 @@ def _model_kernel(table_ref, ids_ref, snd_ref, rcv_ref, gidx_ref, mask_ref,
         pooled = jax.lax.dot_general(
             m_onehot, gate * hcat, contract_rows,
             preferred_element_type=f32)                     # (gp, 2·dp)
+        if encoder:
+            # the hierarchical level-1 readout: stop at the pooled
+            # function embedding — same prologue, same message rounds,
+            # same pooling softmax, no head (models/ggnn_hier.py)
+            out_ref[:] = pooled
+            return
         a = pooled
         for li in range(n_layers):
             a = jnp.dot(a, head[2 * li][:], preferred_element_type=f32) + head[2 * li + 1][:]
@@ -459,6 +467,141 @@ def megabatch_reference(table, ids, senders, receivers, gidx, mask,
         if i != len(head) - 1:
             a = jax.nn.relu(a)
     return a[..., 0].astype(jnp.float32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_steps", "n_graphs", "edges_sorted"))
+def megabatch_encoder_reference(table, ids, senders, receivers, gidx, mask,
+                                ew, eb, xw, xb, hw, hb, gw, gb, *,
+                                n_steps: int, n_graphs: int,
+                                edges_sorted: bool = True) -> jnp.ndarray:
+    """:func:`megabatch_reference` stopped at the pooled embedding — the
+    segment-twin math of the hierarchical level-1 encoder (same ops, same
+    order, no classifier head). Routing target for over-plan shapes in
+    :class:`~deepdfa_tpu.models.ggnn_hier.HierScorer`."""
+    h0 = jnp.take(table, ids, axis=0).reshape(ids.shape[0], -1)
+    h = _unrolled_reference(h0, senders, receivers, ew, eb, xw, xb, hw, hb,
+                            n_steps, edges_sorted)
+    hcat = jnp.concatenate([h, h0], axis=-1)
+    gate_logit = (hcat @ gw + gb)[:, 0]
+    gate = segment_softmax(gate_logit, gidx, n_graphs, mask=mask,
+                           indices_are_sorted=True)
+    pooled = segment_sum(gate[:, None] * hcat, gidx, n_graphs,
+                         indices_are_sorted=True)
+    return pooled.astype(jnp.float32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_steps", "n_graphs", "interpret",
+                                    "edges_sorted"))
+def fused_ggnn_encoder(
+    table: jnp.ndarray,
+    ids: jnp.ndarray,
+    senders: jnp.ndarray,
+    receivers: jnp.ndarray,
+    gidx: jnp.ndarray,
+    mask: jnp.ndarray,
+    ew: jnp.ndarray,
+    eb: jnp.ndarray,
+    xw: jnp.ndarray,
+    xb: jnp.ndarray,
+    hw: jnp.ndarray,
+    hb: jnp.ndarray,
+    gw: jnp.ndarray,
+    gb: jnp.ndarray,
+    *,
+    n_steps: int,
+    n_graphs: int,
+    interpret: bool = False,
+    edges_sorted: bool = True,
+) -> jnp.ndarray:
+    """Whole-model fused forward WITHOUT the classifier head: embed →
+    ``n_steps`` message rounds → GRU → attention pool, ONE Pallas launch,
+    per-graph pooled embeddings ``[n_graphs, 2·width]`` out.
+
+    The level-1 inner loop of the hierarchical scorer
+    (:mod:`deepdfa_tpu.models.ggnn_hier`): identical prologue, rounds and
+    pooling epilogue to :func:`fused_ggnn_model` — the SAME kernel with
+    the head matmuls elided — so per-function embeddings come off the
+    fused path the megabatch packer feeds, never a separate program.
+    Inference-only (no custom_vjp: the hierarchical level 1 serves frozen
+    params). Callers are expected to check :func:`fits_vmem_megabatch`
+    and route over-plan shapes to :func:`megabatch_encoder_reference`.
+    """
+    n, n_sub = ids.shape
+    e = senders.shape[0]
+    d = ew.shape[0]
+    ed = table.shape[1]
+    t_rows = table.shape[0]
+    if n_sub * ed != d:
+        raise ValueError(
+            f"embed width {n_sub}·{ed} != conv width {d} — the whole-model "
+            "kernel requires the concat-subkey config (embed == hidden)")
+    np_ = _round_up(max(n, 8), 8)
+    dp = _round_up(max(d, 1), 128)
+    ep = _round_up(max(e, 1), 128)
+    gp = _round_up(max(n_graphs, 1), 128)
+    tp = _round_up(max(t_rows, 8), 8)
+    edp = _round_up(max(ed, 1), 128)
+    npl = _round_up(np_, 128)
+    f32 = jnp.float32
+
+    from deepdfa_tpu.ops.fused_ggnn import _pack_gate_bias, _pack_gates
+
+    tablep = jnp.pad(table.astype(f32), ((0, tp - t_rows), (0, edp - ed)))
+    idsp = jnp.pad(ids.astype(jnp.int32).T, ((0, 8 - n_sub), (0, npl - n)))
+    sndp = jnp.pad(senders.astype(jnp.int32), (0, ep - e)).reshape(1, ep)
+    rcvp = jnp.pad(receivers.astype(jnp.int32), (0, ep - e)).reshape(1, ep)
+    gidxp = jnp.pad(gidx.astype(jnp.int32)[:, None],
+                    ((0, np_ - n), (0, 127)))
+    maskp = jnp.pad(mask.astype(f32)[:, None], ((0, np_ - n), (0, 127)))
+    ewp = jnp.pad(ew.astype(f32), ((0, dp - d), (0, dp - d)))
+    ebp = jnp.pad(eb.astype(f32), (0, dp - d)).reshape(1, dp)
+    xwp = _pack_gates(xw.astype(f32), d, dp)
+    xbp = _pack_gate_bias(xb.astype(f32), d, dp)
+    hwp = _pack_gates(hw.astype(f32), d, dp)
+    hbp = _pack_gate_bias(hb.astype(f32), d, dp)
+    gwp = _pack_half_rows(gw.astype(f32), d, dp, 128)
+    gbp = jnp.pad(gb.astype(f32), (0, 127)).reshape(1, 128)
+
+    full = lambda shape: pl.BlockSpec(shape, lambda s: tuple(0 for _ in shape),
+                                      memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(
+            _model_kernel, n_nodes=n, n_edges=e, n_sub=n_sub, embed_w=ed,
+            width=dp, n_steps=n_steps, gp=gp, n_layers=0, encoder=True),
+        grid=(n_steps + 1,),
+        in_specs=[
+            full((tp, edp)),            # stacked embedding table
+            full((8, npl)),             # per-subkey offset ids
+            full((1, ep)),              # senders
+            full((1, ep)),              # receivers
+            full((np_, 128)),           # node_gidx column
+            full((np_, 128)),           # node_mask column
+            full((dp, dp)),             # edge_linear kernel
+            full((1, dp)),              # edge_linear bias
+            full((dp, 3 * dp)),         # gru x_proj kernel
+            full((1, 3 * dp)),          # gru x_proj bias
+            full((dp, 3 * dp)),         # gru h_proj kernel
+            full((1, 3 * dp)),          # gru h_proj bias
+            full((2 * dp, 128)),        # pooling gate kernel
+            full((1, 128)),             # pooling gate bias
+        ],
+        out_specs=full((gp, 2 * dp)),
+        out_shape=jax.ShapeDtypeStruct((gp, 2 * dp), f32),
+        scratch_shapes=[
+            pltpu.VMEM((np_, dp), f32),       # hcur (node states)
+            pltpu.VMEM((np_, dp), f32),       # h0 bank (classifier concat)
+            pltpu.VMEM((np_, dp), f32),       # msg
+            pltpu.VMEM((np_, dp), f32),       # agg
+            pltpu.VMEM((np_, 2 * dp), f32),   # hcat
+        ],
+        interpret=interpret,
+    )(tablep, idsp, sndp, rcvp, gidxp, maskp, ewp, ebp, xwp, xbp, hwp, hbp,
+      gwp, gbp)
+    # unpad the packed-half layout [h (dp) | h0 (dp)] back to [2·d]
+    return jnp.concatenate(
+        [out[:n_graphs, :d], out[:n_graphs, dp:dp + d]], axis=-1)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(15, 16, 17, 18))

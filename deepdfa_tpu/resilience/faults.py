@@ -74,6 +74,7 @@ KNOWN_POINTS = (
     "cascade.escalation_drop",
     "frontend.worker_crash",
     "frontend.spawn_fail",
+    "embcache.cache_corrupt",
 )
 
 # One line per point; keys must equal KNOWN_POINTS (the analysis faults
@@ -146,6 +147,10 @@ POINT_DOCS = {
         "fail one frontend encode-session spawn — the supervisor retries "
         "with backoff; a pool that cannot spawn at all degrades to inline "
         "encode, never a 5xx (serve/frontend.py)"),
+    "embcache.cache_corrupt": (
+        "corrupt one function-embedding-cache payload at read — the entry "
+        "must read as a MISS (level 1 re-embeds), never a decode crash "
+        "(serve/embcache.py)"),
 }
 
 

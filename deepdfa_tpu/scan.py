@@ -51,12 +51,14 @@ def collect_c_files(paths: Sequence[str | Path]) -> list[Path]:
     return out
 
 
-def _session_factory(vocabs, frontend):
+def _session_factory(vocabs, frontend, keep_cpg: bool = False):
     """The scan's encode sessions come from the SAME factory the online
     :class:`~deepdfa_tpu.serve.frontend.FrontendPool` uses — offline and
     online frontends share one pool implementation, so mode (process vs
     thread), the vocab-hash spawn handshake, and timeout semantics
-    cannot drift between the two surfaces."""
+    cannot drift between the two surfaces. ``keep_cpg`` (the interproc
+    scan) asks thread sessions to keep the per-function CPGs so the
+    supergraph pass reuses them instead of re-parsing every source."""
     from deepdfa_tpu.config import FrontendConfig
     from deepdfa_tpu.serve.frontend import encode_session_factory
 
@@ -64,7 +66,7 @@ def _session_factory(vocabs, frontend):
         # encode must still run on the pool's worker threads — "inline"
         # only means no child processes, i.e. thread-mode sessions
         frontend = FrontendConfig(mode="thread")
-    return encode_session_factory(vocabs, frontend)
+    return encode_session_factory(vocabs, frontend, keep_cpg=keep_cpg)
 
 
 def _score_functions(engine, rows: list[dict], graphs: list) -> None:
@@ -121,27 +123,47 @@ def _cascade_rescore(tier2, band, rows: list[dict], graphs: list,
         row["vulnerable_probability"] = round(float(p), 6)
 
 
-def _interproc_report(sources: list[tuple[str, str]]) -> dict:
-    """Whole-unit interprocedural pass over the scanned sources: parse each
-    file, merge the per-file CPGs into ONE graph (so calls resolve across
-    file boundaries too), build the call-graph supergraph, and run the
-    cross-function taint differential (``cpg.interproc``). Findings are the
-    taint flows a per-function scan provably cannot see — the source API is
-    in the caller, the sink in the callee. Per-file parse failures degrade
-    to error rows; this never aborts the scan."""
+def _interproc_pass(sources: list[tuple[str, str]],
+                    parsed: dict[str, list] | None = None):
+    """Whole-unit interprocedural pass over the scanned sources: merge the
+    per-file CPGs into ONE graph (so calls resolve across file boundaries
+    too), build the call-graph supergraph, and run the cross-function
+    taint differential (``cpg.interproc``). Findings are the taint flows a
+    per-function scan provably cannot see — the source API is in the
+    caller, the sink in the callee.
+
+    ``parsed`` maps a file name to its already-parsed per-function CPGs
+    (the scan loop's thread-mode encode keeps them) — those files skip
+    the second parse entirely; files not in the map (process-mode encode,
+    warm old-generation cache entries, parse failures) fall back to
+    :func:`~deepdfa_tpu.cpg.frontend.parse_source`. Per-file failures
+    degrade to error rows; this never aborts the scan. Returns
+    ``(report, supergraph-or-None)`` so the caller can reuse the
+    supergraph for hierarchical unit scoring."""
     from deepdfa_tpu.cpg.frontend import parse_source
     from deepdfa_tpu.cpg.interproc import (
         build_supergraph, cross_function_taint, merge_cpgs)
 
+    parsed = parsed or {}
     cpgs, errors = [], []
+    n_files, n_reused = 0, 0
     for name, code in sources:
+        pre = parsed.get(name)
+        if pre:
+            cpgs.extend(pre)
+            n_files += 1
+            n_reused += 1
+            continue
         try:
             cpgs.append(parse_source(code))
+            n_files += 1
         except Exception as exc:  # noqa: BLE001 — one error row per file
             errors.append({"file": name, "error": f"{type(exc).__name__}: {exc}"})
+    base = {"n_files_parsed": n_files, "n_files_reused": n_reused,
+            "errors": errors, "findings": [], "attribution": {},
+            "call_edges": 0, "functions": 0}
     if not cpgs:
-        return {"n_files_parsed": 0, "errors": errors, "findings": [],
-                "attribution": {}, "call_edges": 0, "functions": 0}
+        return base, None
     merged, _ = merge_cpgs(cpgs)
     try:
         sg = build_supergraph(merged)
@@ -151,16 +173,76 @@ def _interproc_report(sources: list[tuple[str, str]]) -> dict:
                        type(exc).__name__, exc)
         errors.append({"file": "<merged>",
                        "error": f"{type(exc).__name__}: {exc}"})
-        return {"n_files_parsed": len(cpgs), "errors": errors, "findings": [],
-                "attribution": {}, "call_edges": 0, "functions": 0}
-    return {
-        "n_files_parsed": len(cpgs),
-        "errors": errors,
-        "findings": cross["findings"],
-        "attribution": cross["attribution"],
-        "call_edges": sg.n_call_edges,
-        "functions": len(sg.callgraph.methods),
-    }
+        return base, None
+    base.update(
+        findings=cross["findings"],
+        attribution=cross["attribution"],
+        call_edges=sg.n_call_edges,
+        functions=len(sg.callgraph.methods),
+    )
+    return base, sg
+
+
+def _interproc_report(sources: list[tuple[str, str]],
+                      parsed: dict[str, list] | None = None) -> dict:
+    """:func:`_interproc_pass`'s report alone (the stable surface the
+    interproc tests and external callers consume)."""
+    report, _ = _interproc_pass(sources, parsed)
+    return report
+
+
+def _function_source(file_source: str, cpg) -> str | None:
+    """The line-slice of ``file_source`` covering one function's CPG — the
+    content the embedding cache keys on. Slicing per function keeps a
+    sibling-function edit from invalidating every entry in the file; a
+    CPG without line info returns None (the caller falls back to the
+    whole file, still correct, just coarser invalidation)."""
+    lines = [n.line for n in cpg.nodes.values()
+             if getattr(n, "line", None)]
+    if not lines:
+        return None
+    lo, hi = min(lines), max(lines)
+    split = file_source.split("\n")
+    return "\n".join(split[max(lo - 1, 0):hi])
+
+
+def _attach_embedding_cache(engine, vocabs, cache_dir) -> None:
+    """Front the engine's hierarchical scorer with a content-addressed
+    function-embedding cache under ``{cache_dir}/emb`` — keyed on the
+    function source × model revision × vocab content × feature config, so
+    a warm rescan of unchanged functions re-dispatches zero level-1
+    megabatches. No cache dir (or an engine without a hierarchical path)
+    is a clean no-op: scoring still works, just uncached."""
+    if cache_dir is None:
+        return
+    try:
+        hier = engine.hier
+        if hier.cache is not None:
+            return  # caller already attached one (e.g. bench harness)
+        from deepdfa_tpu.serve.embcache import FunctionEmbeddingCache
+        hier.cache = FunctionEmbeddingCache(
+            Path(cache_dir) / "emb",
+            model_rev=getattr(engine, "model_rev", "unknown") or "unknown",
+            vocab_hash=vocab_content_hash(vocabs),
+            feature_salt=",".join(getattr(engine, "feat_keys", ()) or ()),
+            dim=hier.out_dim,
+        )
+    except Exception as exc:  # noqa: BLE001 — cache is an optimisation
+        logger.warning("scan --interproc: embedding cache unavailable "
+                       "(%s: %s)", type(exc).__name__, exc)
+
+
+def _score_unit(engine, sg, unit_fns: list) -> dict:
+    """One hierarchical ``score_unit`` request over the merged unit —
+    level-1 embeddings off the fused megabatch kernels (cache-fronted),
+    composed over the call graph (``models/ggnn_hier.py``). Any failure
+    degrades to a ``unit_error`` entry; the scan never aborts on it."""
+    try:
+        return engine.score_unit(unit_fns, sg)
+    except Exception as exc:  # noqa: BLE001 — degrade, never abort
+        logger.warning("scan --interproc: unit scoring failed (%s: %s)",
+                       type(exc).__name__, exc)
+        return {"unit_error": f"{type(exc).__name__}: {exc}"}
 
 
 def scan_paths(
@@ -187,7 +269,7 @@ def scan_paths(
         # a re-vocabed corpus must MISS rather than serve stale encodings
         cache = ExtractCache(cache_dir, salt=vocab_content_hash(vocabs))
     pool = ExtractionPool(
-        _session_factory(vocabs, frontend),
+        _session_factory(vocabs, frontend, keep_cpg=interproc),
         n_workers=max(1, min(n_workers, max(len(sources), 1))),
         attempts_per_item=attempts_per_item,
         cache=cache,
@@ -200,28 +282,46 @@ def scan_paths(
     )
     elapsed = time.perf_counter() - t0
 
+    source_by_file = dict(sources)
     rows: list[dict] = []
     score_rows: list[dict] = []
     score_graphs: list = []
+    parsed_cpgs: dict[str, list] = {}
+    unit_fns: list = []
     for res in results:
         if res.error is not None:
             rows.append({"file": res.key, "error": res.error,
                          "quarantined": res.quarantined})
             continue
+        if interproc and res.value and all(
+                fn.cpg is not None for fn in res.value):
+            # thread-mode encode kept the per-function CPGs — the
+            # interproc pass reuses them (no second parse); process-mode
+            # results and old-generation cache entries re-parse instead
+            parsed_cpgs[res.key] = [fn.cpg for fn in res.value]
         for fn in res.value:
             row = {"file": res.key, "function": fn.name,
                    "cache_hit": res.cache_hit}
             if fn.graph is None:
                 row["error"] = fn.error
-            elif engine is not None:
-                score_rows.append(row)
-                score_graphs.append(fn.graph)
+            else:
+                if engine is not None:
+                    score_rows.append(row)
+                    score_graphs.append(fn.graph)
+                if interproc:
+                    from deepdfa_tpu.models.ggnn_hier import UnitFunction
+
+                    file_code = source_by_file.get(res.key, "")
+                    code = (_function_source(file_code, fn.cpg)
+                            if fn.cpg is not None else None)
+                    unit_fns.append(UnitFunction(
+                        fn.name, code or f"{fn.name}\n{file_code}", fn.graph))
             rows.append(row)
     if engine is not None and score_graphs:
         _score_functions(engine, score_rows, score_graphs)
         if tier2 is not None:
             _cascade_rescore(tier2, tier2_band, score_rows, score_graphs,
-                             dict(sources))
+                             source_by_file)
 
     n_err = sum(1 for r in rows if "error" in r)
     report = {
@@ -235,7 +335,11 @@ def scan_paths(
         "cache": cache.stats() if cache is not None else None,
     }
     if interproc:
-        report["interproc"] = _interproc_report(sources)
+        ip_report, sg = _interproc_pass(sources, parsed_cpgs)
+        report["interproc"] = ip_report
+        if engine is not None and sg is not None and unit_fns:
+            _attach_embedding_cache(engine, vocabs, cache_dir)
+            ip_report["unit"] = _score_unit(engine, sg, unit_fns)
     if tier2 is not None:
         report["cascade"] = {
             "band": [float(tier2_band[0]), float(tier2_band[1])],

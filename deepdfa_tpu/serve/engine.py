@@ -195,7 +195,7 @@ class ScoringEngine:
                  int8_score_delta: float | None = None,
                  stacked_fn=None, n_replicas: int = 1,
                  model_rev: str | None = None, export_fn=None,
-                 mega: ServeBucket | None = None):
+                 mega: ServeBucket | None = None, hier_factory=None):
         if not buckets:
             raise ValueError("need at least one serving bucket")
         if score_fn is None and stacked_fn is None:
@@ -231,6 +231,11 @@ class ScoringEngine:
         self.warm_buckets: list[int] = []
         self.last_warmup_report: dict | None = None
         self._bucket_fns: dict[ServeBucket, object] = {}
+        # whole-unit hierarchical scoring (models/ggnn_hier.py): live
+        # megabatch-compatible engines get a lazy factory; the scorer is
+        # built on first score_unit so ladder-only serving pays nothing
+        self._hier_factory = hier_factory
+        self._hier = None
         self._lock = threading.RLock()
         # attachment point set by the server: every dispatch records its
         # bucket + real-graph count into the crash flight recorder
@@ -370,6 +375,38 @@ class ScoringEngine:
                 "graphs": sum(len(b) for b in bins)
                 / (len(bins) * spec.max_graphs),
             }
+        return out
+
+    @property
+    def hier(self):
+        """The lazy :class:`~deepdfa_tpu.models.ggnn_hier.HierScorer` —
+        live megabatch-compatible engines only. Attach an embedding cache
+        via ``engine.hier.cache = FunctionEmbeddingCache(...)``."""
+        with self._lock:
+            if self._hier is None:
+                if self._hier_factory is None:
+                    raise RuntimeError(
+                        "score_unit needs a live megabatch-compatible "
+                        "engine (graph labels, concat-subkey embeddings) — "
+                        "artifact engines and excluded model variants have "
+                        "no hierarchical path")
+                self._hier = self._hier_factory()
+            return self._hier
+
+    def score_unit(self, functions, supergraph) -> dict:
+        """Score a merged multi-function unit as ONE request through the
+        hierarchical two-level path: per-function level-1 embeddings off
+        the fused megabatch kernels (cache-fronted), composed over the
+        call graph into a unit score + per-function attribution. Never
+        touches the bucket ladder — a unit whose merged CPG would raise
+        :class:`OversizeGraphError` scores here per function."""
+        faults.raise_if("serve.engine_raises")
+        hier = self.hier
+        with self._lock:
+            before = hier.n_level1_dispatches + hier.n_fallback_dispatches
+            out = hier.score_unit(functions, supergraph)
+            self.n_dispatches += (hier.n_level1_dispatches
+                                  + hier.n_fallback_dispatches - before)
         return out
 
     def submit(self, graphs, bucket: ServeBucket) -> PendingScore:
@@ -671,6 +708,19 @@ class ScoringEngine:
         elif precision != "f32":
             raise ValueError(f"precision must be 'f32' or 'int8', got {precision!r}")
 
+        # hierarchical whole-unit path: always the ORIGINAL f32 params —
+        # the level-1 bit-identity invariant is pinned against the fused
+        # f32 kernels, and the embedding cache keys on their model_rev
+        hier_factory = None
+        if getattr(model, "cfg", None) is not None:
+            from deepdfa_tpu.models.ggnn_hier import (
+                HierScorer, megabatch_compatible)
+
+            if megabatch_compatible(model.cfg):
+                hier_factory = (lambda m=model, p=params, rev=model_rev:
+                                HierScorer(m.cfg, m.input_dim, p,
+                                           model_rev=rev))
+
         if mesh is not None:
             stacked_fn = _make_replicated_fn(chosen_scorer, chosen_params,
                                              mesh)
@@ -679,7 +729,7 @@ class ScoringEngine:
                        latency_mode=latency_mode, precision=precision,
                        int8_score_delta=int8_delta, stacked_fn=stacked_fn,
                        n_replicas=int(mesh.shape["dp"]), model_rev=model_rev,
-                       mega=mega)
+                       mega=mega, hier_factory=hier_factory)
 
         export_fn = _make_export_fn(chosen_model, chosen_params, label_style,
                                     keys)
@@ -687,7 +737,8 @@ class ScoringEngine:
                    feat_keys=keys, vocab_hash=vocab_hash,
                    device_fn=device_fn, latency_mode=latency_mode,
                    precision=precision, int8_score_delta=int8_delta,
-                   model_rev=model_rev, export_fn=export_fn, mega=mega)
+                   model_rev=model_rev, export_fn=export_fn, mega=mega,
+                   hier_factory=hier_factory)
 
     @classmethod
     def from_checkpoint(cls, cfg, ckpt_dir: Path | str, vocabs,

@@ -95,18 +95,23 @@ class _FrontendWorkerCrashed(BaseException):
 class ThreadEncodeSession:
     """In-process encode session: one vocab closure. Every encode failure
     is an :class:`ExtractionItemError` — in-process there is no session
-    infrastructure to implicate, only the item."""
+    infrastructure to implicate, only the item.
 
-    def __init__(self, vocabs):
+    ``keep_cpg=False`` (the default) returns (name, Graph, node_ids) only —
+    small, picklable, exactly what scoring needs. The interproc scan flips
+    it on so the supergraph pass reuses the already-parsed per-function
+    CPGs instead of parsing every source a second time; in-process there
+    is no pickle boundary, so the CPGs ride along for free."""
+
+    def __init__(self, vocabs, *, keep_cpg: bool = False):
         self._vocabs = vocabs
+        self._keep_cpg = keep_cpg
 
     def encode(self, code: str):
         from deepdfa_tpu.pipeline import encode_source
 
         try:
-            # keep_cpg=False: (name, Graph, node_ids) only — small,
-            # picklable, exactly what scoring needs
-            return encode_source(code, self._vocabs, keep_cpg=False)
+            return encode_source(code, self._vocabs, keep_cpg=self._keep_cpg)
         except Exception as exc:  # noqa: BLE001 — item error by definition
             raise ExtractionItemError(f"{type(exc).__name__}: {exc}") from exc
 
@@ -222,14 +227,20 @@ class FrontendProcessSession:
             self._proc.join(timeout=2.0)
 
 
-def encode_session_factory(vocabs, fcfg, *, vocab_source=None) -> Callable:
+def encode_session_factory(vocabs, fcfg, *, vocab_source=None,
+                           keep_cpg: bool = False) -> Callable:
     """One ``session_factory(worker_id)`` for BOTH frontends: the online
     :class:`FrontendPool` and the offline scan's
     :class:`~deepdfa_tpu.data.extraction.ExtractionPool` build their
     encode sessions here, so mode/handshake/timeout semantics cannot
     drift between the two surfaces. ``vocab_source`` (a shard dir) makes
     process children warm-load from disk instead of pickling the vocabs
-    through the spawn args."""
+    through the spawn args.
+
+    ``keep_cpg`` applies to thread sessions only: process children always
+    drop the CPG (it would have to pickle back through the pipe per item
+    — the interproc scan's parse-reuse degrades to a re-parse in process
+    mode, which the scan reports honestly)."""
     from deepdfa_tpu.pipeline import vocab_content_hash
 
     expect_hash = vocab_content_hash(vocabs)
@@ -242,7 +253,7 @@ def encode_session_factory(vocabs, fcfg, *, vocab_source=None) -> Callable:
                 blob, expect_hash=expect_hash,
                 timeout_s=fcfg.encode_timeout_s,
                 spawn_timeout_s=fcfg.spawn_timeout_s)
-        return ThreadEncodeSession(vocabs)
+        return ThreadEncodeSession(vocabs, keep_cpg=keep_cpg)
 
     return factory
 

@@ -123,7 +123,7 @@ def chain_sweep(args) -> dict:
     """[Superseded by --rescue for conclusions — this 25-epoch budget stops
     inside the optimization plateau the round-5 rescue documented; kept for
     reproducing the r03 table.] Union-vs-sum separation curves: for each def→def
-    CFG distance L, train the golden GGNN on ``demo_chain{L}`` with
+    CFG distance L, train the golden GGNN on ``demo_order{L}`` with
     aggregation ∈ {sum, union_relu} at the golden depth (n_steps=5) and at a
     chain-covering depth (n_steps=L+3). The class is decided by WHICH
     definition reaches the memcpy across L reconvergent diamonds — the regime
@@ -137,7 +137,7 @@ def chain_sweep(args) -> dict:
     out = Path(args.out)
     curves: dict = {"n": args.n, "epochs": args.epochs, "depths": depths, "runs": {}}
     for L in depths:
-        ds = f"demo_chain{L}"
+        ds = f"demo_order{L}"
         summary = pp.main(["--dataset", ds, "--n", str(args.n),
                            "--seed", str(args.seed), "--overwrite"])
         if summary.get("graphs") != args.n:
@@ -318,7 +318,7 @@ def rescue(args) -> dict:
     out: dict = {"n": args.n, "epochs": args.epochs, "depths": depths,
                  "n_steps": 5, "runs": {}}
     for L in depths:
-        ds = f"demo_chain{L}"
+        ds = f"demo_order{L}"
         summary = pp.main(["--dataset", ds, "--n", str(args.n),
                            "--seed", str(args.seed), "--overwrite"])
         if summary.get("graphs") != args.n:
@@ -352,7 +352,7 @@ def union_pretrain(args) -> dict:
     out: dict = {"n": args.n, "epochs": args.epochs, "depths": depths,
                  "n_steps": 5, "aggregation": "union_relu", "runs": {}}
     for L in depths:
-        ds = f"demo_chain{L}"
+        ds = f"demo_order{L}"
         summary = pp.main(["--dataset", ds, "--n", str(args.n),
                            "--seed", str(args.seed), "--dataflow-labels",
                            "--overwrite"])

@@ -13,7 +13,9 @@ bounded-queue backpressure, worker-crash exactly-once re-queue,
 invariant-25 degrade-to-inline through the real server) + the
 interprocedural-dataflow suite (``pytest -m 'interproc and not slow'``:
 call-graph/supergraph construction, the cross-function taint catch, the
-zero-call-edge solver parity property) + the
+zero-call-edge solver parity property) + the hierarchical-scoring suite
+(``pytest -m 'hier and not slow'``: level-1 bit-identity, embedding-cache
+rotation/corruption hygiene, whole-unit score_unit routing) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
 lock-order, jit-purity/donation, fault-registry, fault-arming coverage,
 metrics conformance static passes) + the perf-regression ledger
@@ -141,6 +143,19 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("interproc")
+
+    # the hierarchical-scoring suite: level-1 bit-identity to the fused
+    # path, embedding-cache generation rotation + torn-write-is-miss,
+    # whole-unit score_unit routing (including the OversizeGraphError
+    # escape hatch), warm-rescan zero-recompute — CPU interpret-mode
+    # kernels on a tiny model, no accelerator
+    print("lint_gate: pytest -m 'hier and not slow'")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "hier and not slow",
+         "-q", "tests/test_hier.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("hier")
 
     # step 5: the invariant gate — AST passes for atomic-commit,
     # lock-order, jit-purity/donation, fault-registry, fault-arming

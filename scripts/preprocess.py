@@ -284,12 +284,12 @@ def main(argv=None) -> dict:
         return {"status": "exists", "out": str(out_dir)}
 
     # 1. ingest
-    if args.dataset in ("demo", "demo_hard") or args.dataset.startswith("demo_chain"):
+    if args.dataset in ("demo", "demo_hard") or args.dataset.startswith("demo_order"):
         from deepdfa_tpu.data.codegen import demo_corpus
 
         chain_depth = (
-            int(args.dataset[len("demo_chain"):])
-            if args.dataset.startswith("demo_chain") else None
+            int(args.dataset[len("demo_order"):])
+            if args.dataset.startswith("demo_order") else None
         )
         df = demo_corpus(
             args.n if not args.sample else min(args.n, 60), seed=args.seed,

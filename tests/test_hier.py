@@ -1,0 +1,365 @@
+"""Hierarchical two-level scoring (``models/ggnn_hier.py`` +
+``serve/embcache.py`` + the ``scan --interproc`` unit wiring).
+
+The acceptance properties this file pins:
+
+- **level-1 bit-identity**: the hierarchical scorer's per-function
+  embeddings — through its own megabatch packer AND through the
+  content-addressed embedding cache — are bit-equal to the standalone
+  fused-encoder path on every realworld fixture. The hierarchy never
+  perturbs level 1; it only composes it.
+- **never off the fused kernels**: whole-unit scoring of the seeded
+  cross-function fixture runs as ONE ``score_unit`` request with zero
+  segment-fallback dispatches, and a unit whose merged CPG raises
+  :class:`~deepdfa_tpu.serve.OversizeGraphError` on the bucket ladder
+  still scores through the hierarchical path.
+- **cache generation hygiene** (invariant 23): rotating ``model_rev``,
+  the vocab hash, or the feature config each MISSES cleanly; torn or
+  corrupt payloads (including the ``embcache.cache_corrupt`` chaos
+  point) read as a MISS, never a decode crash; and a warm rescan of
+  unchanged sources performs ZERO level-1 recomputes.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.resilience import faults
+from deepdfa_tpu.serve.embcache import FunctionEmbeddingCache
+
+pytestmark = pytest.mark.hier
+
+FIXTURE = Path(__file__).parent / "fixtures" / "interproc" / "cross_taint.c"
+REALWORLD = sorted(
+    (Path(__file__).parent / "fixtures" / "realworld").glob("*.c"))
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+@pytest.fixture(scope="module")
+def vocabs():
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    rows = demo_corpus(6, seed=0).to_dict("records")
+    cpgs = {int(r["id"]): add_dependence_edges(parse_source(r["before"]))
+            for r in rows}
+    labels = {int(r["id"]): int(r["vul"]) for r in rows}
+    _, vocabs = CorpusBuilder(FeatureConfig()).build(
+        cpgs, list(cpgs), graph_labels=labels)
+    return vocabs
+
+
+@pytest.fixture(scope="module")
+def live_model():
+    """Tiny megabatch-compatible GGNN (the flagship config's shape at test
+    width) + fresh params over the full per-subkey feature columns."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepdfa_tpu.config import GGNNConfig
+    from deepdfa_tpu.data.graphs import Graph, batch_np
+    from deepdfa_tpu.data.vocab import ALL_SUBKEYS
+    from deepdfa_tpu.models import make_model
+
+    cfg = GGNNConfig(hidden_dim=8, n_steps=2, num_output_layers=2)
+    keys = tuple(f"_ABS_DATAFLOW_{sk}" for sk in ALL_SUBKEYS)
+    model = make_model(cfg, input_dim=40)
+    g = Graph(senders=np.arange(3, dtype=np.int32),
+              receivers=np.arange(1, 4, dtype=np.int32),
+              node_feats={k: np.zeros(4, np.int32) for k in keys},
+              ).with_self_loops()
+    example = jax.tree.map(jnp.asarray, batch_np([g], 2, 8, 128))
+    params = model.init(jax.random.key(0), example)["params"]
+    return model, params, cfg, keys
+
+
+def _scorer(live_model, **kw):
+    from deepdfa_tpu.models.ggnn_hier import HierScorer
+
+    model, params, cfg, _ = live_model
+    return HierScorer(cfg, model.input_dim, params, **kw)
+
+
+def _unit_functions(code: str, vocabs):
+    from deepdfa_tpu.models.ggnn_hier import UnitFunction
+    from deepdfa_tpu.pipeline import encode_source
+
+    fns = encode_source(code, vocabs, keep_cpg=True)
+    return ([UnitFunction(fn.name, f"{fn.name}\n{code}", fn.graph)
+             for fn in fns if fn.graph is not None],
+            [fn.cpg for fn in fns if fn.cpg is not None])
+
+
+# --------------------------------------------------- level-1 bit-identity
+
+
+def test_megabatch_compatible_mirrors_the_fused_envelope():
+    from deepdfa_tpu.config import GGNNConfig
+    from deepdfa_tpu.models.ggnn_hier import megabatch_compatible
+
+    assert megabatch_compatible(GGNNConfig())
+    assert not megabatch_compatible(GGNNConfig(concat_all_absdf=False))
+    assert not megabatch_compatible(GGNNConfig(label_style="node"))
+    assert not megabatch_compatible(GGNNConfig(encoder_mode=True))
+
+
+def test_hier_scorer_refuses_incompatible_configs(live_model):
+    import dataclasses
+
+    from deepdfa_tpu.models.ggnn_hier import HierScorer
+
+    model, params, cfg, _ = live_model
+    bad = dataclasses.replace(cfg, concat_all_absdf=False)
+    with pytest.raises(ValueError, match="megabatch-compatible"):
+        HierScorer(bad, model.input_dim, params)
+
+
+def test_embed_functions_bit_identical_to_standalone_fused_path(
+        live_model, vocabs, tmp_path):
+    """The tentpole invariant on every realworld fixture: packer and cache
+    plumbing never perturb a bit of the level-1 embedding — cold (cache
+    misses, fused recompute) AND warm (served from the cache files)."""
+    unit_fns = []
+    for path in REALWORLD:
+        fns, _ = _unit_functions(path.read_text(), vocabs)
+        unit_fns.extend(fns)
+    assert len(unit_fns) >= len(REALWORLD)
+
+    baseline = _scorer(live_model)
+    ref = baseline.embed_graphs([fn.graph for fn in unit_fns])
+    assert baseline.n_fallback_dispatches == 0
+
+    cache = FunctionEmbeddingCache(tmp_path / "emb", model_rev="r1",
+                                   vocab_hash="v1")
+    cold = _scorer(live_model, cache=cache)
+    got_cold = cold.embed_functions(unit_fns)
+    np.testing.assert_array_equal(got_cold, ref)
+    assert cold.level1_recompute == len(unit_fns)
+    assert cold.n_fallback_dispatches == 0
+
+    warm = _scorer(live_model, cache=cache)
+    got_warm = warm.embed_functions(unit_fns)
+    np.testing.assert_array_equal(got_warm, ref)
+    assert warm.level1_recompute == 0
+    assert warm.n_level1_dispatches == 0
+    assert cache.stats()["hits"] == len(unit_fns)
+
+
+# ------------------------------------------------ whole-unit end-to-end
+
+
+def test_cross_taint_unit_scores_as_one_request_with_attribution(
+        live_model, vocabs):
+    """The acceptance fixture end-to-end: ``score_unit`` through a live
+    engine — one request, per-function attribution, zero segment
+    fallbacks, and deterministic across engine rebuilds (level 2 is
+    seeded from the level-1 model_rev)."""
+    from deepdfa_tpu.cpg.interproc import build_supergraph, merge_cpgs
+    from deepdfa_tpu.serve import ScoringEngine
+
+    model, params, cfg, keys = live_model
+    code = FIXTURE.read_text()
+    unit_fns, cpgs = _unit_functions(code, vocabs)
+    merged, _ = merge_cpgs(cpgs)
+    sg = build_supergraph(merged)
+
+    engine = ScoringEngine.from_model(model, params, cfg.label_style,
+                                      feat_keys=keys, max_batch=4)
+    before = engine.n_dispatches
+    out = engine.score_unit(unit_fns, sg)
+    assert engine.n_dispatches == before + 1  # ONE level-1 dispatch
+    assert engine.hier.n_fallback_dispatches == 0
+    assert 0.0 < out["unit_score"] < 1.0
+    assert out["n_functions"] == 2 and out["call_edges"] == 1
+    assert {row["function"] for row in out["attribution"]} == {"f", "g"}
+    assert abs(sum(row["weight"] for row in out["attribution"]) - 1.0) < 1e-5
+
+    again = ScoringEngine.from_model(model, params, cfg.label_style,
+                                     feat_keys=keys, max_batch=4)
+    assert again.score_unit(unit_fns, sg)["unit_score"] == out["unit_score"]
+
+
+def test_oversize_unit_raises_on_ladder_but_scores_hierarchically(
+        live_model, vocabs):
+    """A merged unit too big for every serving bucket is a 413 on the
+    per-function ladder — with the node count and the ceiling in the
+    message — while ``score_unit`` routes the SAME unit through the
+    hierarchical path (which never touches the ladder)."""
+    from deepdfa_tpu.cpg.interproc import build_supergraph, merge_cpgs
+    from deepdfa_tpu.data.graphs import BucketSpec, Graph
+    from deepdfa_tpu.serve import OversizeGraphError, ScoringEngine
+    from deepdfa_tpu.serve.engine import ServeBucket
+
+    model, params, cfg, keys = live_model
+    code = FIXTURE.read_text()
+    unit_fns, cpgs = _unit_functions(code, vocabs)
+    merged, _ = merge_cpgs(cpgs)
+    sg = build_supergraph(merged)
+
+    # one deliberately tiny bucket: the merged unit graph exceeds it
+    tiny = ServeBucket(spec=BucketSpec(2, 8, 32), graph_nodes=4)
+    engine = ScoringEngine.from_model(model, params, cfg.label_style,
+                                      feat_keys=keys, buckets=(tiny,))
+    merged_graph = Graph(
+        senders=np.zeros(1, np.int32), receivers=np.zeros(1, np.int32),
+        node_feats={k: np.zeros(16, np.int32) for k in keys})
+    with pytest.raises(OversizeGraphError) as err:
+        engine.assign_bucket(merged_graph)
+    assert "16 nodes" in str(err.value)
+    assert "graph_nodes=4" in str(err.value)
+
+    out = engine.score_unit(unit_fns, sg)
+    assert 0.0 < out["unit_score"] < 1.0
+    assert engine.hier.n_fallback_dispatches == 0
+
+
+def test_score_unit_without_hier_path_raises_cleanly():
+    """Engines with no megabatch-compatible live model (e.g. stub
+    score_fn engines) refuse ``score_unit`` with a clear error."""
+    from deepdfa_tpu.serve import ScoringEngine, serve_buckets
+
+    eng = ScoringEngine(lambda batch: np.zeros(batch.max_graphs, np.float32),
+                        serve_buckets(4))
+    with pytest.raises(RuntimeError, match="megabatch-compatible"):
+        eng.hier
+
+
+# ------------------------------------------------------- embedding cache
+
+
+def test_cache_key_rotates_on_model_rev_vocab_and_features(tmp_path):
+    code = "int f(int x) { return x + 1; }"
+    base = dict(model_rev="r1", vocab_hash="v1", feature_salt="fa")
+    cache = FunctionEmbeddingCache(tmp_path, **base)
+    key = cache.key(code)
+    cache.put(key, np.arange(4, dtype=np.float32))
+    np.testing.assert_array_equal(cache.get(key),
+                                  np.arange(4, dtype=np.float32))
+
+    for rotated in (dict(base, model_rev="r2"),
+                    dict(base, vocab_hash="v2"),
+                    dict(base, feature_salt="fb")):
+        other = FunctionEmbeddingCache(tmp_path, **rotated)
+        assert other.key(code) != key  # different generation, disjoint keys
+        assert other.get(other.key(code)) is None
+        assert other.stats()["misses"] == 1
+
+    # normalized source (source_key): trailing whitespace, blank lines
+    # and CRLF do NOT mint a new entry
+    assert cache.key("int f(int x) { return x + 1; }  \r\n\n") == key
+
+
+def test_cache_version_bump_rotates_keys(tmp_path):
+    code = "int g(void) { return 2; }"
+    v1 = FunctionEmbeddingCache(tmp_path, model_rev="r", vocab_hash="v")
+    v2 = FunctionEmbeddingCache(tmp_path, model_rev="r", vocab_hash="v",
+                                version=2)
+    assert v1.key(code) != v2.key(code)
+
+
+def test_torn_or_corrupt_entries_read_as_miss_never_crash(tmp_path):
+    cache = FunctionEmbeddingCache(tmp_path, model_rev="r", vocab_hash="v")
+    emb = np.linspace(0, 1, 8).astype(np.float32)
+
+    # torn write: payload landed, meta marker did not — entry nonexistent
+    torn = cache.key("int a(void) { return 0; }")
+    payload, meta = cache._paths(torn)
+    cache.put(torn, emb)
+    meta.unlink()
+    assert cache.get(torn) is None
+
+    # truncated payload: meta digest mismatch → MISS counted as corrupt
+    trunc = cache.key("int b(void) { return 1; }")
+    cache.put(trunc, emb)
+    p, _ = cache._paths(trunc)
+    p.write_bytes(p.read_bytes()[:5])
+    assert cache.get(trunc) is None
+    assert cache.stats()["corrupt"] == 1
+
+    # wrong-width blob for this scorer's out_dim → MISS
+    sized = FunctionEmbeddingCache(tmp_path, model_rev="r", vocab_hash="v",
+                                   dim=16)
+    ok = sized.key("int c(void) { return 2; }")
+    sized.put(ok, emb)  # 8 wide, scorer wants 16
+    assert sized.get(ok) is None
+
+
+@pytest.mark.faults
+def test_injected_corruption_fault_is_a_miss(tmp_path):
+    """The ``embcache.cache_corrupt`` chaos point: a bit-rotted payload
+    under an intact meta marker reads as MISS (then recovers)."""
+    cache = FunctionEmbeddingCache(tmp_path, model_rev="r", vocab_hash="v")
+    emb = np.full(6, 0.5, np.float32)
+    key = cache.key("int d(void) { return 3; }")
+    cache.put(key, emb)
+    with faults.installed("embcache.cache_corrupt@1"):
+        assert cache.get(key) is None  # injected rot → miss, no raise
+        np.testing.assert_array_equal(cache.get(key), emb)  # @1: one shot
+    assert cache.stats()["corrupt"] == 1
+
+
+def test_corrupt_cache_never_changes_the_unit_score(live_model, vocabs,
+                                                    tmp_path):
+    """End-to-end under injected corruption: score_unit falls back to
+    recompute and the answer is bit-identical to the clean run."""
+    from deepdfa_tpu.cpg.interproc import build_supergraph, merge_cpgs
+
+    code = FIXTURE.read_text()
+    unit_fns, cpgs = _unit_functions(code, vocabs)
+    merged, _ = merge_cpgs(cpgs)
+    sg = build_supergraph(merged)
+
+    cache = FunctionEmbeddingCache(tmp_path / "emb", model_rev="r1",
+                                   vocab_hash="v1")
+    scorer = _scorer(live_model, cache=cache, model_rev="r1")
+    clean = scorer.score_unit(unit_fns, sg)["unit_score"]
+    with faults.installed("embcache.cache_corrupt"):  # EVERY get rots
+        rotted = scorer.score_unit(unit_fns, sg)
+    assert rotted["unit_score"] == clean
+    assert rotted["level1"]["cache"]["corrupt"] == len(unit_fns)
+
+
+# ------------------------------------------------- scan wiring, warm rescan
+
+
+def test_scan_interproc_scores_unit_and_warm_rescan_recomputes_nothing(
+        live_model, vocabs, tmp_path):
+    """``scan --interproc`` with a live engine: the unit block lands in
+    the report with attribution; a second scan of the unchanged tree is
+    served entirely from the embedding cache — zero level-1 recomputes,
+    zero dispatches, identical unit score."""
+    from deepdfa_tpu.scan import scan_paths
+    from deepdfa_tpu.serve import ScoringEngine
+
+    model, params, cfg, keys = live_model
+    engine = ScoringEngine.from_model(model, params, cfg.label_style,
+                                      feat_keys=keys, max_batch=4)
+    code = FIXTURE.read_text()
+    sink, rest = code.split("int f(void)")
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "sink.c").write_text(sink)
+    (tree / "src.c").write_text("int f(void)" + rest)
+
+    cold = scan_paths([tree], vocabs, engine=engine, n_workers=1,
+                      cache_dir=tmp_path / "cache", interproc=True)
+    unit = cold["interproc"]["unit"]
+    assert "unit_error" not in unit
+    assert unit["n_functions"] == 2
+    assert {r["function"] for r in unit["attribution"]} == {"f", "g"}
+    assert unit["level1"]["fallback_dispatches"] == 0
+    assert cold["interproc"]["n_files_reused"] == 2  # no second parse
+
+    engine.hier.reset_counters()
+    warm = scan_paths([tree], vocabs, engine=engine, n_workers=1,
+                      cache_dir=tmp_path / "cache", interproc=True)
+    warm_unit = warm["interproc"]["unit"]
+    assert warm_unit["unit_score"] == unit["unit_score"]
+    assert engine.hier.level1_recompute == 0
+    assert engine.hier.n_level1_dispatches == 0

@@ -202,6 +202,41 @@ def test_scan_interproc_report_merges_files_and_degrades():
     assert report["attribution"] == {"g": ["f"]}
 
 
+def test_interproc_pass_reuses_scan_loop_cpgs(monkeypatch):
+    """Satellite pin (PR 17): ``scan --interproc`` must not parse every
+    source twice — files whose per-function CPGs the scan loop already
+    produced (thread-mode encode with ``keep_cpg``) are threaded through
+    to the supergraph pass, which then re-parses NOTHING for them. Files
+    without pre-parsed CPGs (process pool, old cache generations) still
+    parse — honest degradation, counted in ``n_files_reused``."""
+    from deepdfa_tpu.cpg import frontend
+    from deepdfa_tpu.cpg.frontend import parse_functions
+    from deepdfa_tpu.scan import _interproc_pass
+
+    sink = "void g(char *data) { char local[64]; strcpy(local, data); }\n"
+    src = "int f(void) { char buf[64]; gets(buf); g(buf); return 0; }\n"
+    parsed = {"sink.c": [cpg for _, cpg in parse_functions(sink)]}
+
+    calls: list[str] = []
+    real = frontend.parse_source
+
+    def counting_parse(code):
+        calls.append(code)
+        return real(code)
+
+    monkeypatch.setattr(frontend, "parse_source", counting_parse)
+    report, sg = _interproc_pass([("sink.c", sink), ("src.c", src)], parsed)
+    assert calls == [src]  # sink.c rode the scan loop's CPGs
+    assert report["n_files_parsed"] == 2  # both files are IN the unit
+    assert report["n_files_reused"] == 1
+    assert sg is not None and report["call_edges"] == 1
+    # reuse is semantics-preserving: same findings as the parse-everything
+    # path (parse_source IS the merge of parse_functions)
+    fresh = _interproc_pass([("sink.c", sink), ("src.c", src)])[0]
+    assert report["attribution"] == fresh["attribution"] == {"g": ["f"]}
+    assert len(report["findings"]) == len(fresh["findings"])
+
+
 def test_merge_cpgs_disjoint_ids_and_dangling_drop():
     a = parse_source("int f(void){ return 1; }")
     b = parse_source("int g(void){ return 2; }")

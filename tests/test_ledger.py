@@ -384,3 +384,35 @@ def test_frontend_direction_flows_into_verdicts(tmp_path):
     assert row["stage"] == "frontend"
     assert row["lower_is_better"] is False
     assert row["verdict"] == "regression" and ok is False
+
+
+def test_hier_series_are_explicitly_declared():
+    """Satellite pin (PR 17): the hier stage's series are DECLARED.
+    ``level1_recompute`` and ``fallback_dispatches`` are the ones the
+    heuristic would get WRONG — nothing in either name says
+    lower-is-better, but any warm-rescan recompute means the embedding
+    cache leaked a miss and any segment fallback means whole-unit scoring
+    fell off the fused kernels."""
+    for metric in ("unit_score_ms", "level1_recompute",
+                   "fallback_dispatches"):
+        assert EXPLICIT_SERIES[("hier", metric)] is True, metric
+        assert lower_is_better(metric, "hier") is True, metric
+    for metric in ("embed_cache_hit_rate", "warm_speedup"):
+        assert EXPLICIT_SERIES[("hier", metric)] is False, metric
+        assert lower_is_better(metric, "hier") is False, metric
+
+
+def test_hier_direction_flows_into_verdicts(tmp_path):
+    """A fallback_dispatches JUMP under the hier stage must go red end to
+    end — the bench artifact nests the hier block one level down, so this
+    also pins that the walker assigns stage="hier" there."""
+    for i in range(4):
+        _art(tmp_path, f"BENCH_h{i:02d}.json", emitted=1000 + i,
+             hier={"fallback_dispatches": 0, "embed_cache_hit_rate": 1.0})
+    _art(tmp_path, "BENCH_h99.json", emitted=2000,
+         hier={"fallback_dispatches": 3, "embed_cache_hit_rate": 1.0})
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    (row,) = [r for r in rows if r["metric"] == "fallback_dispatches"]
+    assert row["stage"] == "hier"
+    assert row["lower_is_better"] is True
+    assert row["verdict"] == "regression" and ok is False

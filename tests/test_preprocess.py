@@ -44,6 +44,16 @@ def test_demo_corpus_balance():
     assert df.before.equals(df2.before)
 
 
+def test_demo_order_dataset_name():
+    """VERDICT item 7: the def→def-distance corpus is ``demo_order{L}`` —
+    the old ``demo_chain{L}`` name oversold it as a depth benchmark (the
+    graph label stays locally decidable; the knob pins order, not
+    required reasoning hops)."""
+    df = demo_corpus(8, seed=0, chain_depth=5)
+    assert set(df["dataset"]) == {"demo_order5"}
+    assert set(demo_corpus(8, seed=0, style="hard")["dataset"]) == {"demo_hard"}
+
+
 @pytest.mark.slow
 def test_preprocess_to_training(tmp_path, monkeypatch):
     """preprocess.py --dataset demo → shards the CLI trains on; the defect is
