@@ -496,7 +496,8 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
                           p99_ms, mean_batch_occupancy, cache_hit_rate,
                           cache_hits, requests_total, errors_total,
                           concurrency=None, notes=None, fleet=None,
-                          autoscale=None, cascade=None, frontend=None):
+                          autoscale=None, cascade=None, frontend=None,
+                          admission=None):
     """ONE-line artifact for the serving stage (scripts/bench_serving.py).
 
     Shared between the load generator and the bench-contract test so the
@@ -510,7 +511,9 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
     ``assemble_autoscale_result`` block, from ``--autoscale`` runs) and
     ``cascade`` (an ``assemble_cascade_result`` block, from ``--cascade``
     runs) and ``frontend`` (an ``assemble_frontend_result`` block, from
-    ``--frontend`` runs) ride along and AND their own ok."""
+    ``--frontend`` runs) and ``admission`` (an
+    ``assemble_admission_result`` block, from ``--overload`` runs) ride
+    along and AND their own ok."""
     ok = (requests_total > 0 and errors_total == 0
           and requests_per_sec > 0
           and mean_batch_occupancy is not None
@@ -524,6 +527,8 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
         ok = ok and bool(cascade.get("ok"))
     if frontend is not None:
         ok = ok and bool(frontend.get("ok"))
+    if admission is not None:
+        ok = ok and bool(admission.get("ok"))
     return {
         "metric": "serve_requests_per_sec",
         "value": round(float(requests_per_sec), 2),
@@ -549,6 +554,7 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
         "autoscale": autoscale,
         "cascade": cascade,
         "frontend": frontend,
+        "admission": admission,
         "ok": ok,
         **_provenance_fields(),
     }
@@ -874,6 +880,108 @@ def assemble_autoscale_result(backend, device_kind, min_replicas,
         "spawn_give_ups": spawn_give_ups,
         "errors_total": int(errors_total),
         "decisions": decisions,
+        "notes": notes or {},
+        "ok": ok,
+        **_provenance_fields(),
+    }
+
+
+# admission gates (scripts/bench_serving.py --overload): the sawtooth
+# saturates the fleet at ADMISSION_SATURATION_X times the nominal rate, so
+# the explicit overload behavior (ISSUE 18, invariant candidate 30) is
+# what is measured — sheds ARE expected, what is gated is their shape:
+# every shed a 429 with a Retry-After header, zero 5xx anywhere (the
+# interactive class above all), the batch class shed first, interactive
+# shed only after the brownout ladder reached its last level, nominal
+# load shedding NOTHING, and the SLO burn the sawtooth pages bounded by
+# the brownout budget.
+ADMISSION_SATURATION_X = 10
+ADMISSION_MAX_BURN_MINUTES = 2.0
+ADMISSION_MAX_NOMINAL_SHEDS = 0
+
+
+def assemble_admission_result(backend, device_kind, saturation_x, nominal,
+                              overload, admission, brownout,
+                              slo_burn_minutes, healthz_brownout_level_max,
+                              notes=None):
+    """ONE-line ``admission`` block for ``bench_serving.py --overload``.
+
+    ``nominal``/``overload`` are per-phase collector dicts (requests,
+    per-class response codes, Retry-After header presence on 429s);
+    ``admission``/``brownout`` are the controllers' own summaries — the
+    artifact doubles as the audit trail, exactly like the autoscale
+    block. The gates are the ISSUE 18 acceptance criteria verbatim."""
+    def _code_total(phase, pred, klass=None):
+        total = 0
+        for cls, codes in (phase.get("responses") or {}).items():
+            if klass is not None and cls != klass:
+                continue
+            total += sum(n for code, n in codes.items() if pred(int(code)))
+        return total
+
+    nominal_sheds = _code_total(nominal, lambda c: c == 429)
+    overload_sheds = _code_total(overload, lambda c: c == 429)
+    batch_sheds = _code_total(overload, lambda c: c == 429, klass="batch")
+    interactive_5xx = (_code_total(nominal, lambda c: c >= 500,
+                                   klass="interactive")
+                       + _code_total(overload, lambda c: c >= 500,
+                                     klass="interactive"))
+    total_5xx = (_code_total(nominal, lambda c: c >= 500)
+                 + _code_total(overload, lambda c: c >= 500))
+    retry_after_missing = (int(nominal.get("retry_after_missing") or 0)
+                           + int(overload.get("retry_after_missing") or 0))
+    early_interactive = int(
+        admission.get("interactive_sheds_before_brownout") or 0)
+    journal_drops = (int(admission.get("journal_drops") or 0)
+                     + int(brownout.get("journal_drops") or 0))
+    brownout_escalated = int(brownout.get("transitions_total") or 0) > 0
+    # /healthz must have reported the degradation while it was happening
+    healthz_honest = (not brownout_escalated
+                      or (healthz_brownout_level_max or 0) >= 1)
+    ok = (int(nominal.get("requests_total") or 0) > 0
+          and int(overload.get("requests_total") or 0) > 0
+          and nominal_sheds <= ADMISSION_MAX_NOMINAL_SHEDS
+          and overload_sheds > 0         # the saturation actually shed
+          and batch_sheds > 0            # ... starting with the batch class
+          and total_5xx == 0
+          and interactive_5xx == 0
+          and retry_after_missing == 0
+          and early_interactive == 0     # interactive sheds LAST
+          and journal_drops == 0
+          and brownout_escalated
+          and healthz_honest
+          and slo_burn_minutes is not None
+          and slo_burn_minutes <= ADMISSION_MAX_BURN_MINUTES)
+    return {
+        "metric": "admission_slo_burn_minutes",
+        "value": (None if slo_burn_minutes is None
+                  else round(float(slo_burn_minutes), 3)),
+        "unit": "min",
+        "backend": backend,
+        "device_kind": device_kind,
+        "saturation_x": int(saturation_x),
+        "nominal_shed_total": int(nominal_sheds),
+        "max_nominal_sheds": ADMISSION_MAX_NOMINAL_SHEDS,
+        "overload_shed_total": int(overload_sheds),
+        "batch_shed_total": int(batch_sheds),
+        "interactive_5xx_total": int(interactive_5xx),
+        "responses_5xx_total": int(total_5xx),
+        "retry_after_missing": int(retry_after_missing),
+        "interactive_sheds_before_brownout": early_interactive,
+        "journal_drops": int(journal_drops),
+        "slo_burn_minutes": (None if slo_burn_minutes is None
+                             else round(float(slo_burn_minutes), 3)),
+        "max_burn_minutes": ADMISSION_MAX_BURN_MINUTES,
+        "brownout_transitions": int(brownout.get("transitions_total") or 0),
+        "brownout_max_level": int(brownout.get("max_level_seen") or 0),
+        "healthz_brownout_level_max": (
+            None if healthz_brownout_level_max is None
+            else int(healthz_brownout_level_max)),
+        "healthz_honest": healthz_honest,
+        "nominal": nominal,
+        "overload": overload,
+        "admission_summary": admission,
+        "brownout_summary": brownout,
         "notes": notes or {},
         "ok": ok,
         **_provenance_fields(),

@@ -498,6 +498,77 @@ class FrontendConfig:
 
 
 @dataclass(frozen=True)
+class AdmissionConfig:
+    """Admission-control / QoS knobs (``serve/admission.py``; CLI: ``--set
+    serve.admission.*``): per-tenant token buckets with two priority
+    classes (``interactive`` score vs ``batch`` rescore, tagged
+    per-request), deadline-aware shedding off the frontend queue-wait
+    signal, and the brownout controller — the same hysteresis/streak/
+    cooldown decision shape as the autoscaler, stepping through declared
+    degradation levels under sustained SLO burn. A shed is always
+    429 + deterministic Retry-After (derived from bucket refill state,
+    never wall-clock randomness), never a 5xx; the interactive class
+    sheds last (invariant candidate 30)."""
+
+    enabled: bool = False
+    # per-(tenant, class) token buckets: refill rate (requests/s) and
+    # burst capacity. The batch class gets the smaller budget — it is the
+    # first traffic shed under pressure.
+    interactive_rate: float = 200.0
+    interactive_burst: float = 200.0
+    batch_rate: float = 50.0
+    batch_burst: float = 50.0
+    # deadline-aware shedding: when the observed frontend queue-wait p99
+    # exceeds a class's deadline the class sheds before paying encode
+    # cost. Interactive gets the tight deadline; batch tolerates more.
+    interactive_deadline_ms: float = 2000.0
+    batch_deadline_ms: float = 10000.0
+    # queue-depth guard: estimated wait is also judged from the frontend
+    # queue depth — depth beyond this per-class multiple of the burst
+    # capacity sheds batch traffic early (0 disables the depth signal)
+    depth_shed_factor: float = 4.0
+    # brownout controller (hysteresis watermarks over the fast-window SLO
+    # burn, consecutive-poll streaks, post-action cooldown — the exact
+    # decision shape of AutoscaleConfig so operators tune one vocabulary)
+    brownout: bool = True
+    burn_high: float = 2.0
+    burn_low: float = 0.5
+    up_consecutive: int = 2
+    down_consecutive: int = 5
+    cooldown_s: float = 5.0
+    poll_interval_s: float = 0.5
+    # highest brownout level the controller may reach: 1 = shed batch,
+    # 2 = + warm-cache hits + tier-1 only, 3 = + shed interactive
+    max_level: int = 3
+
+    def __post_init__(self):
+        for name in ("interactive_rate", "interactive_burst",
+                     "batch_rate", "batch_burst"):
+            if getattr(self, name) <= 0:
+                raise ValueError(f"{name} must be > 0")
+        if self.interactive_deadline_ms <= 0:
+            raise ValueError("interactive_deadline_ms must be > 0")
+        if self.batch_deadline_ms <= 0:
+            raise ValueError("batch_deadline_ms must be > 0")
+        if self.depth_shed_factor < 0:
+            raise ValueError("depth_shed_factor must be >= 0 (0 disables)")
+        if self.burn_high <= 0:
+            raise ValueError("burn_high must be > 0")
+        if not 0 <= self.burn_low < self.burn_high:
+            raise ValueError("need 0 <= burn_low < burn_high")
+        if self.up_consecutive < 1:
+            raise ValueError("up_consecutive must be >= 1")
+        if self.down_consecutive < 1:
+            raise ValueError("down_consecutive must be >= 1")
+        if self.cooldown_s <= 0:
+            raise ValueError("cooldown_s must be > 0")
+        if self.poll_interval_s <= 0:
+            raise ValueError("poll_interval_s must be > 0")
+        if not 1 <= self.max_level <= 3:
+            raise ValueError("max_level must be in [1, 3]")
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online scoring service knobs (``deepdfa_tpu/serve``; CLI:
     ``--set serve.*``): the micro-batching window, admission control, the
@@ -544,6 +615,8 @@ class ServeConfig:
     cascade: CascadeConfig = field(default_factory=CascadeConfig)
     # frontend encode pool (serve/frontend.py): cold-path encode workers
     frontend: FrontendConfig = field(default_factory=FrontendConfig)
+    # admission control + QoS classes + brownout (serve/admission.py)
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -645,6 +718,7 @@ _NESTED: dict[tuple[str, str], type] = {
     ("ServeConfig", "autoscale"): AutoscaleConfig,
     ("ServeConfig", "cascade"): CascadeConfig,
     ("ServeConfig", "frontend"): FrontendConfig,
+    ("ServeConfig", "admission"): AdmissionConfig,
 }
 
 

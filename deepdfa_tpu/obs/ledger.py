@@ -137,6 +137,21 @@ EXPLICIT_SERIES: dict[tuple[str, str], bool] = {
     ("hier", "fallback_dispatches"): True,
     ("hier", "embed_cache_hit_rate"): False,
     ("hier", "warm_speedup"): False,
+    # the admission bench block (scripts/bench_serving.py --overload):
+    # overload COST and contract violations all go down — SLO burn
+    # minutes paged during the sawtooth, 5xx leaked to the interactive
+    # class, sheds under nominal load, interactive sheds before the
+    # brownout ladder reached its last level, and 429s missing their
+    # Retry-After header (each nonzero violation is a regression of
+    # invariant candidate 30). Overload shed counts are the mechanism
+    # WORKING, not a quality signal — deliberately untracked here.
+    ("admission", "slo_burn_minutes"): True,
+    ("admission", "interactive_5xx_total"): True,
+    ("admission", "responses_5xx_total"): True,
+    ("admission", "nominal_shed_total"): True,
+    ("admission", "interactive_sheds_before_brownout"): True,
+    ("admission", "retry_after_missing"): True,
+    ("admission", "journal_drops"): True,
 }
 
 
@@ -206,9 +221,18 @@ def iter_entries(doc, source: str = "<mem>") -> list[LedgerEntry]:
     device = str(doc.get("device_kind") or doc.get("backend") or "unknown")
     rev = str(doc.get("git_rev") or "unknown")
     emitted = int(doc.get("emitted_at_unix") or 0)
+    # the assembler shape names its headline: {"metric": "<name>",
+    # "value": <n>}. Keying the series by the declared name instead of the
+    # literal "value" keeps incommensurate headlines apart — a train
+    # bench's graphs/sec and a serve bench's req/s must never share one
+    # rolling baseline just because both spell their number "value".
+    headline_name = doc.get("metric")
     out: list[LedgerEntry] = []
 
     def emit(stage: str, metric: str, value: float) -> None:
+        if (stage == "headline" and metric == "value"
+                and isinstance(headline_name, str) and headline_name):
+            metric = headline_name
         out.append(LedgerEntry(stage=stage, metric=metric, value=value,
                                device_kind=device, git_rev=rev,
                                emitted_at=emitted, source=source))

@@ -232,6 +232,16 @@ class SLOEngine:
         with self._lock:
             return [self._status_locked(spec, now) for spec in self.specs]
 
+    def worst_fast_burn(self) -> float | None:
+        """Max fast-window burn across the specs — the one-number
+        overload signal. The autoscaler reads it over HTTP (``/slo`` +
+        ``max_fast_burn``); the in-process brownout controller
+        (``serve/admission.py``) reads it here, off the same statuses,
+        so both planes act on one consistent signal surface."""
+        burns = [row["burn_fast"] for row in self.statuses()
+                 if row.get("burn_fast") is not None]
+        return max(burns, default=None)
+
     # -- exposition ---------------------------------------------------------
 
     def stage(self, reg: MetricsRegistry) -> None:

@@ -75,6 +75,9 @@ KNOWN_POINTS = (
     "frontend.worker_crash",
     "frontend.spawn_fail",
     "embcache.cache_corrupt",
+    "admission.bucket_exhausted",
+    "admission.deadline_blown",
+    "admission.brownout_force",
 )
 
 # One line per point; keys must equal KNOWN_POINTS (the analysis faults
@@ -151,6 +154,18 @@ POINT_DOCS = {
         "corrupt one function-embedding-cache payload at read — the entry "
         "must read as a MISS (level 1 re-embeds), never a decode crash "
         "(serve/embcache.py)"),
+    "admission.bucket_exhausted": (
+        "drain one (tenant, class) token bucket at admission — the request "
+        "sheds as a 429 with a deterministic Retry-After, never a 5xx "
+        "(serve/admission.py)"),
+    "admission.deadline_blown": (
+        "force one deadline check to judge the queue wait as past the "
+        "class deadline — the request sheds as a 429, never a 5xx "
+        "(serve/admission.py)"),
+    "admission.brownout_force": (
+        "force the brownout controller one level deeper on its next poll — "
+        "the transition is journaled and /healthz reports the new level "
+        "honestly (serve/admission.py)"),
 }
 
 

@@ -397,6 +397,18 @@ class FrontendPool:
         with self._lock:
             return self._depth
 
+    def signals(self) -> dict:
+        """The overload-signal surface the admission layer, autoscaler
+        and ``/healthz`` all read (ISSUE 18: one consistent surface):
+        live queue depth, the queue-wait reservoir p99, and liveness."""
+        return {
+            "queue_depth": self.queue_depth(),
+            "queue_wait_p99_ms": (
+                self.metrics.frontend_queue_wait.quantile(0.99)
+                if self.metrics is not None else None),
+            "alive": self.alive,
+        }
+
     def encode_intervals(self) -> list[tuple[float, float]]:
         """Wall-clock ``(start, end)`` per completed encode — the bench
         intersects these with the batcher's dispatch intervals to measure

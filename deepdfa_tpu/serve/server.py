@@ -52,6 +52,7 @@ from deepdfa_tpu.obs.flightrec import install_sigusr2
 from deepdfa_tpu.pipeline import encode_source, load_vocabs, source_key
 from deepdfa_tpu.resilience import faults
 
+from .admission import QOS_CLASSES, AdmissionController, BrownoutController
 from .batcher import MicroBatcher, QueueFullError
 from .cache import ScanCache
 from .engine import OversizeGraphError, ScoringEngine
@@ -158,6 +159,23 @@ class ScoreServer:
                 tracer=self.tracer, vocab_source=vocab_source)
             if self.frontend is not None:
                 self.frontend.start()
+        # admission control + QoS classes + brownout (serve/admission.py):
+        # shed load BEFORE encode cost is paid — always a 429 with a
+        # deterministic Retry-After, never a 5xx; under sustained SLO burn
+        # the brownout controller steps through declared degradation
+        # levels (invariant candidate 30)
+        adm_cfg = self.cfg.admission
+        self.admission = None
+        self.brownout = None
+        if adm_cfg.enabled:
+            self.admission = AdmissionController(
+                adm_cfg, metrics=self.metrics, journal=journal,
+                flight=self.flight)
+            if adm_cfg.brownout:
+                self.brownout = BrownoutController(
+                    adm_cfg, self._observe_fast_burn, metrics=self.metrics,
+                    journal=journal, flight=self.flight).start()
+                self.admission.brownout = self.brownout
         self._draining = threading.Event()
         self._stop_requested = threading.Event()
         self._stopped = threading.Event()
@@ -223,6 +241,8 @@ class ScoreServer:
         """Refuse new scores, drain queue + in-flight handlers, close."""
         self._draining.set()
         self._stop_requested.set()
+        if self.brownout is not None:
+            self.brownout.stop()
         if self.frontend is not None and self._owns_frontend:
             self.frontend.stop(drain=drain, timeout=self.cfg.drain_timeout_s)
         self.batcher.stop(drain=drain, timeout=self.cfg.drain_timeout_s)
@@ -240,6 +260,10 @@ class ScoreServer:
         self._stopped.set()
         snap = self.metrics.snapshot()
         snap["cache"] = self.cache.stats()
+        if self.admission is not None:
+            snap["admission"] = self.admission.summary()
+        if self.brownout is not None:
+            snap["brownout"] = self.brownout.summary()
         return snap
 
     # -- verdict layer (/slo) ----------------------------------------------
@@ -267,12 +291,14 @@ class ScoreServer:
             "cascade_degraded_total": snap.get("cascade_degraded_total"),
         }
 
-    def render_slo(self) -> str:
-        """The ``/slo`` body: evaluate the specs against the live
-        snapshot, journal any alert transitions as events, refresh the
-        ``alerts.json`` promotion veto, render through the shared
-        registry (invariant 16). None of the side effects can fail the
-        scrape (invariant 14 — drops count in ``obs_dropped_total``)."""
+    def _observe_slo(self) -> None:
+        """One SLO evaluation against the live snapshot: journal any
+        alert transitions as events and refresh the ``alerts.json``
+        promotion veto. Both the ``/slo`` scrape and the brownout
+        controller's poll drive this same path, so transitions are
+        journaled identically no matter who observes first. None of the
+        side effects can fail the caller (invariant 14 — drops count in
+        ``obs_dropped_total``)."""
         events = self.slo.observe(self._slo_snapshot())
         if events:
             for evt in events:
@@ -292,7 +318,19 @@ class ScoreServer:
                 if write_alerts_artifact(self.alerts_path,
                                          self.slo.statuses()) is None:
                     self.slo.dropped_total += 1
+
+    def render_slo(self) -> str:
+        """The ``/slo`` body, rendered through the shared registry
+        (invariant 16) after one evaluation pass."""
+        self._observe_slo()
         return self.slo.render("deepdfa_serve_")
+
+    def _observe_fast_burn(self) -> float | None:
+        """The brownout controller's signal source: drive one SLO
+        evaluation (the exact path a ``/slo`` scrape drives) and return
+        the worst fast-window burn across the specs."""
+        self._observe_slo()
+        return self.slo.worst_fast_burn()
 
     # -- request handling ---------------------------------------------------
 
@@ -307,6 +345,14 @@ class ScoreServer:
         source = payload.get("source") if isinstance(payload, dict) else None
         if not isinstance(source, str) or not source.strip():
             return 400, {"error": "body must be JSON with a 'source' string"}
+        # QoS tagging (serve/admission.py): every request carries a
+        # priority class (default interactive — a human waiting on a
+        # score) and a tenant for its token bucket
+        qos = payload.get("class") or "interactive"
+        if qos not in QOS_CLASSES:
+            return 400, {"error": f"class must be one of "
+                                  f"{'/'.join(QOS_CLASSES)}"}
+        tenant = payload.get("tenant") or "default"
         if self.draining:
             return 503, {"error": "server is draining"}
         if faults.fire("serve.drop_request"):
@@ -325,7 +371,23 @@ class ScoreServer:
                     entry is not None and entry.results is None
                     and entry.encoded is not None)
         if entry is not None and entry.results is not None:
+            # a result-level hit costs no encode or score work, so it is
+            # served at EVERY brownout level without spending a token —
+            # exactly the "warm-cache hits" half of brownout level 2
             return 200, {"results": entry.results, "cached": True}
+
+        # admission control sits here — after the free cache hit, before
+        # any encode cost is paid. A shed is a 429 with a deterministic
+        # Retry-After (from bucket refill state), never a 5xx, and the
+        # decision is already journaled + in the flight ring by the
+        # controller (invariant 20)
+        if self.admission is not None:
+            decision = self.admission.admit(tenant, qos)
+            if not decision["admit"]:
+                return 429, {"error": "request shed by admission control",
+                             "reason": decision["reason"],
+                             "class": qos,
+                             "retry_after_s": decision["retry_after_s"]}
 
         if entry is not None and entry.encoded is not None:
             encoded = entry.encoded  # frontend skipped: encode-level hit
@@ -393,6 +455,12 @@ class ScoreServer:
             row["tier"] = 1
             row["tier1_score"] = round(prob, 6)
             if not cascade.in_band(prob):
+                continue
+            if (self.brownout is not None
+                    and not cascade.escalation_allowed(self.brownout.level)):
+                # brownout level >= 2 is tier-1 only: the tier-1 answer
+                # is served, no tier-2 capacity is spent
+                self.metrics.inc("brownout_suppressed_escalations_total")
                 continue
             self.metrics.inc("cascade_escalated_total")
             with self._span("cascade.escalate", score=round(prob, 6),
@@ -476,12 +544,15 @@ def _make_handler(server: ScoreServer):
         def log_message(self, fmt, *args):  # route BaseHTTPServer noise
             logger.debug("http: " + fmt, *args)
 
-        def _send(self, code: int, body, content_type="application/json"):
+        def _send(self, code: int, body, content_type="application/json",
+                  extra_headers=None):
             data = (body.encode() if isinstance(body, str)
                     else json.dumps(body).encode())
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
+            for name, value in (extra_headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
 
@@ -514,7 +585,23 @@ def _make_handler(server: ScoreServer):
                                 {"mode": server.frontend.cfg.mode,
                                  "alive": server.frontend.alive}
                                 if server.frontend is not None
-                                else {"mode": "inline", "alive": True})})
+                                else {"mode": "inline", "alive": True}),
+                            # the overload-signal surface (ISSUE 18): the
+                            # admission layer, autoscaler and federation
+                            # router read these same two numbers, and the
+                            # brownout level is reported honestly — a
+                            # browned-out replica must say so
+                            "frontend_queue_wait_p99_ms": (
+                                server.metrics.frontend_queue_wait
+                                .quantile(0.99)),
+                            "admission": server.admission is not None,
+                            "brownout_level": (
+                                server.brownout.level
+                                if server.brownout is not None else 0),
+                            "brownout": (
+                                server.brownout.level_name
+                                if server.brownout is not None
+                                else "normal")})
             elif self.path == "/metrics":
                 self._send(200, server.metrics.render(server.cache.stats()),
                            content_type="text/plain; version=0.0.4")
@@ -556,7 +643,13 @@ def _make_handler(server: ScoreServer):
                 server.flight.dump("handler_crash")
             finally:
                 server.metrics.inc("inflight", -1)
-            self._send(code, body)
+            headers = None
+            if code == 429 and isinstance(body, dict) \
+                    and "retry_after_s" in body:
+                # the shed contract (invariant candidate 30): every 429
+                # carries a Retry-After derived from bucket refill state
+                headers = {"Retry-After": str(body["retry_after_s"])}
+            self._send(code, body, extra_headers=headers)
             ms = (time.perf_counter() - t0) * 1000.0
             server.metrics.observe_response(code, ms)
             server.flight.record("request", code=code, ms=round(ms, 3))

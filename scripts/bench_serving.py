@@ -75,6 +75,21 @@ server backed by a hermetic tier-2 joint engine. The artifact gains a
 escalation fraction within ±20% of expected, ZERO degraded answers under
 nominal load, and tier-1 p50 (requests that never escalated) within 10%
 of the baseline phase.
+
+``--overload`` runs the admission/brownout sawtooth: ONE admission-enabled
+replica (generous interactive budget, deliberately tiny batch budget,
+short SLO windows so the burn signal tracks the sawtooth) takes an
+interactive-only nominal trickle, then a 10×-saturation mixed
+interactive+batch leg replayed until the brownout ladder visibly
+escalates, then a cache-hot recovery trickle until it steps back down.
+The artifact gains an ``admission`` block
+(``bench.assemble_admission_result``) gated on the explicit-overload
+contract (invariant candidate 30): nominal sheds ZERO, the saturation leg
+sheds (starting with the batch class), every shed is a 429 carrying its
+Retry-After header, zero 5xx anywhere (the interactive class above all),
+interactive sheds only after the ladder's last level, every decision
+journaled (zero drops), /healthz reported the degradation while it was
+happening, and the SLO burn the sawtooth paged stays within budget.
 """
 
 from __future__ import annotations
@@ -144,7 +159,7 @@ def _build_ckpt(cfg, vocabs):
 def _make_server(ckpt, vocabs, max_batch: int, max_wait_ms: float,
                  warm_store=None, journal=None, replica_id=None,
                  latency_window=None, obs=None, cascade=None,
-                 tier2_engine=None, frontend=None):
+                 tier2_engine=None, frontend=None, admission=None):
     """One ScoreServer replica over a FRESH engine from the shared
     checkpoint (each replica pays — or warm-loads — its own ladder)."""
     from deepdfa_tpu.config import ServeConfig
@@ -163,6 +178,8 @@ def _make_server(ckpt, vocabs, max_batch: int, max_wait_ms: float,
         extra["cascade"] = cascade
     if frontend is not None:
         extra["frontend"] = frontend
+    if admission is not None:
+        extra["admission"] = admission
     serve_cfg = ServeConfig(port=0, max_batch=max_batch,
                             max_wait_ms=max_wait_ms, **extra)
     return ScoreServer(engine, vocabs, serve_cfg, replica_id=replica_id,
@@ -374,6 +391,233 @@ def _run_phase_collect(port: int, bodies: list[str], concurrency: int):
     for t in threads:
         t.join()
     return time.perf_counter() - t0, errors["n"], results
+
+
+def _run_phase_admission(port: int, items: list[tuple[str, str]],
+                         concurrency: int):
+    """Closed loop like :func:`_run_phase`, but QoS-aware: ``items`` are
+    ``(qos_class, body)`` pairs and the collector records a per-class
+    histogram of response codes plus every 429 that arrived WITHOUT its
+    Retry-After header — the raw material of the admission gates
+    (``bench.assemble_admission_result``). A 429 is a shed doing its
+    job, never an error; a transport failure is recorded as code 599 so
+    it trips the zero-5xx gate honestly."""
+    import http.client
+
+    next_i = {"i": 0}
+    lock = threading.Lock()
+    responses: dict[str, dict[str, int]] = {}
+    missing = {"n": 0}
+
+    def worker():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=180)
+        while True:
+            with lock:
+                i = next_i["i"]
+                if i >= len(items):
+                    break
+                next_i["i"] = i + 1
+            klass, body = items[i]
+            try:
+                conn.request("POST", "/score", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                code = resp.status
+                retry_after = resp.getheader("Retry-After")
+            except Exception:
+                code, retry_after = 599, None
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=180)
+            with lock:
+                hist = responses.setdefault(klass, {})
+                hist[str(code)] = hist.get(str(code), 0) + 1
+                if code == 429 and retry_after is None:
+                    missing["n"] += 1
+        conn.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return {
+        "requests_total": len(items),
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+        "responses": responses,
+        "retry_after_missing": missing["n"],
+    }
+
+
+def _merge_admission_phase(acc: dict, part: dict) -> None:
+    """Fold one replay lap's collector dict into the accumulated phase."""
+    acc["requests_total"] += part["requests_total"]
+    acc["elapsed_s"] = round(acc["elapsed_s"] + part["elapsed_s"], 3)
+    acc["retry_after_missing"] += part["retry_after_missing"]
+    for cls, codes in part["responses"].items():
+        hist = acc["responses"].setdefault(cls, {})
+        for code, cnt in codes.items():
+            hist[code] = hist.get(code, 0) + cnt
+
+
+def _run_overload(ckpt, vocabs, base_sources, args, backend: str,
+                  device_kind: str) -> dict:
+    """The admission/brownout sawtooth (ISSUE 18, invariant candidate 30),
+    three legs against ONE admission-enabled replica:
+
+    1. **nominal** — interactive-only trickle (2 workers). The
+       interactive burst covers the whole leg, so ZERO sheds is a hard
+       gate, not a hope.
+    2. **saturation** — ``ADMISSION_SATURATION_X`` × the nominal count,
+       half batch, at full concurrency, replayed with fresh unique
+       bodies every lap until the brownout ladder visibly escalates
+       (bounded). The batch budget is deliberately tiny, so the batch
+       class sheds first and keeps shedding — 429 + Retry-After,
+       measured per response by the collector.
+    3. **recovery** — the nominal bodies replayed (content-addressed
+       cache hits: cheap, fast, admission-free) until the ladder steps
+       back to 0 (bounded).
+
+    Background samplers scrape ``/slo`` (burn seconds → the artifact's
+    ``slo_burn_minutes``) and ``/healthz`` (max ``brownout_level`` seen
+    mid-flight — the honesty gate: the endpoint must have reported the
+    degradation while it was happening, not after)."""
+    import http.client
+    import re
+
+    from bench import ADMISSION_SATURATION_X, assemble_admission_result
+
+    from deepdfa_tpu.config import AdmissionConfig, ObsConfig
+
+    n = max(8, args.requests // 2)
+    sat = ADMISSION_SATURATION_X
+
+    def _qos_bodies(offset: int, count: int, klass: str):
+        return [(klass, json.dumps({
+                    "source": _uniq_source(
+                        base_sources[i % len(base_sources)], offset + i),
+                    "class": klass}))
+                for i in range(count)]
+
+    # interactive budget effectively unbounded (the class must never
+    # bucket-shed — "interactive sheds LAST" means only the ladder's
+    # level 3 may touch it); batch budget tiny so saturation sheds it
+    # immediately; short brownout hysteresis so the ladder moves within
+    # the bench's bounded legs (same rationale as the autoscale stage's
+    # short SLO windows).
+    adm = AdmissionConfig(
+        enabled=True,
+        interactive_rate=500.0, interactive_burst=100_000.0,
+        batch_rate=1.0, batch_burst=4.0,
+        interactive_deadline_ms=120_000.0, batch_deadline_ms=1_000.0,
+        brownout=True, burn_high=1.4, burn_low=0.8,
+        up_consecutive=2, down_consecutive=4,
+        cooldown_s=1.0, poll_interval_s=0.25, max_level=3)
+    obs = ObsConfig(slo_p99_ms=100.0, slo_fast_window_s=2.0,
+                    slo_slow_window_s=4.0)
+    server = _make_server(ckpt, vocabs, args.max_batch, args.max_wait_ms,
+                          latency_window=64, obs=obs, admission=adm)
+    server.warmup()
+    server.start()
+
+    alert_re = re.compile(r"slo_alert\{[^}]*\}\s+1(?:\.0*)?\s*$", re.M)
+    alert = {"seconds": 0.0}
+    health = {"level_max": 0, "green": 0, "samples": 0}
+    sampler_stop = threading.Event()
+
+    def _sample():
+        period = 0.2
+        while not sampler_stop.wait(period):
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", server.port, timeout=2.0)
+                try:
+                    conn.request("GET", "/slo")
+                    slo_text = conn.getresponse().read().decode()
+                finally:
+                    conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", server.port, timeout=2.0)
+                try:
+                    conn.request("GET", "/healthz")
+                    resp = conn.getresponse()
+                    hz = json.loads(resp.read())
+                    status = resp.status
+                finally:
+                    conn.close()
+            except OSError:
+                continue
+            if alert_re.search(slo_text):
+                alert["seconds"] += period
+            health["samples"] += 1
+            health["level_max"] = max(health["level_max"],
+                                      int(hz.get("brownout_level") or 0))
+            if status == 200 and hz.get("status") == "ok":
+                health["green"] += 1
+
+    threading.Thread(target=_sample, daemon=True).start()
+
+    try:
+        # leg 1 — nominal trickle
+        nominal = _run_phase_admission(
+            server.port, _qos_bodies(400_000, n, "interactive"),
+            concurrency=2)
+
+        # leg 2 — saturation, replayed until the ladder escalates
+        overload = {"requests_total": 0, "elapsed_s": 0.0,
+                    "responses": {}, "retry_after_missing": 0}
+        lap, t_high = 0, time.perf_counter()
+        while True:
+            half = sat * n // 2
+            inter = _qos_bodies(500_000 + lap * 10_000, half, "interactive")
+            batch = _qos_bodies(700_000 + lap * 10_000, half, "batch")
+            mixed = [item for pair in zip(inter, batch) for item in pair]
+            _merge_admission_phase(
+                overload,
+                _run_phase_admission(server.port, mixed, args.concurrency))
+            lap += 1
+            escalated = (server.brownout is not None
+                         and server.brownout.level >= 1)
+            if escalated or time.perf_counter() - t_high > 25.0:
+                break
+
+        # leg 3 — recovery until the ladder steps back down (bounded)
+        recovery_laps = 0
+        t_low = time.perf_counter()
+        while (server.brownout is not None and server.brownout.level > 0
+               and time.perf_counter() - t_low < 30.0):
+            _run_phase_admission(
+                server.port, _qos_bodies(400_000, n, "interactive"),
+                concurrency=2)
+            recovery_laps += 1
+        recovered_level = (server.brownout.level
+                           if server.brownout is not None else None)
+    finally:
+        sampler_stop.set()
+        snap = server.shutdown()
+
+    return assemble_admission_result(
+        backend=backend, device_kind=device_kind, saturation_x=sat,
+        nominal=nominal, overload=overload,
+        admission=snap.get("admission") or {},
+        brownout=snap.get("brownout") or {},
+        slo_burn_minutes=alert["seconds"] / 60.0,
+        healthz_brownout_level_max=health["level_max"],
+        notes={
+            "nominal_requests": n,
+            "overload_laps": lap,
+            "recovery_laps": recovery_laps,
+            "recovered_level": recovered_level,
+            "healthz_samples": health["samples"],
+            "healthz_green_samples": health["green"],
+            "slo_p99_ms": obs.slo_p99_ms,
+            "interactive_rate": adm.interactive_rate,
+            "batch_rate": adm.batch_rate,
+            "batch_burst": adm.batch_burst,
+        })
 
 
 def _build_tier2(max_batch: int):
@@ -975,6 +1219,13 @@ def main(argv=None) -> dict:
     ap.add_argument("--frontend-mode", default="process",
                     choices=("process", "thread"), dest="frontend_mode",
                     help="serve.frontend.mode for the --frontend stage")
+    ap.add_argument("--overload", action="store_true",
+                    help="run the admission/brownout sawtooth stage: an "
+                    "admission-enabled replica takes a nominal trickle, a "
+                    "10x-saturation mixed interactive+batch leg, and a "
+                    "recovery trickle; gates the explicit-overload "
+                    "contract (429+Retry-After sheds, zero 5xx, batch "
+                    "first, interactive last, honest /healthz)")
     ap.add_argument("--cascade", action="store_true",
                     help="run the two-tier cascade stage: a no-cascade "
                     "baseline phase doubles as the tier-1 score oracle, "
@@ -1041,6 +1292,11 @@ def main(argv=None) -> dict:
         frontend = _run_frontend(ckpt, vocabs, base_sources, args,
                                  backend=backend, device_kind=device_kind)
 
+    admission = None
+    if args.overload:
+        admission = _run_overload(ckpt, vocabs, base_sources, args,
+                                  backend=backend, device_kind=device_kind)
+
     tiers = tier_precision = tier_refusal = None
     if args.tier_requests > 0:
         tiers, tier_precision, tier_refusal = _precision_tiers(
@@ -1065,6 +1321,7 @@ def main(argv=None) -> dict:
         autoscale=autoscale,
         cascade=cascade,
         frontend=frontend,
+        admission=admission,
         notes={
             "cold_requests_per_sec": round(len(bodies) / cold_s, 2),
             "hot_requests_per_sec": round(len(bodies) / hot_s, 2),

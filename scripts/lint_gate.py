@@ -16,6 +16,9 @@ call-graph/supergraph construction, the cross-function taint catch, the
 zero-call-edge solver parity property) + the hierarchical-scoring suite
 (``pytest -m 'hier and not slow'``: level-1 bit-identity, embedding-cache
 rotation/corruption hygiene, whole-unit score_unit routing) + the
+admission-control suite (``pytest -m 'admission and not slow'``: token
+buckets, deterministic Retry-After, brownout ladder, priority-inversion
+torture, the three ``admission.*`` chaos points) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
 lock-order, jit-purity/donation, fault-registry, fault-arming coverage,
 metrics conformance static passes) + the perf-regression ledger
@@ -156,6 +159,19 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("hier")
+
+    # the admission-control suite: token-bucket determinism (exact
+    # Retry-After pinning on injected clocks), the brownout ladder's
+    # hysteresis/cooldown decision loop, priority-inversion torture and
+    # the three admission.* chaos points through the real ScoreServer —
+    # stub engine, no compiles, pre-commit cadence
+    print("lint_gate: pytest -m 'admission and not slow'")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "admission and not slow",
+         "-q", "tests/test_admission.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("admission")
 
     # step 5: the invariant gate — AST passes for atomic-commit,
     # lock-order, jit-purity/donation, fault-registry, fault-arming

@@ -1,0 +1,836 @@
+"""Admission control, QoS classes, and brownout mode (serve/admission.py).
+
+Pins the explicit-overload contract of ISSUE 18 / invariant candidate 30:
+a shed is ALWAYS a 429 with a deterministic Retry-After (derived from
+bucket refill state — never wall-clock randomness, invariant 5), never a
+5xx; the batch class sheds first and the interactive class sheds only at
+the brownout ladder's last level; every decision is journaled and
+mirrored into the flight ring under invariant 20's no-fail rule; and
+``/healthz`` reports the brownout level honestly while it is happening.
+
+Unit layers (TokenBucket, AdmissionController, BrownoutController) run
+on injected clocks and scripted burn signals so every transition is
+exactly reproducible; the e2e layer drives a REAL ScoreServer over the
+stub-engine idiom of test_serve.py, including a priority-inversion
+torture phase (sustained batch pressure must never starve interactive)
+and the three ``admission.*`` chaos points.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.admission
+
+
+class _Clock:
+    """Injectable monotonic clock: tests own time."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _StubEngine:
+    """Real ScoringEngine over a stub score_fn (test_serve.py idiom)."""
+
+    def __new__(cls, vocabs=(), max_batch=4, prob=0.5):
+        from deepdfa_tpu.serve import ScoringEngine, serve_buckets
+
+        def score_fn(batch):
+            return np.full(batch.max_graphs, prob, np.float32)
+
+        return ScoringEngine(score_fn, serve_buckets(max_batch),
+                             feat_keys=tuple(vocabs))
+
+
+class _Journal:
+    """Recording journal stub; ``fail=True`` makes every write raise —
+    the invariant-20 drop path."""
+
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.events: list[dict] = []
+
+    def write(self, **kw):
+        if self.fail:
+            raise OSError("journal sink down")
+        self.events.append(kw)
+
+
+class _Flight:
+    def __init__(self):
+        self.events: list[tuple[str, dict]] = []
+
+    def record(self, kind, **kw):
+        self.events.append((kind, kw))
+
+
+@pytest.fixture(scope="module")
+def demo():
+    """(vocabs, sources) from a tiny hermetic corpus — real frontend +
+    real vocabularies, no training (test_serve.py idiom)."""
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    rows = demo_corpus(6, seed=0).to_dict("records")
+    cpgs = {int(r["id"]): add_dependence_edges(parse_source(r["before"]))
+            for r in rows}
+    labels = {int(r["id"]): int(r["vul"]) for r in rows}
+    _, vocabs = CorpusBuilder(FeatureConfig()).build(
+        cpgs, list(cpgs), graph_labels=labels)
+    return vocabs, [r["before"] for r in rows]
+
+
+def _req(port, method, path, body=None, timeout=30):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _post_score(port, source, klass=None, tenant=None, timeout=30):
+    payload = {"source": source}
+    if klass is not None:
+        payload["class"] = klass
+    if tenant is not None:
+        payload["tenant"] = tenant
+    status, headers, data = _req(port, "POST", "/score",
+                                 json.dumps(payload), timeout)
+    return status, headers, json.loads(data)
+
+
+def _uniq(base: str, i: int) -> str:
+    return f"{base}\nint adm_uniq_{i}(int a) {{\n  return a + {i};\n}}\n"
+
+
+def _admission_server(demo, **adm_kw):
+    from deepdfa_tpu.config import AdmissionConfig, ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, _ = demo
+    defaults = dict(enabled=True, poll_interval_s=60.0)
+    defaults.update(adm_kw)
+    acfg = AdmissionConfig(**defaults)
+    return ScoreServer(_StubEngine(vocabs), vocabs,
+                       ServeConfig(port=0, max_wait_ms=2.0, admission=acfg))
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+def test_admission_config_validation():
+    from deepdfa_tpu.config import AdmissionConfig
+
+    with pytest.raises(ValueError, match="interactive_rate"):
+        AdmissionConfig(interactive_rate=0.0)
+    with pytest.raises(ValueError, match="batch_burst"):
+        AdmissionConfig(batch_burst=-1.0)
+    with pytest.raises(ValueError, match="interactive_deadline_ms"):
+        AdmissionConfig(interactive_deadline_ms=0.0)
+    with pytest.raises(ValueError, match="depth_shed_factor"):
+        AdmissionConfig(depth_shed_factor=-1.0)
+    with pytest.raises(ValueError, match="burn_low < burn_high"):
+        AdmissionConfig(burn_high=1.0, burn_low=1.5)
+    with pytest.raises(ValueError, match="up_consecutive"):
+        AdmissionConfig(up_consecutive=0)
+    with pytest.raises(ValueError, match="cooldown_s"):
+        AdmissionConfig(cooldown_s=0.0)
+    with pytest.raises(ValueError, match="max_level"):
+        AdmissionConfig(max_level=4)
+    with pytest.raises(ValueError, match="max_level"):
+        AdmissionConfig(max_level=0)
+
+
+def test_admission_config_dotted_overrides_and_roundtrip(tmp_path):
+    from deepdfa_tpu.config import AdmissionConfig, load_config, to_json
+
+    cfg = load_config(overrides={"serve.admission.enabled": True,
+                                 "serve.admission.batch_rate": 5.0,
+                                 "serve.admission.batch_burst": 8.0,
+                                 "serve.admission.burn_high": 3.0,
+                                 "serve.admission.max_level": 2})
+    ac = cfg.serve.admission
+    assert isinstance(ac, AdmissionConfig)
+    assert (ac.enabled, ac.batch_rate, ac.batch_burst, ac.burn_high,
+            ac.max_level) == (True, 5.0, 8.0, 3.0, 2)
+    path = tmp_path / "cfg.json"
+    path.write_text(to_json(cfg))
+    assert load_config(path).serve.admission == ac
+    with pytest.raises(ValueError, match="max_level"):
+        load_config(overrides={"serve.admission.max_level": 9})
+
+
+# ---------------------------------------------------------------------------
+# token bucket (unit, injected clock)
+
+
+def test_token_bucket_refill_and_exhaustion():
+    from deepdfa_tpu.serve.admission import TokenBucket
+
+    clock = _Clock()
+    b = TokenBucket(rate=2.0, burst=4.0, clock=clock)
+    assert all(b.try_take() for _ in range(4))
+    assert not b.try_take()  # burst spent, no time passed
+    clock.advance(1.0)  # +2 tokens
+    assert b.try_take() and b.try_take() and not b.try_take()
+    clock.advance(100.0)
+    assert b.tokens() == 4.0  # refill clamps at burst
+
+
+def test_retry_after_is_deterministic_from_bucket_state():
+    """Invariant 5: Retry-After is a pure function of (deficit, rate) —
+    the exact values are pinned, not just 'some positive number'."""
+    from deepdfa_tpu.serve.admission import TokenBucket
+
+    clock = _Clock()
+    b = TokenBucket(rate=0.25, burst=1.0, clock=clock)
+    assert b.try_take()
+    assert b.retry_after_s() == 4  # deficit 1.0 / rate 0.25
+    clock.advance(2.0)  # tokens 0.5, deficit 0.5
+    assert b.retry_after_s() == 2
+    clock.advance(2.0)  # bucket whole again
+    assert b.retry_after_s() == 1  # floor: never "retry immediately"
+    # and the floor holds even for a full bucket
+    assert TokenBucket(rate=100.0, burst=100.0,
+                       clock=_Clock()).retry_after_s() == 1
+
+
+def test_bucket_drain_is_the_chaos_surface():
+    from deepdfa_tpu.serve.admission import TokenBucket
+
+    clock = _Clock()
+    b = TokenBucket(rate=1.0, burst=10.0, clock=clock)
+    b.drain()
+    assert not b.try_take() and b.retry_after_s() == 1
+    clock.advance(1.0)
+    assert b.try_take()  # refill resumes from the drain instant
+
+
+# ---------------------------------------------------------------------------
+# admission controller (unit)
+
+
+def _controller(metrics=None, journal=None, flight=None, clock=None,
+                **adm_kw):
+    from deepdfa_tpu.config import AdmissionConfig
+    from deepdfa_tpu.serve.admission import AdmissionController
+
+    defaults = dict(enabled=True)
+    defaults.update(adm_kw)
+    return AdmissionController(AdmissionConfig(**defaults), metrics=metrics,
+                               journal=journal, flight=flight,
+                               clock=clock or _Clock())
+
+
+def test_bucket_exhaustion_sheds_batch_not_interactive():
+    ctl = _controller(batch_rate=1.0, batch_burst=2.0,
+                      interactive_rate=100.0, interactive_burst=100.0)
+    batch = [ctl.admit("default", "batch") for _ in range(4)]
+    inter = [ctl.admit("default", "interactive") for _ in range(4)]
+    assert [d["admit"] for d in batch] == [True, True, False, False]
+    assert all(d["admit"] for d in inter)
+    shed = [d for d in batch if not d["admit"]]
+    assert all(d["reason"] == "bucket_exhausted" for d in shed)
+    assert all(d["retry_after_s"] == 1 for d in shed)  # rate 1.0, deficit 1
+    s = ctl.summary()
+    assert s["shed"] == {"batch": 2}
+    assert s["admitted"] == {"batch": 2, "interactive": 4}
+    assert s["shed_reasons"] == {"bucket_exhausted": 2}
+    assert s["interactive_sheds_before_brownout"] == 0
+
+
+def test_per_tenant_buckets_are_isolated():
+    ctl = _controller(batch_rate=1.0, batch_burst=1.0)
+    assert ctl.admit("acme", "batch")["admit"]
+    assert not ctl.admit("acme", "batch")["admit"]  # acme's budget spent
+    assert ctl.admit("globex", "batch")["admit"]  # globex untouched
+
+
+def test_deadline_blown_sheds_off_the_queue_wait_p99():
+    from deepdfa_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    for _ in range(32):
+        m.frontend_queue_wait.observe(5_000.0)  # p99 = 5s
+    ctl = _controller(metrics=m, interactive_deadline_ms=2_000.0,
+                      batch_deadline_ms=10_000.0)
+    d = ctl.admit("default", "interactive")
+    assert not d["admit"] and d["reason"] == "deadline_blown"
+    # batch's looser deadline still holds at 5s observed wait
+    assert ctl.admit("default", "batch")["admit"]
+
+
+def test_depth_guard_binds_batch_only():
+    from deepdfa_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    m.frontend_queue_depth = 100  # >> depth_shed_factor * batch_burst
+    ctl = _controller(metrics=m, batch_burst=4.0, depth_shed_factor=4.0)
+    assert ctl.admit("default", "interactive")["admit"]
+    d = ctl.admit("default", "batch")
+    assert not d["admit"] and d["reason"] == "deadline_blown"
+
+
+def test_shed_decisions_journaled_and_flight_mirrored():
+    journal, flight = _Journal(), _Flight()
+    ctl = _controller(journal=journal, flight=flight,
+                      batch_rate=1.0, batch_burst=1.0)
+    ctl.admit("default", "batch")
+    ctl.admit("default", "batch")  # shed
+    (ev,) = journal.events
+    assert ev["event"] == "admission_shed"
+    assert (ev["class"], ev["reason"]) == ("batch", "bucket_exhausted")
+    assert ev["retry_after_s"] == 1
+    ((kind, rec),) = flight.events
+    assert kind == "admission.shed" and rec["class"] == "batch"
+    assert ctl.summary()["journal_drops"] == 0
+
+
+def test_journal_failure_never_fails_the_decision():
+    """Invariant 20: the journal sink raising must not turn a shed into
+    an exception — the decision stands, the drop is counted."""
+    ctl = _controller(journal=_Journal(fail=True),
+                      batch_rate=1.0, batch_burst=1.0)
+    ctl.admit("default", "batch")
+    d = ctl.admit("default", "batch")
+    assert not d["admit"] and d["retry_after_s"] == 1
+    assert ctl.summary()["journal_drops"] == 1
+
+
+# ---------------------------------------------------------------------------
+# brownout controller (unit, scripted burn + injected clock)
+
+
+def _brownout(burns, clock=None, journal=None, flight=None, metrics=None,
+              **adm_kw):
+    from deepdfa_tpu.config import AdmissionConfig
+    from deepdfa_tpu.serve.admission import BrownoutController
+
+    defaults = dict(enabled=True, burn_high=2.0, burn_low=0.5,
+                    up_consecutive=2, down_consecutive=2, cooldown_s=5.0,
+                    poll_interval_s=60.0)
+    defaults.update(adm_kw)
+    it = iter(burns)
+    return BrownoutController(AdmissionConfig(**defaults),
+                              burn_fn=lambda: next(it),
+                              metrics=metrics, journal=journal,
+                              flight=flight, clock=clock or _Clock())
+
+
+def test_brownout_escalates_on_sustained_burn_only():
+    clock = _Clock()
+    bc = _brownout([3.0, 3.0], clock=clock)
+    assert bc.poll_once() == []  # streak 1 < up_consecutive
+    (t,) = bc.poll_once()
+    assert (t["level_from"], t["level_to"], t["reason"]) == (0, 1,
+                                                             "burn_high")
+    assert bc.level == 1 and bc.level_name == "shed_batch"
+
+
+def test_brownout_cooldown_blocks_consecutive_escalations():
+    clock = _Clock()
+    bc = _brownout([3.0] * 6, clock=clock)
+    bc.poll_once(), bc.poll_once()  # -> level 1, cooldown starts
+    assert bc.poll_once() == [] and bc.poll_once() == []  # cooling
+    assert bc.level == 1
+    clock.advance(6.0)  # past cooldown_s=5; streak already rebuilt
+    assert bc.poll_once()[0]["level_to"] == 2
+
+
+def test_brownout_dead_band_resets_streaks():
+    bc = _brownout([3.0, 1.0, 3.0, 3.0])  # dead band between the highs
+    assert bc.poll_once() == [] and bc.poll_once() == []
+    assert bc.poll_once() == []  # streak restarted from the dead band
+    assert bc.poll_once()[0]["level_to"] == 1
+
+
+def test_brownout_recovers_and_clamps_at_zero():
+    clock = _Clock()
+    bc = _brownout([3.0, 3.0, 0.1, 0.1, 0.1, 0.1], clock=clock)
+    bc.poll_once(), bc.poll_once()
+    assert bc.level == 1
+    clock.advance(6.0)
+    bc.poll_once(), bc.poll_once()  # two lows -> step down
+    assert bc.level == 0
+    clock.advance(6.0)
+    bc.poll_once(), bc.poll_once()  # already normal: no negative level
+    assert bc.level == 0 and bc.summary()["transitions_total"] == 2
+
+
+def test_brownout_clamps_at_max_level():
+    clock = _Clock()
+    bc = _brownout([3.0] * 10, clock=clock, max_level=1)
+    bc.poll_once(), bc.poll_once()
+    assert bc.level == 1
+    clock.advance(6.0)
+    assert bc.poll_once() == [] and bc.poll_once() == []
+    assert bc.level == 1  # the configured ceiling held
+
+
+def test_brownout_transitions_journaled_and_counted():
+    journal, flight = _Journal(), _Flight()
+    from deepdfa_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    bc = _brownout([3.0, 3.0], journal=journal, flight=flight, metrics=m)
+    bc.poll_once(), bc.poll_once()
+    (ev,) = journal.events
+    assert ev["event"] == "brownout_transition"
+    assert (ev["level_from"], ev["level_to"]) == (0, 1)
+    assert ev["level_name"] == "shed_batch" and ev["reason"] == "burn_high"
+    ((kind, _),) = flight.events
+    assert kind == "brownout.transition"
+    assert m.brownout_level == 1 and m.brownout_transitions_total == 1
+
+
+def test_brownout_journal_failure_counts_drop_not_raise():
+    bc = _brownout([3.0, 3.0], journal=_Journal(fail=True))
+    bc.poll_once(), bc.poll_once()
+    assert bc.level == 1
+    assert bc.summary()["journal_drops"] == 1
+
+
+def test_brownout_none_burn_is_a_noop():
+    bc = _brownout([None, 3.0, 3.0])
+    assert bc.poll_once() == []
+    bc.poll_once()
+    assert bc.poll_once()[0]["level_to"] == 1  # None didn't feed a streak
+
+
+def test_cascade_escalation_gated_by_brownout_level():
+    from deepdfa_tpu.config import CascadeConfig
+    from deepdfa_tpu.serve.cascade import CascadeRouter
+
+    router = CascadeRouter(CascadeConfig(), engine=None)
+    assert router.escalation_allowed(0) and router.escalation_allowed(1)
+    assert not router.escalation_allowed(2)  # tier-1 only from level 2
+    assert not router.escalation_allowed(3)
+
+
+# ---------------------------------------------------------------------------
+# chaos points (seed determinism + registration)
+
+
+@pytest.mark.faults
+def test_admission_points_are_registered():
+    from deepdfa_tpu.resilience.faults import KNOWN_POINTS, POINT_DOCS
+
+    for point in ("admission.bucket_exhausted", "admission.deadline_blown",
+                  "admission.brownout_force"):
+        assert point in KNOWN_POINTS
+        assert "admission" in POINT_DOCS[point]
+
+
+@pytest.mark.faults
+def test_admission_fault_schedules_are_seed_deterministic():
+    """Invariant 5 for the admission points: same seed, same schedule."""
+    from deepdfa_tpu.resilience.faults import FaultSpec
+
+    for point in ("admission.bucket_exhausted", "admission.deadline_blown",
+                  "admission.brownout_force"):
+        a = FaultSpec(point, prob=0.3, seed=7).schedule(200)
+        b = FaultSpec(point, prob=0.3, seed=7).schedule(200)
+        c = FaultSpec(point, prob=0.3, seed=8).schedule(200)
+        assert a == b and any(a)
+        assert a != c
+
+
+@pytest.mark.faults
+def test_fault_bucket_exhausted_drains_the_real_bucket():
+    from deepdfa_tpu.resilience import faults
+
+    ctl = _controller(batch_rate=1.0, batch_burst=50.0)
+    with faults.installed("admission.bucket_exhausted@1"):
+        d = ctl.admit("default", "batch")
+    assert not d["admit"] and d["reason"] == "bucket_exhausted"
+    assert d["retry_after_s"] == 1  # deficit 1 over rate 1 — real bucket math
+
+
+@pytest.mark.faults
+def test_fault_deadline_blown_forces_the_judgment():
+    from deepdfa_tpu.resilience import faults
+
+    ctl = _controller()  # no metrics: deadline can't trip on its own
+    with faults.installed("admission.deadline_blown@1"):
+        d = ctl.admit("default", "interactive")
+    assert not d["admit"] and d["reason"] == "deadline_blown"
+    assert ctl.admit("default", "interactive")["admit"]  # one-shot fault
+
+
+@pytest.mark.faults
+def test_fault_brownout_force_steps_one_level():
+    from deepdfa_tpu.resilience import faults
+
+    bc = _brownout([0.0] * 8)  # burn says healthy; the fault overrides
+    with faults.installed("admission.brownout_force@1"):
+        (t,) = bc.poll_once()
+    assert (t["level_to"], t["reason"]) == (1, "fault_injected")
+    with faults.installed("admission.brownout_force"):
+        bc.poll_once(), bc.poll_once()
+        assert bc.level == 3
+        assert bc.poll_once() == []  # clamped at max_level even under chaos
+
+
+# ---------------------------------------------------------------------------
+# server e2e: the 429 + Retry-After contract over real HTTP
+
+
+def test_unknown_class_is_a_400(demo):
+    _, sources = demo
+    srv = _admission_server(demo).start()
+    try:
+        status, _, body = _post_score(srv.port, sources[0], klass="turbo")
+        assert status == 400
+        assert "class must be one of" in body["error"]
+    finally:
+        srv.shutdown()
+
+
+def test_shed_is_429_with_retry_after_header(demo):
+    _, sources = demo
+    srv = _admission_server(demo, batch_rate=0.25, batch_burst=1.0).start()
+    try:
+        s0, _, _ = _post_score(srv.port, _uniq(sources[0], 0), klass="batch")
+        assert s0 == 200
+        status, headers, body = _post_score(srv.port, _uniq(sources[0], 1),
+                                            klass="batch")
+        assert status == 429
+        assert body["reason"] == "bucket_exhausted"
+        assert body["class"] == "batch"
+        # the header IS the body's deterministic bucket-derived value
+        assert headers["Retry-After"] == str(body["retry_after_s"])
+        assert 1 <= body["retry_after_s"] <= 4  # deficit <=1 over rate 0.25
+        # interactive rides its own budget: still admitted
+        si, _, _ = _post_score(srv.port, _uniq(sources[0], 2),
+                               klass="interactive")
+        assert si == 200
+    finally:
+        snap = srv.shutdown()
+    assert snap["admission"]["shed"] == {"batch": 1}
+    assert snap["admission"]["interactive_sheds_before_brownout"] == 0
+    (dec,) = snap["admission"]["decisions"]
+    assert dec["reason"] == "bucket_exhausted" and dec["level"] == 0
+
+
+def test_nominal_load_sheds_nothing(demo):
+    """The default budgets must not shed a modest interactive load —
+    admission control earns its keep ONLY under overload."""
+    _, sources = demo
+    srv = _admission_server(demo).start()
+    try:
+        statuses = [
+            _post_score(srv.port, _uniq(sources[i % len(sources)], i))[0]
+            for i in range(40)]
+        assert statuses == [200] * 40
+    finally:
+        snap = srv.shutdown()
+    assert snap["admission"]["shed_total"] == 0
+    assert snap["admission"]["admitted"] == {"interactive": 40}
+
+
+def test_cache_hits_bypass_admission(demo):
+    """Warm-cache hits are free — served at every brownout level without
+    spending a token (the level-2 contract's cache half)."""
+    _, sources = demo
+    srv = _admission_server(demo, interactive_rate=1.0,
+                            interactive_burst=1.0).start()
+    try:
+        body = _uniq(sources[0], 0)
+        assert _post_score(srv.port, body)[0] == 200  # spends THE token
+        # replay: content-addressed hit, no admission, no token
+        assert _post_score(srv.port, body)[0] == 200
+        # a fresh body now has no token to take
+        status, headers, _ = _post_score(srv.port, _uniq(sources[0], 1))
+        assert status == 429 and "Retry-After" in headers
+    finally:
+        snap = srv.shutdown()
+    assert snap["cache"]["hits"] == 1
+    assert snap["admission"]["admitted"] == {"interactive": 1}
+
+
+def test_priority_inversion_torture(demo):
+    """Sustained batch pressure from many workers must never starve the
+    interactive class: every interactive request answers 200, zero 5xx
+    anywhere, and not one interactive shed (the brownout ladder never
+    moved — its level-3 last resort is the only legal interactive shed)."""
+    _, sources = demo
+    srv = _admission_server(demo, batch_rate=0.5, batch_burst=2.0,
+                            interactive_rate=10_000.0,
+                            interactive_burst=10_000.0).start()
+    codes = {"batch": [], "interactive": []}
+    lock = threading.Lock()
+
+    def _hammer(klass, count, offset):
+        for i in range(count):
+            status, _, _ = _post_score(
+                srv.port, _uniq(sources[(offset + i) % len(sources)],
+                                offset + i), klass=klass)
+            with lock:
+                codes[klass].append(status)
+
+    try:
+        threads = ([threading.Thread(target=_hammer,
+                                     args=("batch", 20, 1000 + 100 * k))
+                    for k in range(4)]
+                   + [threading.Thread(target=_hammer,
+                                       args=("interactive", 10,
+                                             5000 + 100 * k))
+                      for k in range(2)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        snap = srv.shutdown()
+    assert codes["interactive"] == [200] * 20  # never starved, never shed
+    assert set(codes["batch"]) <= {200, 429}  # sheds are 429, NEVER 5xx
+    assert 429 in codes["batch"]  # the pressure actually exceeded budget
+    assert snap["admission"]["interactive_sheds_before_brownout"] == 0
+    assert snap["admission"]["journal_drops"] == 0
+    assert not any(c >= 500 for c in codes["batch"] + codes["interactive"])
+
+
+def test_healthz_exposes_admission_signals(demo):
+    _, sources = demo
+    srv = _admission_server(demo).start()
+    try:
+        _post_score(srv.port, sources[0])
+        status, _, data = _req(srv.port, "GET", "/healthz")
+        health = json.loads(data)
+        assert status == 200 and health["status"] == "ok"
+        assert health["admission"] is True
+        assert health["brownout_level"] == 0
+        assert health["brownout"] == "normal"
+        assert "frontend_queue_wait_p99_ms" in health
+    finally:
+        srv.shutdown()
+
+
+def test_metrics_render_admission_series(demo):
+    _, sources = demo
+    srv = _admission_server(demo, batch_rate=0.25, batch_burst=1.0).start()
+    try:
+        _post_score(srv.port, _uniq(sources[0], 0), klass="batch")
+        _post_score(srv.port, _uniq(sources[0], 1), klass="batch")  # shed
+        _, _, data = _req(srv.port, "GET", "/metrics")
+        text = data.decode()
+        assert 'admission_admitted_total{class="batch"} 1' in text
+        assert 'admission_shed_total{class="batch"} 1' in text
+        assert "brownout_level 0" in text
+        assert "brownout_transitions_total 0" in text
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.faults
+def test_chaos_brownout_ladder_through_real_server(demo):
+    """``admission.brownout_force`` walks the REAL server's ladder while
+    requests are in flight: level 1 sheds batch with reason=brownout
+    (token budget untouched), level 3 finally sheds interactive, cache
+    hits answer 200 at EVERY level, /healthz reports each level honestly,
+    and recovery restores admission — never a 5xx anywhere."""
+    from deepdfa_tpu.resilience import faults
+
+    _, sources = demo
+    srv = _admission_server(demo).start()
+    try:
+        cached = _uniq(sources[0], 0)
+        assert _post_score(srv.port, cached)[0] == 200
+
+        with faults.installed("admission.brownout_force@1"):
+            (t,) = srv.brownout.poll_once()
+        assert t["reason"] == "fault_injected" and srv.brownout.level == 1
+
+        # level 1: batch sheds via class policy, interactive unaffected
+        status, headers, body = _post_score(srv.port, _uniq(sources[1], 1),
+                                            klass="batch")
+        assert status == 429 and body["reason"] == "brownout"
+        assert headers["Retry-After"] == str(body["retry_after_s"])
+        assert _post_score(srv.port, _uniq(sources[2], 2))[0] == 200
+        _, _, data = _req(srv.port, "GET", "/healthz")
+        health = json.loads(data)
+        assert health["status"] == "ok"  # degraded is NOT dead
+        assert (health["brownout_level"], health["brownout"]) == (
+            1, "shed_batch")
+
+        with faults.installed("admission.brownout_force"):
+            srv.brownout.poll_once(), srv.brownout.poll_once()
+        assert srv.brownout.level == 3
+
+        # level 3: the last resort — interactive sheds too, 429 not 5xx
+        status, headers, body = _post_score(srv.port, _uniq(sources[3], 3))
+        assert status == 429 and body["reason"] == "brownout"
+        assert "Retry-After" in headers
+        # ... but the warm cache still answers at the deepest level
+        assert _post_score(srv.port, cached)[0] == 200
+        _, _, data = _req(srv.port, "GET", "/healthz")
+        assert json.loads(data)["brownout"] == "shed_interactive"
+
+        # interactive shed AT level 3 is the contract, not a violation
+        assert (srv.admission.summary()
+                ["interactive_sheds_before_brownout"]) == 0
+    finally:
+        snap = srv.shutdown()
+    assert snap["brownout"]["transitions_total"] == 3
+    assert snap["brownout"]["max_level_seen"] == 3
+    assert all(t["reason"] == "fault_injected"
+               for t in snap["brownout"]["transitions"])
+
+
+@pytest.mark.faults
+def test_chaos_bucket_exhausted_through_real_server(demo):
+    """An armed ``admission.bucket_exhausted`` drains the live bucket:
+    the request sheds 429 + Retry-After through real HTTP — the genuine
+    exhaustion path, not a simulated branch — and the next request rides
+    the refill."""
+    from deepdfa_tpu.resilience import faults
+
+    _, sources = demo
+    srv = _admission_server(demo, interactive_rate=100.0,
+                            interactive_burst=100.0).start()
+    try:
+        with faults.installed("admission.bucket_exhausted@1"):
+            status, headers, body = _post_score(srv.port,
+                                                _uniq(sources[0], 0))
+        assert status == 429 and body["reason"] == "bucket_exhausted"
+        assert headers["Retry-After"] == str(body["retry_after_s"])
+        time.sleep(0.05)  # rate 100/s: the drained bucket refills fast
+        assert _post_score(srv.port, _uniq(sources[0], 1))[0] == 200
+        _, _, data = _req(srv.port, "GET", "/healthz")
+        assert json.loads(data)["status"] == "ok"
+    finally:
+        snap = srv.shutdown()
+    assert snap["admission"]["shed_reasons"] == {"bucket_exhausted": 1}
+    assert not any(c >= 500 for c in snap["responses_total"])
+
+
+@pytest.mark.faults
+def test_chaos_deadline_blown_through_real_server(demo):
+    from deepdfa_tpu.resilience import faults
+
+    _, sources = demo
+    srv = _admission_server(demo).start()
+    try:
+        with faults.installed("admission.deadline_blown@1"):
+            status, headers, body = _post_score(srv.port,
+                                                _uniq(sources[0], 0))
+        assert status == 429 and body["reason"] == "deadline_blown"
+        assert "Retry-After" in headers
+        assert _post_score(srv.port, _uniq(sources[0], 1))[0] == 200
+    finally:
+        snap = srv.shutdown()
+    assert snap["admission"]["shed_reasons"] == {"deadline_blown": 1}
+    assert not any(c >= 500 for c in snap["responses_total"])
+
+
+# ---------------------------------------------------------------------------
+# bench contract (perf_contract: schema + gates without a server)
+
+
+def _green_admission_kwargs():
+    return dict(
+        backend="cpu", device_kind="cpu", saturation_x=10,
+        nominal={"requests_total": 20,
+                 "responses": {"interactive": {"200": 20}},
+                 "retry_after_missing": 0},
+        overload={"requests_total": 200,
+                  "responses": {"interactive": {"200": 100},
+                                "batch": {"200": 20, "429": 80}},
+                  "retry_after_missing": 0},
+        admission={"interactive_sheds_before_brownout": 0,
+                   "journal_drops": 0},
+        brownout={"transitions_total": 2, "max_level_seen": 1,
+                  "journal_drops": 0},
+        slo_burn_minutes=0.4,
+        healthz_brownout_level_max=1)
+
+
+@pytest.mark.perf_contract
+def test_admission_result_green_path():
+    from bench import assemble_admission_result
+
+    r = assemble_admission_result(**_green_admission_kwargs())
+    assert r["ok"] is True
+    assert r["metric"] == "admission_slo_burn_minutes"
+    assert (r["unit"], r["value"]) == ("min", 0.4)
+    assert r["nominal_shed_total"] == 0
+    assert r["overload_shed_total"] == 80 and r["batch_shed_total"] == 80
+    assert r["responses_5xx_total"] == 0
+    assert r["healthz_honest"] is True
+    assert r["brownout_max_level"] == 1
+
+
+@pytest.mark.perf_contract
+def test_admission_gates_fail_closed():
+    """Each half of the overload contract flips ok on its own: a 5xx to
+    the interactive class, a missing Retry-After, a nominal shed, an
+    early interactive shed, a ladder that never moved, a lying /healthz,
+    a dropped journal write, a blown burn budget."""
+    from bench import assemble_admission_result
+
+    def _not_ok(**mut):
+        kw = _green_admission_kwargs()
+        kw.update(mut)
+        return assemble_admission_result(**kw)
+
+    r = _not_ok(overload={"requests_total": 10,
+                          "responses": {"interactive": {"200": 9,
+                                                        "500": 1},
+                                        "batch": {"429": 5}},
+                          "retry_after_missing": 0})
+    assert r["ok"] is False and r["interactive_5xx_total"] == 1
+    kw = _green_admission_kwargs()
+    kw["overload"]["retry_after_missing"] = 1
+    assert assemble_admission_result(**kw)["ok"] is False
+    kw = _green_admission_kwargs()
+    kw["nominal"]["responses"]["interactive"]["429"] = 1
+    r = assemble_admission_result(**kw)
+    assert r["ok"] is False and r["nominal_shed_total"] == 1
+    assert _not_ok(admission={"interactive_sheds_before_brownout": 3,
+                              "journal_drops": 0})["ok"] is False
+    assert _not_ok(brownout={"transitions_total": 0, "max_level_seen": 0,
+                             "journal_drops": 0})["ok"] is False
+    r = _not_ok(healthz_brownout_level_max=0)
+    assert r["ok"] is False and r["healthz_honest"] is False
+    assert _not_ok(admission={"interactive_sheds_before_brownout": 0,
+                              "journal_drops": 2})["ok"] is False
+    assert _not_ok(slo_burn_minutes=5.0)["ok"] is False
+    assert _not_ok(slo_burn_minutes=None)["ok"] is False
+
+
+@pytest.mark.perf_contract
+def test_serve_result_ands_admission_gate():
+    from bench import assemble_admission_result, assemble_serve_result
+
+    base = dict(backend="cpu", device_kind="cpu", requests_per_sec=100.0,
+                p50_ms=5.0, p99_ms=20.0, mean_batch_occupancy=0.9,
+                cache_hit_rate=0.5, cache_hits=32, requests_total=64,
+                errors_total=0)
+    green = assemble_admission_result(**_green_admission_kwargs())
+    assert assemble_serve_result(**base, admission=green)["ok"] is True
+    kw = _green_admission_kwargs()
+    kw["slo_burn_minutes"] = 9.0
+    red = assemble_admission_result(**kw)
+    r = assemble_serve_result(**base, admission=red)
+    assert r["ok"] is False and r["admission"]["ok"] is False
+    # no admission block: the serve gates stand alone (stage is opt-in)
+    assert assemble_serve_result(**base)["ok"] is True

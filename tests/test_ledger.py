@@ -219,6 +219,27 @@ def test_multichip_shape_becomes_ok_series():
         "multichip", "ok", 1.0)
 
 
+def test_headline_value_keyed_by_declared_metric_name():
+    """Artifacts that both spell their headline number ``value`` but
+    declare different ``metric`` names must land in DIFFERENT series — a
+    train bench's graphs/sec and a serve bench's req/s sharing one
+    rolling baseline is how an honest serve artifact goes red against
+    train history once the mixed series accrues enough entries to gate."""
+    train = {"metric": "ggnn_inference_graphs_per_sec", "value": 500.0,
+             "device_kind": "cpu"}
+    serve = {"metric": "serve_requests_per_sec", "value": 50.0,
+             "device_kind": "cpu"}
+    (t,) = iter_entries(train, source="BENCH_train.json")
+    (s,) = iter_entries(serve, source="BENCH_serve.json")
+    assert (t.stage, t.metric, t.value) == (
+        "headline", "ggnn_inference_graphs_per_sec", 500.0)
+    assert (s.stage, s.metric, s.value) == (
+        "headline", "serve_requests_per_sec", 50.0)
+    # a headline with no declared name keeps the literal key
+    (bare,) = iter_entries({"value": 1.0})
+    assert (bare.stage, bare.metric) == ("headline", "value")
+
+
 def test_unreadable_artifact_is_zero_rows_not_a_crash(tmp_path):
     bad = tmp_path / "BENCH_bad.json"
     bad.write_text("{torn json")
@@ -414,5 +435,38 @@ def test_hier_direction_flows_into_verdicts(tmp_path):
     ok, rows = Ledger.from_paths([tmp_path]).check()
     (row,) = [r for r in rows if r["metric"] == "fallback_dispatches"]
     assert row["stage"] == "hier"
+    assert row["lower_is_better"] is True
+    assert row["verdict"] == "regression" and ok is False
+
+
+def test_admission_series_are_explicitly_declared():
+    """Satellite pin (PR 18): the admission stage's series are DECLARED.
+    ``interactive_sheds_before_brownout`` and ``nominal_shed_total`` are
+    the ones the heuristic would get WRONG — no latency/error token in
+    either name, but any creep upward means the "interactive sheds last /
+    nominal sheds nothing" halves of invariant candidate 30 are eroding.
+    Overload shed counts are the mechanism working and stay untracked."""
+    for metric in ("slo_burn_minutes", "interactive_5xx_total",
+                   "responses_5xx_total", "nominal_shed_total",
+                   "interactive_sheds_before_brownout",
+                   "retry_after_missing", "journal_drops"):
+        assert EXPLICIT_SERIES[("admission", metric)] is True, metric
+        assert lower_is_better(metric, "admission") is True, metric
+    assert ("admission", "overload_shed_total") not in EXPLICIT_SERIES
+
+
+def test_admission_direction_flows_into_verdicts(tmp_path):
+    """A nominal_shed_total JUMP under the admission stage must go red
+    end to end — the serve artifact nests the admission block one level
+    down, so this also pins that the walker assigns stage="admission"
+    there."""
+    for i in range(4):
+        _art(tmp_path, f"BENCH_a{i:02d}.json", emitted=1000 + i,
+             admission={"nominal_shed_total": 0, "slo_burn_minutes": 0.2})
+    _art(tmp_path, "BENCH_a99.json", emitted=2000,
+         admission={"nominal_shed_total": 7, "slo_burn_minutes": 0.2})
+    ok, rows = Ledger.from_paths([tmp_path]).check()
+    (row,) = [r for r in rows if r["metric"] == "nominal_shed_total"]
+    assert row["stage"] == "admission"
     assert row["lower_is_better"] is True
     assert row["verdict"] == "regression" and ok is False
