@@ -1265,6 +1265,72 @@ def assemble_hier_result(n_functions, n_call_edges, cold_unit_score_ms,
     }
 
 
+def assemble_promotion_result(n_replicas, capture, shadow_same, shadow_diff,
+                              roll, rollback, responses_5xx,
+                              prior_rev_restored, notes=None, error=None):
+    """ONE-line artifact for the ``promotion`` stage
+    (``scripts/bench_promotion.py``): the whole continuous-learning
+    sawtooth on a live fleet — capture journaled traffic, shadow-replay
+    it against baseline + candidate engines, roll the candidate through
+    the router's drain/warm-join protocol, then force the drift watch
+    and prove the rollback restores the prior ``model_rev``. Gates are
+    the ISSUE 19 acceptance criteria verbatim: (a) the shadow harness is
+    honest — identical revs produce a ZERO-diff report while the
+    distinct-rev report measures a real difference; (b) the forward roll
+    completed with ``join_cold_compiles == 0`` (invariant 11) and zero
+    5xx surfaced through the router while replicas were swapped
+    (invariants 12/22); (c) the forced-drift leg rolled back —
+    ``rollback_total >= 1`` — and the PRIOR rev is what the ring serves
+    afterwards (invariant candidate 31's restore half); (d) capture
+    dropped nothing (invariant 20 is a counter, not a hope)."""
+    shadow_honest = (bool((shadow_same or {}).get("zero_diff"))
+                     and (shadow_diff or {}).get("max_abs_delta") is not None
+                     and (shadow_diff or {}).get("max_abs_delta", 0) > 0)
+    rollout_seconds = (roll or {}).get("rollout_seconds")
+    join_cold = ((roll or {}).get("join_cold_compiles", 0)
+                 + (rollback or {}).get("join_cold_compiles", 0))
+    rollback_total = (rollback or {}).get("rollback_total", 0)
+    capture_dropped = int((capture or {}).get("dropped") or 0)
+    ok = (error is None
+          and shadow_honest
+          and bool((roll or {}).get("completed"))
+          and rollout_seconds is not None
+          and join_cold == 0
+          and int(responses_5xx or 0) == 0
+          and rollback_total >= 1
+          and bool(prior_rev_restored)
+          and capture_dropped == 0
+          and int((capture or {}).get("written") or 0) > 0)
+    return {
+        "metric": "promotion_rollout_seconds",
+        "value": (None if rollout_seconds is None
+                  else round(float(rollout_seconds), 3)),
+        "unit": "s",
+        "backend": "cpu",
+        "device_kind": "host",
+        "promotion": {
+            "rollout_seconds": (None if rollout_seconds is None
+                                else round(float(rollout_seconds), 3)),
+            "rollback_total": int(rollback_total),
+            "join_cold_compiles": int(join_cold),
+        },
+        "n_replicas": int(n_replicas),
+        "capture": capture or {},
+        "shadow_same_max_abs_delta": (shadow_same or {}).get("max_abs_delta"),
+        "shadow_same_zero_diff": bool((shadow_same or {}).get("zero_diff")),
+        "shadow_diff_max_psi": (shadow_diff or {}).get("max_psi"),
+        "shadow_diff_max_abs_delta": (
+            shadow_diff or {}).get("max_abs_delta"),
+        "responses_5xx_total": int(responses_5xx or 0),
+        "prior_rev_restored": bool(prior_rev_restored),
+        "roll_completed": bool((roll or {}).get("completed")),
+        "notes": notes or {},
+        "error": error,
+        "ok": ok,
+        **_provenance_fields(),
+    }
+
+
 def bench_fused_train(corpus, n_batches: int, k: int,
                       dtype: str = "bfloat16", trials: int = 3):
     """The ``ggnn_fused_train`` stage: chained TRAIN steps (fwd + backward +

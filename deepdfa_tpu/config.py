@@ -569,6 +569,57 @@ class AdmissionConfig:
 
 
 @dataclass(frozen=True)
+class ContinualConfig:
+    """Continuous-learning loop knobs (``deepdfa_tpu/continual``; CLI:
+    ``--set serve.continual.*``): the sampled request-capture journal on
+    ``/score`` (invariant 20 — capture can never fail the request it
+    records), the shadow-replay gate thresholds, the promotion veto
+    freshness window, and the post-roll drift watch. Capture is off by
+    default — zero-change for existing deployments."""
+
+    enabled: bool = False
+    # request capture (continual/capture.py): JSONL journal of scored
+    # requests. None disables capture even when the loop is enabled.
+    capture_path: str | None = None
+    # sampling: record every Nth /score request (1 = every request)
+    capture_sample_every: int = 1
+    # bound on the journal: past this many records, capture stops
+    # (counted as sampled-out, never an error)
+    capture_max_records: int = 10000
+    # shadow replay (continual/shadow.py): score-histogram bins and the
+    # per-bucket PSI ceiling a candidate must stay under to pass
+    shadow_bins: int = 10
+    shadow_max_psi: float = 0.25
+    # promotion veto (obs/slo.py read_promotion_veto): an alerts.json
+    # older than this is STALE — no veto evidence, refuse to promote
+    veto_max_age_s: float = 3600.0
+    # post-roll drift watch (continual/promote.py): consecutive clean
+    # polls before the candidate is confirmed, and the poll cadence
+    drift_settle_polls: int = 3
+    poll_interval_s: float = 0.5
+    # per-replica warm-join budget during a roll
+    join_timeout_s: float = 120.0
+
+    def __post_init__(self):
+        if self.capture_sample_every < 1:
+            raise ValueError("capture_sample_every must be >= 1")
+        if self.capture_max_records < 1:
+            raise ValueError("capture_max_records must be >= 1")
+        if self.shadow_bins < 2:
+            raise ValueError("shadow_bins must be >= 2")
+        if self.shadow_max_psi <= 0:
+            raise ValueError("shadow_max_psi must be > 0")
+        if self.veto_max_age_s <= 0:
+            raise ValueError("veto_max_age_s must be > 0")
+        if self.drift_settle_polls < 1:
+            raise ValueError("drift_settle_polls must be >= 1")
+        if self.poll_interval_s <= 0:
+            raise ValueError("poll_interval_s must be > 0")
+        if self.join_timeout_s <= 0:
+            raise ValueError("join_timeout_s must be > 0")
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Online scoring service knobs (``deepdfa_tpu/serve``; CLI:
     ``--set serve.*``): the micro-batching window, admission control, the
@@ -617,6 +668,9 @@ class ServeConfig:
     frontend: FrontendConfig = field(default_factory=FrontendConfig)
     # admission control + QoS classes + brownout (serve/admission.py)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    # continuous-learning loop (deepdfa_tpu/continual): traffic capture,
+    # shadow replay, incremental retrain, checkpoint promotion
+    continual: ContinualConfig = field(default_factory=ContinualConfig)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -719,6 +773,7 @@ _NESTED: dict[tuple[str, str], type] = {
     ("ServeConfig", "cascade"): CascadeConfig,
     ("ServeConfig", "frontend"): FrontendConfig,
     ("ServeConfig", "admission"): AdmissionConfig,
+    ("ServeConfig", "continual"): ContinualConfig,
 }
 
 

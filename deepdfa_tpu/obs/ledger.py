@@ -152,6 +152,14 @@ EXPLICIT_SERIES: dict[tuple[str, str], bool] = {
     ("admission", "interactive_sheds_before_brownout"): True,
     ("admission", "retry_after_missing"): True,
     ("admission", "journal_drops"): True,
+    # the promotion stage (scripts/bench_promotion.py): the roll's
+    # wall-clock goes down; "rollback_total" counts rolls the drift
+    # watch reverted (the bench forces exactly one, so growth means the
+    # forward leg started failing too); "join_cold_compiles" is the
+    # invariant-11 warm-join gate — any nonzero value is a regression.
+    ("promotion", "rollout_seconds"): True,
+    ("promotion", "rollback_total"): True,
+    ("promotion", "join_cold_compiles"): True,
 }
 
 

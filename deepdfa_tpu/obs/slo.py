@@ -45,6 +45,7 @@ __all__ = [
     "router_specs",
     "train_specs",
     "write_alerts_artifact",
+    "read_promotion_veto",
 ]
 
 _KINDS = ("ratio", "max", "min")
@@ -373,3 +374,42 @@ def write_alerts_artifact(path, statuses, *, extra_alerts=(),
         return path
     except Exception:  # noqa: BLE001 — the veto artifact is advisory output
         return None
+
+
+def read_promotion_veto(path, *, max_age_s: float = 3600.0,
+                        clock=time.time) -> dict:
+    """The consuming half of :func:`write_alerts_artifact` — the
+    promotion tooling's veto check, and it is FAIL-CLOSED: a missing,
+    torn (unparseable / wrong shape), or stale (``generated_at_unix``
+    older than ``max_age_s``) ``alerts.json`` is *no veto evidence*, and
+    no evidence means refuse to promote. Only a fresh, well-formed
+    artifact with ``promotion_vetoed`` false yields ``allow=True``.
+
+    Returns ``{"allow", "reason", "vetoed", "age_s", "firing"}``;
+    ``vetoed``/``age_s`` are None when the artifact could not be read.
+    Never raises."""
+    refusal = {"allow": False, "vetoed": None, "age_s": None, "firing": []}
+    if path is None:
+        return {**refusal, "reason": "missing"}
+    try:
+        text = Path(path).read_text()
+    except (FileNotFoundError, OSError):
+        return {**refusal, "reason": "missing"}
+    try:
+        doc = json.loads(text)
+    except (json.JSONDecodeError, ValueError):
+        return {**refusal, "reason": "torn"}
+    if (not isinstance(doc, dict) or doc.get("schema") != 1
+            or not isinstance(doc.get("generated_at_unix"), (int, float))
+            or "promotion_vetoed" not in doc):
+        return {**refusal, "reason": "torn"}
+    age_s = float(clock()) - float(doc["generated_at_unix"])
+    firing = doc.get("firing") or []
+    if age_s > max_age_s:
+        return {**refusal, "reason": "stale", "age_s": round(age_s, 3),
+                "vetoed": bool(doc["promotion_vetoed"]), "firing": firing}
+    if doc["promotion_vetoed"]:
+        return {"allow": False, "reason": "vetoed", "vetoed": True,
+                "age_s": round(age_s, 3), "firing": firing}
+    return {"allow": True, "reason": "fresh", "vetoed": False,
+            "age_s": round(age_s, 3), "firing": firing}

@@ -78,6 +78,9 @@ KNOWN_POINTS = (
     "admission.bucket_exhausted",
     "admission.deadline_blown",
     "admission.brownout_force",
+    "continual.capture_drop",
+    "continual.rollout_crash",
+    "continual.rollback_trigger",
 )
 
 # One line per point; keys must equal KNOWN_POINTS (the analysis faults
@@ -166,6 +169,18 @@ POINT_DOCS = {
         "force the brownout controller one level deeper on its next poll — "
         "the transition is journaled and /healthz reports the new level "
         "honestly (serve/admission.py)"),
+    "continual.capture_drop": (
+        "fail one request-capture journal write — counted in the capture's "
+        "dropped counter; the /score request it records must still succeed "
+        "(continual/capture.py)"),
+    "continual.rollout_crash": (
+        "hard-exit the promotion controller mid-rollout, between a "
+        "candidate's warm join and the prior replica's retirement — a "
+        "resumed controller must converge the fleet (continual/promote.py)"),
+    "continual.rollback_trigger": (
+        "force the post-roll drift watch to fire against the candidate rev "
+        "— the controller rolls back and the prior model_rev serves again "
+        "(continual/promote.py)"),
 }
 
 

@@ -176,6 +176,20 @@ class ScoreServer:
                     adm_cfg, self._observe_fast_burn, metrics=self.metrics,
                     journal=journal, flight=self.flight).start()
                 self.admission.brownout = self.brownout
+        # continuous-learning capture (continual/capture.py): a sampled,
+        # bounded journal of scored requests feeding shadow replay and
+        # incremental retraining. Invariant 20 lives inside the capture —
+        # record_request never raises — so the hook below is bare.
+        cont_cfg = self.cfg.continual
+        self.capture = None
+        if cont_cfg.enabled and cont_cfg.capture_path:
+            from deepdfa_tpu.continual.capture import TrafficCapture
+
+            self.capture = TrafficCapture(
+                Path(cont_cfg.capture_path),
+                sample_every=cont_cfg.capture_sample_every,
+                max_records=cont_cfg.capture_max_records,
+                flight=self.flight)
         self._draining = threading.Event()
         self._stop_requested = threading.Event()
         self._stopped = threading.Event()
@@ -493,6 +507,11 @@ class ScoreServer:
                 if fut is not None:
                     self.metrics.observe_answered(row["tier"])
 
+        if self.capture is not None:
+            # capture records the request as served (scores, tiers, the
+            # encoded graphs) — and can never fail it (invariant 20)
+            self.capture.record_request(key, rows, graphs,
+                                        model_rev=tier1_rev)
         self.cache.store(key, results=rows)
         return 200, {"results": rows, "cached": False}
 

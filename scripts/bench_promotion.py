@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""The continuous-learning sawtooth on a live 2-replica fleet: capture →
+shadow → roll → forced-drift rollback.
+
+The ``promotion`` ledger stage (``bench.assemble_promotion_result``).
+One run drives the whole ISSUE 19 loop hermetically on localhost:
+
+1. **capture** — real demo-corpus graphs scored through a real
+   :class:`~deepdfa_tpu.serve.engine.ScoringEngine` (stub score_fn, no
+   compiles) and journaled through the real
+   :class:`~deepdfa_tpu.continual.TrafficCapture` write path;
+2. **shadow** — the captured traffic replayed twice: identical revs MUST
+   produce a zero-diff report, the candidate rev must measure a real
+   (but gate-passing) score delta;
+3. **roll** — two stdlib stub replicas (the test_autoscaler idiom, extended
+   to report ``model_rev``) serve ``revA`` behind a REAL
+   :class:`~deepdfa_tpu.serve.router.FleetRouter`; the
+   :class:`~deepdfa_tpu.continual.PromotionController` rolls ``revB``
+   through the router's drain/warm-join membership protocol while client
+   load flows — gates: ``join_cold_compiles == 0`` and zero 5xx;
+4. **rollback** — the injected ``continual.rollback_trigger`` fires the
+   post-roll drift watch; the controller must restore ``revA`` the same
+   replica-by-replica way (``rollback_total >= 1``,
+   ``prior_rev_restored``).
+
+Pure host-side; prints ONE JSON line.
+
+Usage: python scripts/bench_promotion.py [--replicas 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# the stub replica: stdlib-only HTTP server reporting the rev it serves
+# (spawn costs milliseconds, not a jax import — test_autoscaler idiom)
+_REV_STUB = r'''
+import json, os, signal, threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+REV = os.environ.get("STUB_REV", "revA")
+draining = threading.Event()
+
+
+class H(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, body, ctype="application/json"):
+        data = (body if isinstance(body, str) else json.dumps(body)).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            code = 503 if draining.is_set() else 200
+            self._send(code, {"status": "draining" if draining.is_set()
+                              else "ok", "draining": draining.is_set(),
+                              "warm": True, "model_rev": REV,
+                              "replica_id": "stub-" + REV})
+        elif self.path == "/metrics":
+            self._send(200, "stub_up 1\n", ctype="text/plain; version=0.0.4")
+        else:
+            self._send(404, {"error": "no route"})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n)
+        if draining.is_set():
+            self._send(503, {"error": "draining"})
+        else:
+            self._send(200, {"results": [{"score": 0.5, "cached": False,
+                                          "model_rev": REV}],
+                             "bytes": len(raw)})
+
+
+httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+httpd.daemon_threads = True
+
+
+def _term(*_):
+    draining.set()
+    threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+
+signal.signal(signal.SIGTERM, _term)
+print(json.dumps({"status": "serving", "host": "127.0.0.1",
+                  "port": httpd.server_address[1],
+                  "replica_id": "stub-" + REV,
+                  "warm_store": {"buckets": 3, "hits": 3, "misses": 0,
+                                 "compile_seconds_saved": 2.5}}),
+      flush=True)
+httpd.serve_forever()
+'''
+
+
+def _build_vocabs():
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    rows = demo_corpus(6, seed=0).to_dict("records")
+    cpgs = {int(r["id"]): add_dependence_edges(parse_source(r["before"]))
+            for r in rows}
+    labels = {int(r["id"]): int(r["vul"]) for r in rows}
+    _, vocabs = CorpusBuilder(FeatureConfig()).build(
+        cpgs, list(cpgs), graph_labels=labels)
+    return vocabs, [r["before"] for r in rows]
+
+
+def _engine(vocabs, shift: float, rev: str):
+    """Real ScoringEngine over a deterministic slot-keyed stub score_fn:
+    the candidate's ``shift`` is a real, measurable score delta that
+    still stays inside the shadow gate's PSI ceiling."""
+    import numpy as np
+
+    from deepdfa_tpu.serve import ScoringEngine, serve_buckets
+
+    def score_fn(batch):
+        base = (np.arange(batch.max_graphs) % 8) / 10.0 + 0.12
+        return np.clip(base + shift, 0.0, 1.0).astype(np.float32)
+
+    return ScoringEngine(score_fn, serve_buckets(4),
+                         feat_keys=tuple(vocabs), model_rev=rev)
+
+
+def _capture_leg(traffic_path, vocabs, sources):
+    """Journal the baseline engine's served scores for every demo graph
+    through the real capture write path."""
+    import numpy as np
+
+    from deepdfa_tpu.continual import TrafficCapture
+    from deepdfa_tpu.pipeline import encode_source
+
+    eng = _engine(vocabs, 0.0, "revA")
+    cap = TrafficCapture(traffic_path)
+    for i, src in enumerate(sources):
+        for ef in encode_source(src, vocabs, keep_cpg=False):
+            if ef.graph is None:
+                continue
+            bucket = eng.assign_bucket(ef.graph)
+            score = float(np.asarray(eng.score([ef.graph], bucket))[0])
+            cap.record_request(
+                f"bench:{i}", [{"function": ef.name, "tier": 1,
+                                "vulnerable_probability": score}],
+                [ef.graph], model_rev="revA")
+    return cap.stats()
+
+
+class _Recording:
+    """SubprocessLauncher wrapper that keeps every spawned handle for
+    teardown."""
+
+    def __init__(self, launcher):
+        self._launcher = launcher
+        self.handles = []
+
+    def spawn(self):
+        h = self._launcher.spawn()
+        self.handles.append(h)
+        return h
+
+
+def _fleet_legs(n_replicas: int, workdir: Path, shadow_report: dict):
+    """Roll revB onto a live revA stub fleet under client load, then
+    force the drift watch and roll back. Returns (roll, rollback,
+    responses_5xx, prior_rev_restored)."""
+    from deepdfa_tpu.continual import PromotionController
+    from deepdfa_tpu.continual.promote import _default_rev_probe
+    from deepdfa_tpu.obs.slo import write_alerts_artifact
+    from deepdfa_tpu.resilience import faults
+    from deepdfa_tpu.resilience.journal import RunJournal
+    from deepdfa_tpu.serve import FleetRouter, SubprocessLauncher
+
+    stub = workdir / "rev_stub.py"
+    stub.write_text(_REV_STUB)
+    alerts = write_alerts_artifact(workdir / "alerts.json", [])
+
+    def launcher(rev):
+        return _Recording(SubprocessLauncher(
+            [sys.executable, str(stub)],
+            env={**os.environ, "STUB_REV": rev}, startup_timeout_s=30.0))
+
+    prior_launcher = launcher("revA")
+    cand_launcher = launcher("revB")
+    router = FleetRouter([], port=0, probe_interval_s=0.1,
+                         allow_empty=True).start(probe=True)
+    for _ in range(n_replicas):
+        router.add_backend(prior_launcher.spawn().name)
+
+    bad_responses = []
+    stop = threading.Event()
+
+    def load():
+        import http.client
+
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", router.port, timeout=10)
+                conn.request("POST", "/score",
+                             json.dumps({"source": f"int f{i}();"}),
+                             headers={"Content-Type": "application/json"})
+                code = conn.getresponse().status
+                conn.close()
+                if code != 200:
+                    bad_responses.append(code)
+            except OSError:
+                bad_responses.append(599)  # router itself must stay up
+            time.sleep(0.01)
+
+    def controller(candidate_launcher, prior_fallback, name):
+        pc = PromotionController(
+            router, candidate_launcher, prior_fallback,
+            candidate_rev="revB", prior_rev="revA", alerts_path=alerts,
+            state_journal=RunJournal(workdir / f"state_{name}.json"),
+            journal=RunJournal(workdir / f"decisions_{name}.json"),
+            drift_settle_polls=2, poll_interval_s=0.05,
+            join_timeout_s=30.0)
+        return pc
+
+    workers = [threading.Thread(target=load, daemon=True) for _ in range(2)]
+    try:
+        for w in workers:
+            w.start()
+        time.sleep(0.3)  # load flowing through the prior fleet
+
+        # leg 3: the forward roll — replica-by-replica, warm joins only
+        forward = controller(cand_launcher, prior_launcher, "roll")
+        for h in prior_launcher.handles:
+            forward.adopt(h)
+        roll = forward.promote(shadow_report)
+        time.sleep(0.3)  # candidate fleet takes load
+
+        # leg 4: the forced-drift sawtooth back down — the injected
+        # rollback trigger fires the settle watch on an already-rolled
+        # fleet, so the controller's only move is the rollback
+        back = controller(cand_launcher, prior_launcher, "rollback")
+        for h in cand_launcher.handles:
+            back.adopt(h)
+        with faults.installed("continual.rollback_trigger@1"):
+            rollback = back.promote(shadow_report)
+        time.sleep(0.3)  # restored fleet takes load
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=10)
+        ring = {}
+        try:
+            ring = {name: _default_rev_probe(name)
+                    for name in router.probe_once()}
+        finally:
+            router.shutdown()
+            for h in prior_launcher.handles + cand_launcher.handles:
+                try:
+                    h.kill()
+                except Exception:  # noqa: BLE001 — already-exited replicas
+                    pass
+
+    prior_rev_restored = (len(ring) >= n_replicas
+                          and all(rev == "revA" for rev in ring.values()))
+    return roll, rollback, list(bad_responses), prior_rev_restored
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="prior-fleet size the candidate rolls across")
+    args = ap.parse_args(argv)
+
+    from bench import assemble_promotion_result
+    from deepdfa_tpu.continual import shadow_replay
+
+    error = None
+    capture = shadow_same = shadow_diff = roll = rollback = None
+    responses_5xx = []
+    prior_rev_restored = False
+    with tempfile.TemporaryDirectory() as td:
+        workdir = Path(td)
+        traffic = workdir / "traffic.jsonl"
+        try:
+            # leg 1: capture real graphs + served scores
+            vocabs, sources = _build_vocabs()
+            capture = _capture_leg(traffic, vocabs, sources)
+
+            # leg 2: shadow replay — identical revs must be a ZERO diff,
+            # the candidate must measure a real one and still pass
+            shadow_same = shadow_replay(
+                traffic, _engine(vocabs, 0.0, "revA"),
+                _engine(vocabs, 0.0, "revA"))
+            shadow_diff = shadow_replay(
+                traffic, _engine(vocabs, 0.0, "revA"),
+                _engine(vocabs, 0.03, "revB"),
+                out_path=workdir / "shadow_report.json")
+
+            # legs 3+4: the live-fleet roll + forced rollback
+            roll, rollback, responses_5xx, prior_rev_restored = _fleet_legs(
+                args.replicas, workdir, shadow_diff)
+        except Exception as exc:  # noqa: BLE001 — the artifact records the
+            # failure; the gate turns it into ok=False
+            error = f"{type(exc).__name__}: {exc}"
+
+    result = assemble_promotion_result(
+        n_replicas=args.replicas,
+        capture=capture,
+        shadow_same=shadow_same,
+        shadow_diff=shadow_diff,
+        roll=roll,
+        rollback=rollback,
+        responses_5xx=len(responses_5xx),
+        prior_rev_restored=prior_rev_restored,
+        notes={"bad_response_codes": sorted(set(responses_5xx))[:10]},
+        error=error,
+    )
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

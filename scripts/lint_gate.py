@@ -19,6 +19,9 @@ rotation/corruption hygiene, whole-unit score_unit routing) + the
 admission-control suite (``pytest -m 'admission and not slow'``: token
 buckets, deterministic Retry-After, brownout ladder, priority-inversion
 torture, the three ``admission.*`` chaos points) + the
+continuous-learning suite (``pytest -m 'continual and not slow'``:
+capture no-fail rule, shadow zero-diff, fail-closed veto reader, the
+promotion controller's roll/rollback/converge paths) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
 lock-order, jit-purity/donation, fault-registry, fault-arming coverage,
 metrics conformance static passes) + the perf-regression ledger
@@ -172,6 +175,19 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("admission")
+
+    # the continuous-learning suite: capture no-fail sampling, shadow
+    # zero-diff on identical revs, the fail-closed veto reader, the
+    # promotion controller's roll/rollback/crash-converge paths on stub
+    # fleets — device-free, pre-commit cadence (the subprocess chaos
+    # cases are `slow` and stay in tier-1's slow lane)
+    print("lint_gate: pytest -m 'continual and not slow'")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "continual and not slow",
+         "-q", "tests/test_continual.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("continual")
 
     # step 5: the invariant gate — AST passes for atomic-commit,
     # lock-order, jit-purity/donation, fault-registry, fault-arming

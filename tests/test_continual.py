@@ -1,0 +1,1044 @@
+"""Continuous-learning loop battery (``pytest -m continual``;
+``deepdfa_tpu/continual``).
+
+Pins ISSUE 19 / invariant candidate 31 end to end: the sampled request
+capture can NEVER fail the request it records (invariant 20 — including
+under the injected ``continual.capture_drop`` fault through a real
+``ScoreServer``); the shadow harness is honest (identical revs replay to
+a bit-zero diff, distinct revs measure a real one, an empty traffic file
+refuses rather than passing vacuously); the promotion veto reader is
+fail-closed on every degenerate artifact shape (missing / torn / stale);
+the retrain gate refuses on any missing evidence leg; and the
+``PromotionController`` rolls replica-by-replica with a never-empty ring
+and zero cold compiles, refuses a vetoed candidate outright, rolls back
+on a drift alert (injected ``continual.rollback_trigger`` or a real
+``score_drift_alert`` sample), and converges after a ``kill -9``
+mid-rollout (``continual.rollout_crash`` hard-exits a controller
+subprocess between a warm join and the prior's retirement; a resumed
+controller must restore the prior rev with zero 5xx through the real
+router).
+
+Unit layers run on fakes and injected clocks; the e2e layers use the
+stub-engine / stub-replica idioms of test_admission.py and
+test_autoscaler.py so nothing compiles XLA.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deepdfa_tpu.resilience import faults
+
+pytestmark = pytest.mark.continual
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# shared fakes + fixtures (test_admission.py idiom)
+
+
+class _StubEngine:
+    """Real ScoringEngine over a stub score_fn (test_serve.py idiom)."""
+
+    def __new__(cls, vocabs=(), max_batch=4, prob=0.5, rev=None):
+        from deepdfa_tpu.serve import ScoringEngine, serve_buckets
+
+        def score_fn(batch):
+            return np.full(batch.max_graphs, prob, np.float32)
+
+        return ScoringEngine(score_fn, serve_buckets(max_batch),
+                             feat_keys=tuple(vocabs), model_rev=rev)
+
+
+class _Journal:
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.events: list[dict] = []
+
+    def write(self, **kw):
+        if self.fail:
+            raise OSError("journal sink down")
+        self.events.append(kw)
+
+
+class _Flight:
+    def __init__(self):
+        self.events: list[tuple[str, dict]] = []
+
+    def record(self, kind, **kw):
+        self.events.append((kind, kw))
+
+
+@pytest.fixture(scope="module")
+def demo():
+    """(vocabs, sources) from a tiny hermetic corpus — real frontend +
+    real vocabularies, no training (test_serve.py idiom)."""
+    from deepdfa_tpu.config import FeatureConfig
+    from deepdfa_tpu.cpg.features import add_dependence_edges
+    from deepdfa_tpu.cpg.frontend import parse_source
+    from deepdfa_tpu.data.codegen import demo_corpus
+    from deepdfa_tpu.data.materialize import CorpusBuilder
+
+    rows = demo_corpus(6, seed=0).to_dict("records")
+    cpgs = {int(r["id"]): add_dependence_edges(parse_source(r["before"]))
+            for r in rows}
+    labels = {int(r["id"]): int(r["vul"]) for r in rows}
+    _, vocabs = CorpusBuilder(FeatureConfig()).build(
+        cpgs, list(cpgs), graph_labels=labels)
+    return vocabs, [r["before"] for r in rows]
+
+
+def _demo_graphs(demo, n=6):
+    """Real encoded graphs through the real frontend."""
+    from deepdfa_tpu.pipeline import encode_source
+
+    vocabs, sources = demo
+    graphs = []
+    for src in sources:
+        for ef in encode_source(src, vocabs, keep_cpg=False):
+            if ef.graph is not None:
+                graphs.append(ef.graph)
+    assert len(graphs) >= 3  # the corpus must actually encode
+    return graphs[:n]
+
+
+def _traffic(path, demo, *, prob=0.5, rev="revA", tier=1):
+    """A capture journal of real graphs with stub scores, via the real
+    write path."""
+    from deepdfa_tpu.continual import TrafficCapture
+
+    graphs = _demo_graphs(demo)
+    rows = [{"function": f"f{i}", "vulnerable_probability": prob,
+             "tier": tier} for i in range(len(graphs))]
+    cap = TrafficCapture(path)
+    wrote = cap.record_request("srckey", rows, graphs, model_rev=rev)
+    assert wrote == len(graphs)
+    return path, cap
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+def test_continual_config_validation():
+    from deepdfa_tpu.config import ContinualConfig
+
+    cfg = ContinualConfig()
+    assert cfg.enabled is False and cfg.capture_path is None
+    for field, bad in [("capture_sample_every", 0),
+                       ("capture_max_records", 0),
+                       ("shadow_bins", 1),
+                       ("shadow_max_psi", 0.0),
+                       ("veto_max_age_s", 0.0),
+                       ("drift_settle_polls", 0),
+                       ("poll_interval_s", 0.0)]:
+        with pytest.raises(ValueError, match=field):
+            ContinualConfig(**{field: bad})
+
+
+def test_continual_config_dotted_overrides_and_roundtrip(tmp_path):
+    from deepdfa_tpu.config import ContinualConfig, load_config, to_json
+
+    cfg = load_config(overrides={
+        "serve.continual.enabled": True,
+        "serve.continual.capture_path": "traffic.jsonl",
+        "serve.continual.capture_sample_every": 3,
+        "serve.continual.shadow_max_psi": 0.1,
+        "serve.continual.drift_settle_polls": 5})
+    cc = cfg.serve.continual
+    assert isinstance(cc, ContinualConfig)
+    assert (cc.enabled, cc.capture_path, cc.capture_sample_every,
+            cc.shadow_max_psi, cc.drift_settle_polls) == (
+                True, "traffic.jsonl", 3, 0.1, 5)
+    path = tmp_path / "cfg.json"
+    path.write_text(to_json(cfg))
+    assert load_config(path).serve.continual == cc
+    with pytest.raises(ValueError, match="shadow_bins"):
+        load_config(overrides={"serve.continual.shadow_bins": 1})
+
+
+# ---------------------------------------------------------------------------
+# capture: sampling, bounds, the no-fail rule, torn-tail reads
+
+
+def test_capture_roundtrip_rebuilds_graphs(tmp_path, demo):
+    from deepdfa_tpu.continual import read_capture, record_graph
+
+    path, cap = _traffic(tmp_path / "t.jsonl", demo, prob=0.25, rev="rev1")
+    rows = read_capture(path)
+    assert len(rows) == cap.stats()["written"] > 0
+    for rec in rows:
+        assert rec["schema"] == 1 and rec["model_rev"] == "rev1"
+        assert rec["score"] == 0.25 and rec["tier"] == 1
+        assert rec["source_key"] == "srckey"
+    g0 = record_graph(rows[0])
+    want = _demo_graphs(demo)[0]
+    np.testing.assert_array_equal(g0.senders, want.senders)
+    np.testing.assert_array_equal(g0.receivers, want.receivers)
+    assert set(g0.node_feats) == set(want.node_feats)
+    assert record_graph({"schema": 1}) is None  # no payload → None
+
+
+def test_capture_sampling_and_record_bound(tmp_path, demo):
+    from deepdfa_tpu.continual import TrafficCapture, read_capture
+
+    g = _demo_graphs(demo)[:1]
+    row = [{"function": "f", "vulnerable_probability": 0.5}]
+    cap = TrafficCapture(tmp_path / "t.jsonl", sample_every=2,
+                         max_records=2)
+    wrote = [cap.record_request(f"k{i}", row, g, model_rev="r")
+             for i in range(6)]
+    # requests 0, 2 recorded; 1, 3, 5 sampled out; 4 hits the bound
+    assert wrote == [1, 0, 1, 0, 0, 0]
+    stats = cap.stats()
+    assert stats == {"written": 2, "skipped": 4, "dropped": 0, "seen": 6}
+    assert len(read_capture(tmp_path / "t.jsonl")) == 2
+
+
+def test_capture_never_fails_on_unwritable_path(tmp_path, demo):
+    from deepdfa_tpu.continual import TrafficCapture
+
+    g = _demo_graphs(demo)[:1]
+    row = [{"function": "f", "vulnerable_probability": 0.5}]
+    flight = _Flight()
+    cap = TrafficCapture(tmp_path, flight=flight)  # a DIRECTORY: open fails
+    assert cap.record_request("k", row, g, model_rev="r") == 0  # no raise
+    assert cap.stats()["dropped"] == 1
+    assert [k for k, _ in flight.events] == ["capture.dropped"]
+
+
+@pytest.mark.faults
+def test_capture_drop_fault_counts_never_raises(tmp_path, demo):
+    from deepdfa_tpu.continual import TrafficCapture, read_capture
+
+    g = _demo_graphs(demo)[:1]
+    row = [{"function": "f", "vulnerable_probability": 0.5}]
+    cap = TrafficCapture(tmp_path / "t.jsonl", flight=_Flight())
+    with faults.installed("continual.capture_drop@1"):
+        assert cap.record_request("k0", row, g, model_rev="r") == 0
+        assert cap.record_request("k1", row, g, model_rev="r") == 1
+    stats = cap.stats()
+    assert stats["dropped"] == 1 and stats["written"] == 1
+    assert len(read_capture(tmp_path / "t.jsonl")) == 1
+
+
+def test_read_capture_tolerates_torn_tail(tmp_path):
+    from deepdfa_tpu.continual import read_capture
+
+    path = tmp_path / "t.jsonl"
+    good = json.dumps({"schema": 1, "score": 0.5})
+    path.write_text(good + "\n" + good + "\n" + '{"schema": 1, "sco')
+    assert len(read_capture(path)) == 2  # the torn tail ends the journal
+    assert read_capture(tmp_path / "absent.jsonl") == []
+
+
+# ---------------------------------------------------------------------------
+# capture through a REAL ScoreServer (invariant 20 where it matters)
+
+
+def _capture_server(demo, tmp_path, **cont_kw):
+    from deepdfa_tpu.config import ContinualConfig, ServeConfig
+    from deepdfa_tpu.serve import ScoreServer
+
+    vocabs, _ = demo
+    ccfg = ContinualConfig(enabled=True,
+                           capture_path=str(tmp_path / "traffic.jsonl"),
+                           **cont_kw)
+    return ScoreServer(_StubEngine(vocabs), vocabs,
+                       ServeConfig(port=0, max_wait_ms=2.0, continual=ccfg))
+
+
+def _post_score(port, source, timeout=30):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/score", json.dumps({"source": source}),
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, json.loads(r.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _uniq(base: str, i: int) -> str:
+    return f"{base}\nint cont_uniq_{i}(int a) {{\n  return a + {i};\n}}\n"
+
+
+def test_server_capture_records_served_requests(demo, tmp_path):
+    from deepdfa_tpu.continual import read_capture, record_graph
+
+    _, sources = demo
+    srv = _capture_server(demo, tmp_path).start()
+    try:
+        for i in range(2):
+            status, body = _post_score(srv.port, _uniq(sources[0], i))
+            assert status == 200 and body["results"]
+    finally:
+        srv.shutdown()
+    rows = read_capture(tmp_path / "traffic.jsonl")
+    assert srv.capture.stats()["dropped"] == 0
+    assert len(rows) == srv.capture.stats()["written"] > 0
+    for rec in rows:
+        assert 0.0 <= rec["score"] <= 1.0 and rec["tier"] == 1
+        assert rec["model_rev"]  # the serving rev rides every row
+        assert record_graph(rec) is not None
+
+
+@pytest.mark.faults
+def test_capture_drop_never_fails_the_scored_request(demo, tmp_path):
+    """The invariant-20 contract at the HTTP surface: the injected
+    capture failure costs a journal row, never the client's 200."""
+    _, sources = demo
+    srv = _capture_server(demo, tmp_path).start()
+    try:
+        with faults.installed("continual.capture_drop@1"):
+            status, body = _post_score(srv.port, _uniq(sources[1], 0))
+        assert status == 200 and body["results"]
+    finally:
+        srv.shutdown()
+    assert srv.capture.stats()["dropped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the promotion veto reader (obs/slo.py) — fail-closed on every shape
+
+
+def test_read_promotion_veto_missing():
+    from deepdfa_tpu.obs.slo import read_promotion_veto
+
+    for path in (None, "/nonexistent/alerts.json"):
+        veto = read_promotion_veto(path)
+        assert veto["allow"] is False and veto["reason"] == "missing"
+        assert veto["vetoed"] is None and veto["age_s"] is None
+
+
+def test_read_promotion_veto_torn(tmp_path):
+    from deepdfa_tpu.obs.slo import read_promotion_veto
+
+    path = tmp_path / "alerts.json"
+    for text in ('{"schema": 1, "promotion_ve',          # torn write
+                 '[1, 2, 3]',                            # wrong shape
+                 '{"schema": 2, "generated_at_unix": 1, '
+                 '"promotion_vetoed": false}',           # wrong schema
+                 '{"schema": 1, "promotion_vetoed": false}'):  # no timestamp
+        path.write_text(text)
+        veto = read_promotion_veto(path)
+        assert veto["allow"] is False and veto["reason"] == "torn", text
+
+
+def test_read_promotion_veto_stale(tmp_path):
+    from deepdfa_tpu.obs.slo import read_promotion_veto, write_alerts_artifact
+
+    path = write_alerts_artifact(tmp_path / "alerts.json", [],
+                                 clock=lambda: 1000.0)
+    veto = read_promotion_veto(path, max_age_s=3600.0,
+                               clock=lambda: 1000.0 + 7200.0)
+    assert veto["allow"] is False and veto["reason"] == "stale"
+    assert veto["age_s"] == pytest.approx(7200.0)
+    # the same artifact inside the window allows
+    fresh = read_promotion_veto(path, max_age_s=3600.0,
+                                clock=lambda: 1000.0 + 60.0)
+    assert fresh["allow"] is True and fresh["reason"] == "fresh"
+
+
+def test_read_promotion_veto_firing_alert_vetoes(tmp_path):
+    from deepdfa_tpu.obs.slo import read_promotion_veto, write_alerts_artifact
+
+    path = write_alerts_artifact(
+        tmp_path / "alerts.json", [],
+        extra_alerts=[{"slo": "latency_p99", "alert": True}])
+    veto = read_promotion_veto(path)
+    assert veto["allow"] is False and veto["reason"] == "vetoed"
+    assert veto["vetoed"] is True and veto["firing"] == ["latency_p99"]
+
+
+# ---------------------------------------------------------------------------
+# shadow replay: zero-diff honesty, real diffs, fail-closed gate
+
+
+def test_shadow_identical_revs_is_zero_diff(tmp_path, demo):
+    from deepdfa_tpu.continual import shadow_gate, shadow_replay
+
+    vocabs, _ = demo
+    path, _ = _traffic(tmp_path / "t.jsonl", demo, prob=0.5, rev="revA")
+    out = tmp_path / "shadow_report.json"
+    report = shadow_replay(path,
+                           _StubEngine(vocabs, prob=0.5, rev="revA"),
+                           _StubEngine(vocabs, prob=0.5, rev="revA"),
+                           out_path=out)
+    assert report["zero_diff"] is True and report["pass"] is True
+    assert report["max_psi"] == 0.0 and report["max_abs_delta"] == 0.0
+    assert report["n_replayed"] > 0 and report["buckets"]
+    assert report["rev_a"] == report["rev_b"] == "revA"
+    assert json.loads(out.read_text()) == report  # atomic artifact
+    assert shadow_gate(report) == (True, "shadow gate passed")
+
+
+def test_shadow_distinct_revs_measures_the_diff(tmp_path, demo):
+    from deepdfa_tpu.continual import shadow_gate, shadow_replay
+
+    vocabs, _ = demo
+    path, _ = _traffic(tmp_path / "t.jsonl", demo, prob=0.5, rev="revA")
+    report = shadow_replay(path,
+                           _StubEngine(vocabs, prob=0.5, rev="revA"),
+                           _StubEngine(vocabs, prob=0.9, rev="revB"))
+    assert report["zero_diff"] is False
+    assert report["max_abs_delta"] == pytest.approx(0.4, abs=1e-6)
+    assert report["max_psi"] > 0.25 and report["pass"] is False
+    assert (report["rev_a"], report["rev_b"]) == ("revA", "revB")
+    allow, reason = shadow_gate(report)
+    assert allow is False and "max_psi" in reason
+
+
+def test_shadow_empty_traffic_refuses(tmp_path, demo):
+    from deepdfa_tpu.continual import shadow_replay
+
+    vocabs, _ = demo
+    a = _StubEngine(vocabs, prob=0.5)
+    with pytest.raises(ValueError, match="no scoreable traffic"):
+        shadow_replay(tmp_path / "absent.jsonl", a, a)
+
+
+def test_shadow_gate_fail_closed_on_missing_evidence():
+    from deepdfa_tpu.continual import shadow_gate
+
+    for bad in (None, {}, {"schema": 2, "pass": True}, {"schema": 1}):
+        allow, _reason = shadow_gate(bad)
+        assert allow is False, bad
+    assert shadow_gate({"schema": 1, "pass": True})[0] is True
+
+
+# ---------------------------------------------------------------------------
+# retrain: delta extraction (invariant 23) + the no-regression gate
+
+
+def test_corpus_delta_only_misses_pay_extract(tmp_path):
+    from deepdfa_tpu.continual import corpus_delta
+    from deepdfa_tpu.data.extract_cache import ExtractCache
+
+    cache = ExtractCache(tmp_path / "xc")
+    calls = []
+
+    def extract(code):
+        calls.append(code)
+        if "poison" in code:
+            raise RuntimeError("frontend crash")
+        return {"n": len(code)}
+
+    sources = {f"s{i}": f"int f{i}() {{ return {i}; }}" for i in range(4)}
+    values, stats = corpus_delta(sources, cache, extract)
+    assert stats == {"total": 4, "hits": 0, "misses": 4, "failures": 0,
+                     "delta_fraction": 1.0}
+    assert len(values) == 4 and len(calls) == 4
+
+    # the grown corpus: unchanged functions are cache READS, never parses
+    calls.clear()
+    sources["s4"] = "int f4() { return 4; }"
+    sources["bad"] = "int poison() { return 0; }"
+    values, stats = corpus_delta(sources, cache, extract)
+    assert stats["hits"] == 4 and stats["misses"] == 1
+    assert stats["failures"] == 1 and "bad" not in values
+    assert sorted(calls) == sorted([sources["s4"], sources["bad"]])
+
+
+def test_no_regression_gate_refuses_each_leg():
+    from deepdfa_tpu.continual import no_regression_gate
+
+    ok_shadow = {"schema": 1, "pass": True}
+    base = {"val_f1": 0.80}
+    good = no_regression_gate({"val_f1": 0.82}, base, ok_shadow,
+                              metric="val_f1")
+    assert good["allow"] is True and good["reasons"] == []
+    # metric regression
+    bad = no_regression_gate({"val_f1": 0.70}, base, ok_shadow,
+                             metric="val_f1")
+    assert bad["allow"] is False and "regressed" in bad["reasons"][0]
+    # a bounded drop is tolerated only inside max_drop
+    assert no_regression_gate({"val_f1": 0.79}, base, ok_shadow,
+                              metric="val_f1", max_drop=0.02)["allow"]
+    # missing evidence refuses: no metric, no shadow
+    assert not no_regression_gate({}, base, ok_shadow,
+                                  metric="val_f1")["allow"]
+    assert not no_regression_gate({"val_f1": 0.9}, None, ok_shadow,
+                                  metric="val_f1")["allow"]
+    assert not no_regression_gate({"val_f1": 0.9}, base, None,
+                                  metric="val_f1")["allow"]
+    # lower-is-better metrics flip the drop sign
+    loss = no_regression_gate({"val_loss": 0.3}, {"val_loss": 0.4},
+                              ok_shadow, metric="val_loss",
+                              higher_is_better=False)
+    assert loss["allow"] is True
+
+
+def test_run_retrain_journals_and_fails_closed(tmp_path):
+    from deepdfa_tpu.continual import run_retrain
+    from deepdfa_tpu.data.extract_cache import ExtractCache
+
+    cache = ExtractCache(tmp_path / "xc")
+    sources = {"s0": "int f() { return 1; }"}
+    journal = _Journal()
+    ok_shadow = {"schema": 1, "pass": True}
+
+    rec = run_retrain(None, tmp_path / "run", sources=sources, cache=cache,
+                      extract=lambda code: {"n": len(code)},
+                      baseline_metrics={"val_f1": 0.8},
+                      shadow_report=ok_shadow,
+                      fit_fn=lambda cfg, run_dir, resume: {"val_f1": 0.85},
+                      journal=journal)
+    assert rec["promoted_candidate"] is True
+    assert rec["delta"]["misses"] == 1
+    assert journal.events[-1]["event"] == "retrain"
+
+    # a crashed fine-tune is a refused candidate with a reason, not a
+    # crashed scheduler
+    def broken_fit(cfg, run_dir, resume):
+        raise RuntimeError("OOM")
+
+    rec = run_retrain(None, tmp_path / "run", sources=sources, cache=cache,
+                      extract=lambda code: {"n": len(code)},
+                      baseline_metrics={"val_f1": 0.8},
+                      shadow_report=ok_shadow, fit_fn=broken_fit,
+                      journal=_Journal(fail=True))  # dead sink: no raise
+    assert rec["promoted_candidate"] is False
+    assert rec["gate"]["reasons"][0].startswith("fine-tune failed")
+
+
+# ---------------------------------------------------------------------------
+# promotion controller on fakes: roll protocol, gates, rollback, converge
+
+
+class _Ring:
+    """Fake router with rev book-keeping and a membership-size trace
+    (the never-empty-ring property is asserted on ``sizes``)."""
+
+    def __init__(self):
+        self.states: dict[str, str] = {}
+        self.revs: dict[str, str] = {}
+        self.sizes: list[int] = []
+
+    def add_backend(self, spec):
+        self.states[str(spec)] = "ready"
+        self.sizes.append(len(self.states))
+
+    def remove_backend(self, name):
+        ok = self.states.pop(name, None) is not None
+        self.sizes.append(len(self.states))
+        return ok
+
+    def probe_once(self):
+        return dict(self.states)
+
+
+class _RevHandle:
+    def __init__(self, name, cold=0):
+        self.name = name
+        self.join_cold_compiles = cold
+        self.drained = False
+
+    def drain(self):
+        self.drained = True
+
+
+class _RevLauncher:
+    def __init__(self, ring, rev, base_port, cold=0):
+        self.ring = ring
+        self.rev = rev
+        self.base = base_port
+        self.cold = cold
+        self.count = 0
+        self.handles: list[_RevHandle] = []
+
+    def spawn(self):
+        self.count += 1
+        h = _RevHandle(f"127.0.0.1:{self.base + self.count}", self.cold)
+        self.ring.revs[h.name] = self.rev
+        self.handles.append(h)
+        return h
+
+
+def _fresh_alerts(tmp_path, vetoed=False, clock=time.time):
+    from deepdfa_tpu.obs.slo import write_alerts_artifact
+
+    extra = [{"slo": "score_drift", "alert": True}] if vetoed else []
+    return write_alerts_artifact(tmp_path / "alerts.json", [],
+                                 extra_alerts=extra, clock=clock)
+
+
+def _controller(tmp_path, *, n_prior=2, vetoed=False, journal=None,
+                flight=None, drift_probe=None, state_journal=None,
+                wall_clock=time.time, alerts_clock=None):
+    from deepdfa_tpu.continual import PromotionController
+
+    ring = _Ring()
+    prior = _RevLauncher(ring, "revA", 9100)
+    cand = _RevLauncher(ring, "revB", 9200)
+    for _ in range(n_prior):
+        ring.add_backend(prior.spawn().name)
+    ring.sizes.clear()  # trace only the roll's own membership changes
+    alerts = _fresh_alerts(tmp_path, vetoed=vetoed,
+                           clock=alerts_clock or time.time)
+    pc = PromotionController(
+        ring, cand, prior, candidate_rev="revB", prior_rev="revA",
+        alerts_path=alerts, journal=journal, flight=flight,
+        state_journal=state_journal, rev_probe=ring.revs.get,
+        drift_probe=drift_probe or (lambda name: ""),
+        drift_settle_polls=2, poll_interval_s=0.01, join_timeout_s=5.0,
+        sleep=lambda s: None, wall_clock=wall_clock)
+    for h in prior.handles:
+        pc.adopt(h)  # the running fleet's handles: retirement can drain
+    return pc, ring, cand, prior
+
+
+_OK_SHADOW = {"schema": 1, "pass": True}
+
+
+def test_promote_rolls_replica_by_replica(tmp_path):
+    journal, flight = _Journal(), _Flight()
+    pc, ring, cand, prior = _controller(tmp_path, journal=journal,
+                                        flight=flight)
+    out = pc.promote(_OK_SHADOW)
+    assert out["completed"] is True and "rolled_back" not in out
+    assert out["ring_by_rev"] == {
+        "revB": sorted(h.name for h in cand.handles)}
+    assert out["join_cold_compiles"] == 0 and out["rollback_total"] == 0
+    # replica-by-replica: join → retire, twice; the ring NEVER dips below
+    # its starting size (invariant 12's never-empty floor)
+    assert min(ring.sizes) >= 2 and max(ring.sizes) == 3
+    assert all(h.drained for h in prior.handles)  # invariant 22: no kills
+    actions = [d["action"] for d in out["decisions"]]
+    assert actions == ["rollout_start", "warm_join", "drained",
+                       "warm_join", "drained", "rolled", "drift_settled",
+                       "complete"]
+    # every decision journaled + flight-mirrored
+    assert [e["action"] for e in journal.events] == actions
+    assert all(e["event"] == "promotion_transition" for e in journal.events)
+    assert [k for k, _ in flight.events] == [f"promotion.{a}"
+                                             for a in actions]
+
+
+def test_vetoed_candidate_never_promoted(tmp_path):
+    """ISSUE 19 satellite: a real firing ``alerts.json`` (written by the
+    real artifact writer) must stop the roll before a single spawn."""
+    pc, ring, cand, prior = _controller(tmp_path, vetoed=True)
+    out = pc.promote(_OK_SHADOW)
+    assert out.get("refused") is True and not out.get("completed")
+    assert cand.count == 0 and ring.sizes == []  # nothing moved
+    assert out["ring_by_rev"] == {
+        "revA": sorted(h.name for h in prior.handles)}
+    refusal = out["decisions"][0]
+    assert refusal["action"] == "refused" and refusal["gate"] == "veto"
+    assert refusal["reason"] == "vetoed"
+
+
+def test_missing_or_stale_alerts_refuse_the_roll(tmp_path):
+    from deepdfa_tpu.continual import PromotionController
+
+    # missing artifact: no veto evidence is NOT permission
+    ring = _Ring()
+    pc = PromotionController(ring, _RevLauncher(ring, "revB", 9200),
+                             _RevLauncher(ring, "revA", 9100),
+                             candidate_rev="revB", prior_rev="revA",
+                             alerts_path=tmp_path / "absent.json",
+                             rev_probe=ring.revs.get)
+    out = pc.promote(_OK_SHADOW)
+    assert out["refused"] is True
+    assert out["decisions"][0]["reason"] == "missing"
+    # stale artifact: written at t=1000, judged two hours later
+    pc2, ring2, cand2, _ = _controller(tmp_path,
+                                       alerts_clock=lambda: 1000.0,
+                                       wall_clock=lambda: 1000.0 + 7200.0)
+    out2 = pc2.promote(_OK_SHADOW)
+    assert out2["refused"] is True and cand2.count == 0
+    assert out2["decisions"][0]["reason"] == "stale"
+
+
+def test_failing_shadow_report_refuses(tmp_path):
+    pc, ring, cand, _ = _controller(tmp_path)
+    for report in (None, {}, {"schema": 1, "pass": False}):
+        out = pc.promote(report)
+        assert out["refused"] is True, report
+        assert out["decisions"][-1]["gate"] == "shadow"
+    assert cand.count == 0 and ring.sizes == []
+
+
+@pytest.mark.faults
+def test_injected_drift_rolls_back_to_prior_rev(tmp_path):
+    pc, ring, cand, prior = _controller(tmp_path)
+    with faults.installed("continual.rollback_trigger@1"):
+        out = pc.promote(_OK_SHADOW)
+    assert out["rolled_back"] is True and not out.get("completed")
+    assert out["rollback_total"] == 1
+    # the fleet serves the PRIOR rev again, via warm joins only
+    assert set(out["ring_by_rev"]) == {"revA"}
+    assert len(out["ring_by_rev"]["revA"]) == 2
+    assert out["join_cold_compiles"] == 0
+    assert min(ring.sizes) >= 2  # the floor held through BOTH rolls
+    actions = [d["action"] for d in out["decisions"]]
+    assert "drift_alert" in actions and "rollback_complete" in actions
+    alert = next(d for d in out["decisions"] if d["action"] == "drift_alert")
+    assert alert["injected"] is True and alert["rev"] == "revB"
+
+
+def test_real_drift_alert_sample_triggers_rollback(tmp_path):
+    """The rendered ``score_drift_alert`` gauge (per-tier key included)
+    is the rollback authority — same line format serve/metrics.py emits."""
+    firing = ('deepdfa_serve_score_drift_alert{model_rev="revB@t1"} 1\n'
+              'deepdfa_serve_score_drift{model_rev="revB@t1"} 0.41\n')
+    pc, ring, cand, _ = _controller(tmp_path,
+                                    drift_probe=lambda name: firing)
+    out = pc.promote(_OK_SHADOW)
+    assert out["rolled_back"] is True and out["rollback_total"] == 1
+    assert set(out["ring_by_rev"]) == {"revA"}
+    alert = next(d for d in out["decisions"] if d["action"] == "drift_alert")
+    assert alert["rev"] == "revB" and "backend" in alert
+
+
+def test_drift_alert_firing_parser():
+    from deepdfa_tpu.continual import drift_alert_firing
+
+    line = 'deepdfa_serve_score_drift_alert{model_rev="%s"} %s\n'
+    assert drift_alert_firing(line % ("revB", "1"), "revB")
+    assert drift_alert_firing(line % ("revB@t2", "1"), "revB")  # tier key
+    assert not drift_alert_firing(line % ("revB", "0"), "revB")  # not set
+    assert not drift_alert_firing(line % ("revA@t1", "1"), "revB")  # other
+    assert not drift_alert_firing(line % ("revBB", "1"), "revB")  # prefix !=
+    assert not drift_alert_firing("", "revB")
+    assert not drift_alert_firing(None, "revB")
+
+
+def test_converge_rolls_back_from_crash_state(tmp_path):
+    """Unit half of the kill -9 story: a controller resumed over a
+    ``phase="rolling"`` state journal restores the prior rev; a
+    ``phase="complete"`` state is a no-op."""
+    from deepdfa_tpu.resilience.journal import RunJournal
+
+    state = RunJournal(tmp_path / "state.json")
+    pc, ring, cand, prior = _controller(tmp_path, n_prior=1,
+                                        state_journal=state)
+    # a crashed roll left one candidate joined alongside the prior
+    ring.add_backend(cand.spawn().name)
+    state.write(event="promotion_state", phase="rolling",
+                candidate_rev="revB", prior_rev="revA",
+                joined=[{"name": cand.handles[0].name, "pid": None}])
+    out = pc.converge()
+    assert out["converged"] is True and out["rolled_back"] is True
+    assert set(out["ring_by_rev"]) == {"revA"}
+    assert out["join_cold_compiles"] == 0 and min(ring.sizes) >= 2
+
+    # complete state: nothing to undo
+    state.write(event="promotion_state", phase="complete",
+                candidate_rev="revB", prior_rev="revA", joined=[])
+    pc2, ring2, cand2, _ = _controller(tmp_path, state_journal=state)
+    out2 = pc2.converge()
+    assert out2["completed"] is True and out2["converged"] is True
+    assert cand2.count == 0 and ring2.sizes == []
+
+
+def test_stage_candidate_exports_through_warmup(tmp_path, demo):
+    from deepdfa_tpu.continual import stage_candidate
+    from deepdfa_tpu.serve import WarmStore
+
+    vocabs, _ = demo
+    eng = _StubEngine(vocabs, prob=0.5, rev="revB")
+    report = stage_candidate(eng, WarmStore(tmp_path / "warm"))
+    assert report["model_rev"] == "revB"
+    assert report["buckets"] >= 1
+    assert report["hits"] + report["misses"] == report["buckets"]
+
+
+# ---------------------------------------------------------------------------
+# the kill -9 chaos case: controller dies mid-rollout, fleet converges
+
+
+_REV_STUB = r'''
+import json, os, signal, threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+REV = os.environ.get("STUB_REV", "revA")
+draining = threading.Event()
+
+
+class H(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code, body, ctype="application/json"):
+        data = (body if isinstance(body, str) else json.dumps(body)).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            code = 503 if draining.is_set() else 200
+            self._send(code, {"status": "draining" if draining.is_set()
+                              else "ok", "draining": draining.is_set(),
+                              "warm": True, "model_rev": REV,
+                              "replica_id": "stub-" + REV})
+        elif self.path == "/metrics":
+            self._send(200, "stub_up 1\n", ctype="text/plain; version=0.0.4")
+        else:
+            self._send(404, {"error": "no route"})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n)
+        if draining.is_set():
+            self._send(503, {"error": "draining"})
+        else:
+            self._send(200, {"results": [{"score": 0.5, "cached": False,
+                                          "model_rev": REV}],
+                             "bytes": len(raw)})
+
+
+httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+httpd.daemon_threads = True
+
+
+def _term(*_):
+    draining.set()
+    threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+
+signal.signal(signal.SIGTERM, _term)
+print(json.dumps({"status": "serving", "host": "127.0.0.1",
+                  "port": httpd.server_address[1],
+                  "replica_id": "stub-" + REV,
+                  "warm_store": {"buckets": 3, "hits": 3, "misses": 0,
+                                 "compile_seconds_saved": 2.5}}),
+      flush=True)
+httpd.serve_forever()
+'''
+
+
+_DRIVER = r'''
+"""Promotion-controller driver: rolls revB through the router's admin
+surface. With DEEPDFA_FAULTS=continual.rollout_crash@1 in the
+environment it hard-exits (137) between the first candidate's warm join
+and the prior replica's retirement."""
+import json
+import os
+import sys
+
+from deepdfa_tpu.continual.promote import PromotionController
+from deepdfa_tpu.resilience.journal import RunJournal
+from deepdfa_tpu.serve.autoscaler import AdminRouterClient, SubprocessLauncher
+
+admin_port, stub, state_path, alerts_path = sys.argv[1:5]
+client = AdminRouterClient("127.0.0.1", int(admin_port))
+cand = SubprocessLauncher([sys.executable, stub],
+                          env={**os.environ, "STUB_REV": "revB"},
+                          startup_timeout_s=30.0)
+prior = SubprocessLauncher([sys.executable, stub],
+                           env={**os.environ, "STUB_REV": "revA"},
+                           startup_timeout_s=30.0)
+pc = PromotionController(client, cand, prior,
+                         candidate_rev="revB", prior_rev="revA",
+                         alerts_path=alerts_path,
+                         state_journal=RunJournal(state_path),
+                         drift_settle_polls=1, poll_interval_s=0.05,
+                         join_timeout_s=30.0)
+out = pc.promote({"schema": 1, "pass": True})
+print(json.dumps({"completed": bool(out.get("completed"))}), flush=True)
+'''
+
+
+@pytest.mark.faults
+def test_kill9_mid_rollout_converges_without_cold_compiles(tmp_path):
+    """ISSUE 19's acceptance chaos case: the promotion controller is
+    hard-killed (``continual.rollout_crash`` → ``os._exit(137)``) between
+    a candidate's warm join and the prior replica's retirement, while
+    load flows through the real router. A RESUMED controller must read
+    the crash-state journal and converge the fleet back to the prior
+    ``model_rev`` — zero cold compiles, zero 5xx surfaced to clients."""
+    from deepdfa_tpu.continual.promote import PromotionController
+    from deepdfa_tpu.obs.slo import write_alerts_artifact
+    from deepdfa_tpu.resilience.journal import RunJournal
+    from deepdfa_tpu.serve import FleetRouter, SubprocessLauncher
+
+    stub = tmp_path / "rev_stub.py"
+    stub.write_text(_REV_STUB)
+    driver = tmp_path / "promotion_driver.py"
+    driver.write_text(_DRIVER)
+    state_path = tmp_path / "promotion_state.json"
+    alerts = write_alerts_artifact(tmp_path / "alerts.json", [])
+
+    class _Recording(SubprocessLauncher):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.handles = []
+
+        def spawn(self):
+            h = super().spawn()
+            self.handles.append(h)
+            return h
+
+    prior_launcher = _Recording([sys.executable, str(stub)],
+                                env={**os.environ, "STUB_REV": "revA"},
+                                startup_timeout_s=30.0)
+    cand_launcher = _Recording([sys.executable, str(stub)],
+                               env={**os.environ, "STUB_REV": "revB"},
+                               startup_timeout_s=30.0)
+    router = FleetRouter([], port=0, probe_interval_s=0.1,
+                         allow_empty=True).start(probe=True)
+    for _ in range(2):
+        router.add_backend(prior_launcher.spawn().name)
+
+    errors = []
+    stop = threading.Event()
+
+    def load():
+        import http.client
+
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                                  timeout=10)
+                conn.request("POST", "/score",
+                             json.dumps({"source": f"int f{i}();"}),
+                             headers={"Content-Type": "application/json"})
+                code = conn.getresponse().status
+                conn.close()
+                if code != 200:
+                    errors.append(code)
+            except OSError:
+                errors.append("conn")  # the ROUTER itself must stay up
+            time.sleep(0.01)
+
+    env = {**os.environ}
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    # the chaos arming (faultcov form): the driver subprocess inherits the
+    # fault spec and its crash_if fires on the roll's first hit
+    env["DEEPDFA_FAULTS"] = "continual.rollout_crash@1"
+    workers = [threading.Thread(target=load, daemon=True) for _ in range(2)]
+    orphan_pids = []
+    try:
+        for w in workers:
+            w.start()
+        time.sleep(0.3)  # load is flowing through both prior replicas
+        proc = subprocess.run(
+            [sys.executable, str(driver), str(router.port), str(stub),
+             str(state_path), str(alerts)],
+            env=env, cwd=str(REPO), capture_output=True, text=True,
+            timeout=120)
+        # the controller died by the injected crash, not a clean exit
+        assert proc.returncode == 137, (proc.returncode, proc.stderr)
+        # the crash window left the fleet mixed-rev: the joined candidate
+        # is an orphan, on record in the state journal with its pid
+        state = RunJournal(state_path).read()
+        assert state["phase"] == "rolling"
+        orphan_pids = [row["pid"] for row in state["joined"] if row["pid"]]
+        assert len(orphan_pids) == 1
+        time.sleep(0.3)  # mixed-rev window: load keeps flowing
+
+        resumed = PromotionController(
+            router, cand_launcher, prior_launcher,
+            candidate_rev="revB", prior_rev="revA", alerts_path=alerts,
+            state_journal=RunJournal(state_path),
+            drift_settle_polls=1, poll_interval_s=0.05, join_timeout_s=30.0)
+        out = resumed.converge()
+        time.sleep(0.3)  # post-convergence window
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=10)
+        rsnap = router.shutdown()
+        for h in prior_launcher.handles + cand_launcher.handles:
+            h.kill()
+        for pid in orphan_pids:
+            try:
+                os.kill(int(pid), 9)
+            except OSError:
+                pass  # already reaped by the rollback
+
+    assert out["converged"] is True and out["rolled_back"] is True
+    assert out["join_cold_compiles"] == 0  # every join warm (invariant 11)
+    by_rev = out["ring_by_rev"]
+    assert set(by_rev) == {"revA"}  # the prior rev serves again
+    assert len(by_rev["revA"]) >= 2
+    # zero 5xx through the router across crash, mixed-rev, and rollback
+    assert errors == [], errors[:10]
+    assert rsnap["no_backend_total"] == 0
+    assert RunJournal(state_path).read()["phase"] == "rolled_back"
+
+
+# ---------------------------------------------------------------------------
+# ledger series + the promotion bench assembler (satellite 5/6 contracts)
+
+
+def test_promotion_ledger_directions():
+    from deepdfa_tpu.obs.ledger import lower_is_better
+
+    assert lower_is_better("rollout_seconds", "promotion") is True
+    assert lower_is_better("rollback_total", "promotion") is True
+    assert lower_is_better("join_cold_compiles", "promotion") is True
+
+
+def _promotion_legs():
+    return dict(
+        n_replicas=2,
+        capture={"written": 12, "skipped": 0, "dropped": 0, "seen": 12},
+        shadow_same={"zero_diff": True, "max_abs_delta": 0.0,
+                     "max_psi": 0.0},
+        shadow_diff={"zero_diff": False, "max_abs_delta": 0.4,
+                     "max_psi": 1.2},
+        roll={"completed": True, "rollout_seconds": 1.5,
+              "join_cold_compiles": 0},
+        rollback={"rollback_total": 1, "join_cold_compiles": 0},
+        responses_5xx=0,
+        prior_rev_restored=True)
+
+
+def test_assemble_promotion_result_green():
+    from bench import assemble_promotion_result
+
+    res = assemble_promotion_result(**_promotion_legs())
+    assert res["ok"] is True and res["error"] is None
+    assert res["metric"] == "promotion_rollout_seconds"
+    assert res["value"] == 1.5 and res["unit"] == "s"
+    assert res["device_kind"] == "host"
+    # the ledger's dedicated-stage block (EXPLICIT_SERIES keys)
+    assert res["promotion"] == {"rollout_seconds": 1.5,
+                                "rollback_total": 1,
+                                "join_cold_compiles": 0}
+    assert res["schema_version"] == 1 and "git_rev" in res
+
+
+def test_assemble_promotion_result_gates_fail_closed():
+    from bench import assemble_promotion_result
+
+    breakers = [
+        {"error": "boom"},
+        {"shadow_same": {"zero_diff": False, "max_abs_delta": 0.01}},
+        {"shadow_diff": {"zero_diff": False, "max_abs_delta": 0.0}},
+        {"roll": {"completed": False, "rollout_seconds": 1.5,
+                  "join_cold_compiles": 0}},
+        {"roll": {"completed": True, "rollout_seconds": None,
+                  "join_cold_compiles": 0}},
+        {"roll": {"completed": True, "rollout_seconds": 1.5,
+                  "join_cold_compiles": 1}},  # a cold join anywhere
+        {"rollback": {"rollback_total": 0, "join_cold_compiles": 0}},
+        {"responses_5xx": 3},
+        {"prior_rev_restored": False},
+        {"capture": {"written": 12, "dropped": 1}},  # invariant 20
+        {"capture": {"written": 0, "dropped": 0}},   # no traffic at all
+    ]
+    for override in breakers:
+        legs = {**_promotion_legs(), **override}
+        res = assemble_promotion_result(**legs)
+        assert res["ok"] is False, override
