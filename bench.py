@@ -497,7 +497,7 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
                           cache_hits, requests_total, errors_total,
                           concurrency=None, notes=None, fleet=None,
                           autoscale=None, cascade=None, frontend=None,
-                          admission=None):
+                          admission=None, federation=None):
     """ONE-line artifact for the serving stage (scripts/bench_serving.py).
 
     Shared between the load generator and the bench-contract test so the
@@ -512,8 +512,9 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
     ``cascade`` (an ``assemble_cascade_result`` block, from ``--cascade``
     runs) and ``frontend`` (an ``assemble_frontend_result`` block, from
     ``--frontend`` runs) and ``admission`` (an
-    ``assemble_admission_result`` block, from ``--overload`` runs) ride
-    along and AND their own ok."""
+    ``assemble_admission_result`` block, from ``--overload`` runs) and
+    ``federation`` (an ``assemble_federation_result`` block, from
+    ``--federation N`` runs) ride along and AND their own ok."""
     ok = (requests_total > 0 and errors_total == 0
           and requests_per_sec > 0
           and mean_batch_occupancy is not None
@@ -529,6 +530,8 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
         ok = ok and bool(frontend.get("ok"))
     if admission is not None:
         ok = ok and bool(admission.get("ok"))
+    if federation is not None:
+        ok = ok and bool(federation.get("ok"))
     return {
         "metric": "serve_requests_per_sec",
         "value": round(float(requests_per_sec), 2),
@@ -555,6 +558,7 @@ def assemble_serve_result(backend, device_kind, requests_per_sec, p50_ms,
         "cascade": cascade,
         "frontend": frontend,
         "admission": admission,
+        "federation": federation,
         "ok": ok,
         **_provenance_fields(),
     }
@@ -1324,6 +1328,96 @@ def assemble_promotion_result(n_replicas, capture, shadow_same, shadow_diff,
         "responses_5xx_total": int(responses_5xx or 0),
         "prior_rev_restored": bool(prior_rev_restored),
         "roll_completed": bool((roll or {}).get("completed")),
+        "notes": notes or {},
+        "error": error,
+        "ok": ok,
+        **_provenance_fields(),
+    }
+
+
+# federation gates (scripts/bench_serving.py --federation N): the
+# cell-killed sawtooth SIGKILLs one whole cell under 10x load and gates
+# invariant candidate 32 — losing any single cell loses no request: zero
+# client-visible 5xx across every phase, the spillover actually served
+# off the survivors, every shed carrying its
+# Retry-After, the killed cell healed and warm-rejoined (zero cold
+# compiles) inside the recovery deadline, and a promotion attempted
+# during the brownout refused/paused until recovery, then completed.
+FEDERATION_RECOVERY_DEADLINE_S = 60.0
+
+
+def assemble_federation_result(backend, device_kind, n_cells, nominal,
+                               killed, recovery, federation,
+                               cell_kill_recovery_s, rejoined,
+                               join_cold_compiles,
+                               promotion_refused_during_brownout,
+                               promotion_completed_after,
+                               notes=None, error=None):
+    """ONE-line ``federation`` block for ``bench_serving.py
+    --federation N``. ``nominal``/``killed``/``recovery`` are per-phase
+    collector dicts (requests, response-code histogram, Retry-After
+    presence on 429s); ``federation`` is the FederationRouter's own
+    metrics snapshot — the artifact doubles as the audit trail, exactly
+    like the admission block. The gates are the ISSUE 20 acceptance
+    criteria verbatim."""
+    def _codes(phase, pred):
+        return sum(n for code, n in (phase or {}).get("codes", {}).items()
+                   if pred(int(code)))
+
+    phases = [p for p in (nominal, killed, recovery) if p]
+    total_5xx = sum(_codes(p, lambda c: c >= 500) for p in phases)
+    fleetwide_5xx = max(total_5xx,
+                        int((federation or {}).get("fleetwide_5xx_total")
+                            or 0))
+    retry_after_missing = sum(int(p.get("retry_after_missing") or 0)
+                              for p in phases)
+    spillover_served = int((federation or {}).get("spillover_total") or 0)
+    spillover_errors = int((federation or {}).get("spillover_errors_total")
+                           or 0)
+    ok = (error is None
+          and int((nominal or {}).get("requests_total") or 0) > 0
+          and int((killed or {}).get("requests_total") or 0) > 0
+          and fleetwide_5xx == 0
+          and spillover_served > 0       # survivors actually absorbed it
+          # spillover_errors is deliberately NOT a hard gate: a spilled
+          # forward racing a dying cell is expected — what matters is the
+          # retry served it (zero 5xx above). The ledger tracks the count
+          # as a lower-is-better series instead.
+          and retry_after_missing == 0
+          and cell_kill_recovery_s is not None
+          and cell_kill_recovery_s <= FEDERATION_RECOVERY_DEADLINE_S
+          and bool(rejoined)
+          and int(join_cold_compiles or 0) == 0
+          and bool(promotion_refused_during_brownout)
+          and bool(promotion_completed_after))
+    return {
+        "metric": "federation_cell_kill_recovery_s",
+        "value": (None if cell_kill_recovery_s is None
+                  else round(float(cell_kill_recovery_s), 3)),
+        "unit": "s",
+        "backend": backend,
+        "device_kind": device_kind,
+        "n_cells": int(n_cells),
+        # the three ledger series (EXPLICIT_SERIES stage "federation") —
+        # top-level in this block so the serve artifact's nested
+        # "federation" key becomes their stage, the admission-block shape
+        "cell_kill_recovery_s": (
+            None if cell_kill_recovery_s is None
+            else round(float(cell_kill_recovery_s), 3)),
+        "spillover_errors": spillover_errors,
+        "fleetwide_5xx": fleetwide_5xx,
+        "recovery_deadline_s": FEDERATION_RECOVERY_DEADLINE_S,
+        "spillover_served": spillover_served,
+        "retry_after_missing": int(retry_after_missing),
+        "rejoined": bool(rejoined),
+        "join_cold_compiles": int(join_cold_compiles or 0),
+        "promotion_refused_during_brownout": bool(
+            promotion_refused_during_brownout),
+        "promotion_completed_after": bool(promotion_completed_after),
+        "nominal": nominal or {},
+        "killed": killed or {},
+        "recovery": recovery or {},
+        "federation_metrics": federation or {},
         "notes": notes or {},
         "error": error,
         "ok": ok,

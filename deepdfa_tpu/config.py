@@ -620,9 +620,63 @@ class ContinualConfig:
 
 
 @dataclass(frozen=True)
+class FederationConfig:
+    """Multi-cell federation knobs (``serve/federation.py``; CLI: ``--set
+    serve.federation.*``): the cell ring the :class:`FederationRouter`
+    fronts, the saturation watermarks that trigger spillover off a cell's
+    own ``/healthz`` + ``/slo`` truth (no new probes), and the drain
+    deadline for cell-level deploys. Off by default — a single-cell
+    deployment never pays for federation."""
+
+    enabled: bool = False
+    # the cell ring: each entry is the host:port of a cell's FleetRouter.
+    # Empty means the federation starts with no members (cells join via
+    # /admin/cells), mirroring FleetRouter's allow_empty bootstrap.
+    cells: tuple[str, ...] = ()
+    # virtual nodes per cell on the source-key-sticky hash ring
+    vnodes: int = 16
+    # cell health-probe cadence (GET /healthz + GET /slo per cell)
+    probe_interval_s: float = 1.0
+    # spillover watermarks — a cell is SATURATED (spill its sticky
+    # traffic to the least-burned healthy cell) when ANY of these trips:
+    # its reported brownout level, its frontend queue-wait p99, or its
+    # fast-window SLO burn rate
+    spill_brownout_level: int = 1
+    spill_queue_wait_p99_ms: float = 5000.0
+    spill_burn_high: float = 2.0
+    # cell-level drain: budget for the drained cell's in-flight forwards
+    # to finish after it has left the cell ring (flag-only, invariant 6)
+    drain_deadline_s: float = 30.0
+    # floor on the Retry-After a fleet-wide shed advertises when no cell
+    # supplied one (e.g. every cell was unreachable, not shedding)
+    retry_after_floor_s: int = 1
+
+    def __post_init__(self):
+        object.__setattr__(self, "cells", tuple(self.cells))
+        for cell in self.cells:
+            if not isinstance(cell, str) or ":" not in cell:
+                raise ValueError(
+                    f"cells entries must be 'host:port' strings, got {cell!r}")
+        if self.vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        if self.probe_interval_s <= 0:
+            raise ValueError("probe_interval_s must be > 0")
+        if not 1 <= self.spill_brownout_level <= 3:
+            raise ValueError("spill_brownout_level must be in [1, 3]")
+        if self.spill_queue_wait_p99_ms <= 0:
+            raise ValueError("spill_queue_wait_p99_ms must be > 0")
+        if self.spill_burn_high <= 0:
+            raise ValueError("spill_burn_high must be > 0")
+        if self.drain_deadline_s <= 0:
+            raise ValueError("drain_deadline_s must be > 0")
+        if self.retry_after_floor_s < 1:
+            raise ValueError("retry_after_floor_s must be >= 1")
+
+
+@dataclass(frozen=True)
 class ServeConfig:
-    """Online scoring service knobs (``deepdfa_tpu/serve``; CLI:
-    ``--set serve.*``): the micro-batching window, admission control, the
+    """Online scoring service knobs (``deepdfa_tpu/serve``; CLI: ``--set
+    serve.*``): the micro-batching window, admission control, the
     content-addressed scan cache, and the HTTP endpoint."""
 
     host: str = "127.0.0.1"
@@ -671,6 +725,9 @@ class ServeConfig:
     # continuous-learning loop (deepdfa_tpu/continual): traffic capture,
     # shadow replay, incremental retrain, checkpoint promotion
     continual: ContinualConfig = field(default_factory=ContinualConfig)
+    # multi-cell federation (serve/federation.py): spillover routing,
+    # cell-level drain, cell-kill survival
+    federation: FederationConfig = field(default_factory=FederationConfig)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -774,6 +831,7 @@ _NESTED: dict[tuple[str, str], type] = {
     ("ServeConfig", "frontend"): FrontendConfig,
     ("ServeConfig", "admission"): AdmissionConfig,
     ("ServeConfig", "continual"): ContinualConfig,
+    ("ServeConfig", "federation"): FederationConfig,
 }
 
 

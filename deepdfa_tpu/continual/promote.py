@@ -100,6 +100,26 @@ def _default_rev_probe(name: str, timeout: float = 5.0) -> str | None:
         conn.close()
 
 
+def _default_brownout_probe(name: str, timeout: float = 5.0) -> int:
+    """``brownout_level`` from a target's /healthz (a cell router
+    aggregates the worst backend level; a single replica reports its
+    own). An unreachable target reads as level 0 — brownout is a
+    *pressure* signal, and liveness is the roll's own probe's job."""
+    import http.client
+    import json as _json
+
+    host, port = name.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("GET", "/healthz")
+        body = _json.loads(conn.getresponse().read() or b"{}")
+        return int(body.get("brownout_level") or 0)
+    except (OSError, ValueError):
+        return 0
+    finally:
+        conn.close()
+
+
 def _default_drift_probe(name: str, timeout: float = 5.0) -> str:
     import http.client
 
@@ -134,6 +154,8 @@ class PromotionController:
                  alerts_path=None, veto_max_age_s: float = 3600.0,
                  state_journal=None, journal=None, flight=None,
                  rev_probe=None, drift_probe=None,
+                 brownout_probe=None, brownout_targets=None,
+                 brownout_pause_timeout_s: float = 60.0,
                  drift_settle_polls: int = 3, poll_interval_s: float = 0.5,
                  join_timeout_s: float = 120.0,
                  clock=time.monotonic, sleep=time.sleep,
@@ -150,6 +172,15 @@ class PromotionController:
         self._flight = flight
         self._rev_probe = rev_probe or _default_rev_probe
         self._drift_probe = drift_probe or _default_drift_probe
+        # brownout coordination (ROADMAP direction 1 residual): the
+        # controller never deploys INTO an overloaded target. Targets are
+        # the cells (or replicas) whose /healthz brownout_level gates the
+        # roll — an iterable of "host:port" names or a zero-arg callable
+        # returning one; None leaves the gate off (single-cell deploys
+        # that predate federation keep their exact behaviour).
+        self._brownout_probe = brownout_probe or _default_brownout_probe
+        self._brownout_targets = brownout_targets
+        self._brownout_pause_timeout_s = brownout_pause_timeout_s
         self._settle_polls = max(1, drift_settle_polls)
         self._poll_interval_s = poll_interval_s
         self._join_timeout_s = join_timeout_s
@@ -223,20 +254,72 @@ class PromotionController:
 
     # -- gates --------------------------------------------------------------
 
+    def _worst_brownout(self) -> tuple[int, str | None]:
+        """Worst ``brownout_level`` any target cell reports, and which
+        cell. Probe failures read as level 0 (pressure signal, not a
+        liveness gate)."""
+        targets = self._brownout_targets
+        if targets is None:
+            return 0, None
+        if callable(targets):
+            targets = targets()
+        worst, worst_name = 0, None
+        for name in targets:
+            try:
+                level = int(self._brownout_probe(name) or 0)
+            except Exception:  # noqa: BLE001 — an unprobeable target is
+                # not browned out; cell liveness is the roll's own problem
+                level = 0
+            if level > worst:
+                worst, worst_name = level, name
+        return worst, worst_name
+
     def check_gates(self, shadow_report=None) -> dict | None:
-        """Refusal decision, or None when both gates pass. Order matters:
-        the veto is the operator's hand on the big red button and is
-        checked first."""
+        """Refusal decision, or None when every gate passes. Order
+        matters: the veto is the operator's hand on the big red button
+        and is checked first; the brownout gate refuses to START a roll
+        into any target cell already shedding load (a deploy spends
+        spawn/compile/drain capacity exactly when the cell has none)."""
         veto = read_promotion_veto(self._alerts_path,
                                    max_age_s=self._veto_max_age_s,
                                    clock=self._wall_clock)
         if not veto["allow"]:
             return self._record("refused", gate="veto",
                                 reason=veto["reason"], veto=veto)
+        level, name = self._worst_brownout()
+        if level > 0:
+            return self._record(
+                "refused", gate="brownout",
+                reason=f"target {name} reports brownout_level {level}",
+                brownout_level=level, target=name)
         allow, reason = shadow_gate(shadow_report)
         if not allow:
             return self._record("refused", gate="shadow", reason=reason)
         return None
+
+    def _await_brownout_clear(self) -> None:
+        """Mid-roll pause: before each membership change the roll re-reads
+        the target cells' brownout level and HOLDS while any is > 0 —
+        resuming when it clears, raising (→ rollout_failed → rollback)
+        when the pause outlives ``brownout_pause_timeout_s``. Both
+        transitions are journaled/flight-mirrored (invariant 20)."""
+        level, name = self._worst_brownout()
+        if level <= 0:
+            return
+        self._record("paused", gate="brownout", brownout_level=level,
+                     target=name)
+        self._save_state("paused", brownout_level=level, target=name)
+        deadline = self._clock() + self._brownout_pause_timeout_s
+        while self._clock() < deadline:
+            self._sleep(self._poll_interval_s)
+            level, name = self._worst_brownout()
+            if level <= 0:
+                self._record("resumed", gate="brownout")
+                self._save_state("rolling")
+                return
+        raise RuntimeError(
+            f"brownout pause exceeded {self._brownout_pause_timeout_s}s "
+            f"(target {name} still at level {level})")
 
     # -- the roll -----------------------------------------------------------
 
@@ -292,6 +375,12 @@ class PromotionController:
         self._save_state("rolling", remaining_prior=prior)
         try:
             for i, old_name in enumerate(prior):
+                # brownout hold point: a roll caught by load mid-flight
+                # pauses BEFORE the next membership change and resumes
+                # when the cells recover. Rollback deliberately does NOT
+                # pause — restoring known-good capacity during a brownout
+                # is the correct move, not a deploy.
+                self._await_brownout_clear()
                 self._join_one(self._candidate_launcher, self.candidate_rev)
                 # the chaos point: a controller hard-exit between a
                 # candidate's warm join and the prior replica's retirement

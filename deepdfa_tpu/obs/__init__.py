@@ -11,6 +11,7 @@ from deepdfa_tpu.obs.registry import Family, MetricsRegistry, escape_label_value
 from deepdfa_tpu.obs.slo import (
     SLOEngine,
     SLOSpec,
+    federation_specs,
     router_specs,
     serve_specs,
     train_specs,
@@ -45,6 +46,7 @@ __all__ = [
     "TrainTelemetry",
     "chrome_trace",
     "escape_label_value",
+    "federation_specs",
     "install_sigusr2",
     "load_trace_records",
     "new_span_id",

@@ -160,6 +160,15 @@ EXPLICIT_SERIES: dict[tuple[str, str], bool] = {
     ("promotion", "rollout_seconds"): True,
     ("promotion", "rollback_total"): True,
     ("promotion", "join_cold_compiles"): True,
+    # the federation block (scripts/bench_serving.py --federation): a
+    # killed cell's heal-and-rejoin wall-clock goes down, and both
+    # violation counts — spilled forwards lost instead of retried, and
+    # 5xx leaked to clients while a cell was dead — are regressions of
+    # invariant candidate 32 at any nonzero value. Spillover VOLUME is
+    # the mechanism working, not a quality signal — untracked.
+    ("federation", "cell_kill_recovery_s"): True,
+    ("federation", "spillover_errors"): True,
+    ("federation", "fleetwide_5xx"): True,
 }
 
 

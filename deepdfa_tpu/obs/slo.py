@@ -203,7 +203,14 @@ class SLOEngine:
             ratio = max(0.0, head[1] - base[1]) / d_total
             budget = 1.0 - spec.target
             return min(_BURN_CAP, ratio / budget)
-        vals = [s[1] for s in dq if s[0] >= cutoff] or [head[1]]
+        vals = [s[1] for s in dq if s[0] >= cutoff]
+        if not vals:
+            # every gauge sample aged out of this window: no observations
+            # means no violation — mirroring the ratio branch above. The
+            # old fallback (reuse the last value forever) froze an idle
+            # replica at its final saturation reading, and a replica that
+            # reads saturated gets no traffic, so it could never recover.
+            return 0.0
         mean = sum(vals) / len(vals)
         if spec.kind == "max":
             if spec.target <= 0:
@@ -329,6 +336,21 @@ def router_specs(*, availability: float = 0.99,
         SLOSpec("availability", "ratio", availability,
                 bad="errors_total", total="requests_total"),
         SLOSpec("latency_p99", "max", p99_ms, value="latency_p99_ms"),
+    )
+
+
+def federation_specs(*, availability: float = 0.99,
+                     p99_ms: float = 2000.0) -> tuple[SLOSpec, ...]:
+    """Federation-tier objectives (invariant candidate 32). Availability
+    budgets 5xx ONLY — a fleet-wide 429 shed is correct behaviour per
+    request, a 5xx is a broken promise; ``spillover_errors`` pages the
+    moment a spilled forward is lost instead of retried."""
+    return (
+        SLOSpec("availability", "ratio", availability,
+                bad="fleetwide_5xx_total", total="requests_total"),
+        SLOSpec("latency_p99", "max", p99_ms, value="latency_p99_ms"),
+        SLOSpec("spillover_errors", "max", 0.0,
+                value="spillover_errors_total"),
     )
 
 

@@ -81,6 +81,9 @@ KNOWN_POINTS = (
     "continual.capture_drop",
     "continual.rollout_crash",
     "continual.rollback_trigger",
+    "federation.cell_kill",
+    "federation.spillover_drop",
+    "federation.probe_partition",
 )
 
 # One line per point; keys must equal KNOWN_POINTS (the analysis faults
@@ -181,6 +184,18 @@ POINT_DOCS = {
         "force the post-roll drift watch to fire against the candidate rev "
         "— the controller rolls back and the prior model_rev serves again "
         "(continual/promote.py)"),
+    "federation.cell_kill": (
+        "kill -9 one whole cell (its router and every replica) from the "
+        "federation probe loop — survivors absorb the sticky traffic with "
+        "zero client-visible 5xx (serve/federation.py)"),
+    "federation.spillover_drop": (
+        "drop one spilled-over forward on the wire — the federation "
+        "counts a spillover error and retries the next cell, never a 5xx "
+        "(serve/federation.py)"),
+    "federation.probe_partition": (
+        "partition one cell health probe — the probe reads as a socket "
+        "failure, the cell is marked down and rejoins on the next clean "
+        "probe (serve/federation.py)"),
 }
 
 

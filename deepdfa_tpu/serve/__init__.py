@@ -46,6 +46,7 @@ from .frontend import (
     VocabHashMismatch,
     encode_session_factory,
 )
+from .federation import Cell, FederationMetrics, FederationRouter
 from .metrics import LatencyReservoir, ServeMetrics
 from .router import Backend, FleetRouter, HashRing, RouterMetrics
 from .server import ScoreServer, build_server, serve_command
@@ -75,6 +76,9 @@ __all__ = [
     "LatencyReservoir",
     "ServeMetrics",
     "Backend",
+    "Cell",
+    "FederationMetrics",
+    "FederationRouter",
     "FleetRouter",
     "HashRing",
     "RouterMetrics",

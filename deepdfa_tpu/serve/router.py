@@ -307,6 +307,19 @@ class FleetRouter:
     def request_stop(self) -> None:
         self._stop_requested.set()
 
+    def request_drain(self) -> None:
+        """Flag-only cell-level drain (invariant 6 one level up): new
+        ``/score``s get 503, ``/healthz`` goes 503/``draining`` so the
+        federation drops this cell from its ring, in-flight forwards
+        finish. The process keeps serving — ``clear_drain`` reverses it."""
+        self._draining.set()
+
+    def clear_drain(self) -> None:
+        """Reverse a flag-only drain: the next federation probe finds the
+        cell healthy again and readmits it (readiness-gated, invariant
+        13). A SIGTERM-initiated stop is NOT reversible."""
+        self._draining.clear()
+
     def shutdown(self) -> dict:
         self._draining.set()
         self._stop_requested.set()
@@ -471,7 +484,14 @@ class FleetRouter:
                 continue
             b.forwarded += 1
             self.metrics.observe_forward(name)
-            return status, body, {"X-DeepDFA-Backend": name}
+            extra = {"X-DeepDFA-Backend": name}
+            if status == 429 and isinstance(body, dict) \
+                    and body.get("retry_after_s") is not None:
+                # a shed's deterministic Retry-After survives the proxy —
+                # the federation (and any client) reads the header, not
+                # the body (invariant 30)
+                extra["Retry-After"] = str(int(body["retry_after_s"]))
+            return status, body, extra
         self.metrics.inc("no_backend_total")
         return 503, {"error": "no ready backend for this key"}, {}
 
@@ -529,12 +549,47 @@ class FleetRouter:
         return (200 if removed else 404), {"backend": spec,
                                            "removed": removed}
 
+    def handle_admin_drain(self, raw: bytes) -> tuple[int, dict]:
+        """``POST /admin/drain``: ``{"action": "drain"|"undrain"}`` — the
+        federation's cell-level deploy surface. Drain is flag-only: this
+        router's ``/healthz`` goes 503/``draining`` (so the federation's
+        next probe drops the cell from its ring), new ``/score``s get
+        503, in-flight forwards finish. Undrain clears the flag; the cell
+        rejoins through the same readiness gate as a new member."""
+        try:
+            payload = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            return 400, {"error": "body is not valid JSON"}
+        action = payload.get("action") if isinstance(payload, dict) else None
+        if action not in ("drain", "undrain"):
+            return 400, {"error": "need {'action': 'drain'|'undrain'}"}
+        if action == "drain":
+            self.request_drain()
+        else:
+            self.clear_drain()
+        return 200, {"action": action, "draining": self.draining}
+
     def healthz(self) -> tuple[int, dict]:
         ready = sorted(self.ring.nodes)
+        # the cell tells the truth one level up: the worst backend's
+        # brownout level and queue-wait p99 ARE the cell's saturation
+        # signal — the federation spills on these, no new probes
+        brownout = 0
+        queue_wait = 0.0
+        for b in self._backend_list():
+            if b.state != "ready":
+                continue
+            brownout = max(brownout, int(b.health.get("brownout_level") or 0))
+            queue_wait = max(
+                queue_wait,
+                float(b.health.get("frontend_queue_wait_p99_ms") or 0.0))
         body = {
             "status": "draining" if self.draining else (
                 "ok" if ready else "no_ready_backends"),
             "draining": self.draining,
+            "warm": bool(ready),
+            "brownout_level": brownout,
+            "frontend_queue_wait_p99_ms": queue_wait,
             "ready_backends": ready,
             "backends": {b.name: {"state": b.state,
                                   "replica_id": b.health.get("replica_id"),
@@ -582,10 +637,13 @@ def _make_handler(router: FleetRouter):
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
-            if self.path == "/admin/backends":
+            if self.path in ("/admin/backends", "/admin/drain"):
+                handler = (router.handle_admin
+                           if self.path == "/admin/backends"
+                           else router.handle_admin_drain)
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
-                    code, body = router.handle_admin(self.rfile.read(length))
+                    code, body = handler(self.rfile.read(length))
                 except Exception as exc:  # noqa: BLE001
                     code, body = 500, {
                         "error": f"{type(exc).__name__}: {exc}"}

@@ -114,6 +114,9 @@ class ScoreServer:
             slow_window_s=obs.slo_slow_window_s,
             burn_threshold=obs.slo_burn_threshold,
             flight=self.flight)
+        # (responses_total, monotonic time it last changed) — the idle
+        # detector behind _slo_snapshot's stale-latency suppression
+        self._slo_traffic_mark = (0, time.monotonic())
         self.alerts_path = Path(obs.alerts_path) if obs.alerts_path else None
         self.metrics.tracer = self.tracer
         self.metrics.drift = self.drift
@@ -285,7 +288,15 @@ class ScoreServer:
     def _slo_snapshot(self) -> dict:
         """The flat snapshot the SLO specs read: response counters split
         by badness, the p99 gauge, and the drift sentinel's alert count
-        (the PR 8 PSI alert, wired into action here)."""
+        (the PR 8 PSI alert, wired into action here).
+
+        The latency gauges go ``None`` once no response has completed
+        within the fast SLO window: the reservoir quantile is a memory of
+        the LAST traffic, and a replica that reads as slow while serving
+        nothing can never be sent traffic to prove otherwise — the
+        federation's spillover demotion plus a frozen burn is a permanent
+        saturation deadlock. No traffic in the window means no latency
+        verdict, the same honesty rule the ratio burn already applies."""
         snap = self.metrics.snapshot()
         responses = snap.get("responses_total") or {}
         total = sum(responses.values())
@@ -293,14 +304,19 @@ class ScoreServer:
         errors = sum(n for code, n in responses.items() if int(code) >= 400)
         drift_alerting = sum(
             1 for row in self.drift.snapshot().values() if row["alert"])
+        now = time.monotonic()
+        if total != self._slo_traffic_mark[0]:
+            self._slo_traffic_mark = (total, now)
+        idle = (now - self._slo_traffic_mark[1]) >= self.slo.fast_window_s
         return {
             "responses_total": total,
             "responses_5xx_total": bad_5xx,
             "responses_error_total": errors,
-            "latency_p99_ms": snap.get("latency_p99_ms"),
+            "latency_p99_ms": None if idle else snap.get("latency_p99_ms"),
             "drift_alerting": drift_alerting,
             # cascade keys — read by the tier-2 specs when enabled
-            "tier2_latency_p99_ms": snap.get("tier2_latency_p99_ms"),
+            "tier2_latency_p99_ms": (None if idle
+                                     else snap.get("tier2_latency_p99_ms")),
             "cascade_escalated_total": snap.get("cascade_escalated_total"),
             "cascade_degraded_total": snap.get("cascade_degraded_total"),
         }

@@ -90,6 +90,25 @@ Retry-After header, zero 5xx anywhere (the interactive class above all),
 interactive sheds only after the ladder's last level, every decision
 journaled (zero drops), /healthz reported the degradation while it was
 happening, and the SLO burn the sawtooth paged stays within budget.
+
+``--federation N`` runs the cell-killed sawtooth: N complete cells (each
+ONE admission-enabled replica behind its own FleetRouter, all
+warm-joined from the shared store) behind a live
+:class:`~deepdfa_tpu.serve.FederationRouter`. A nominal trickle, then a
+``--load-x``× replay first saturates the fleet until saturation
+spillover is visible, then the ``federation.cell_kill`` fault SIGKILLs
+one whole cell from the federation's own probe loop; survivors absorb
+its keyspace. A promotion attempted mid-brownout must be REFUSED by the
+brownout gate; the killed cell heals (replacement replica warm-joins
+behind a fresh cell router, rejoins the federation through the readiness
+gate), a recovery trickle drains the ladder, and the SAME promotion then
+rolls a real perturbed-params candidate rev across the healed cell. The
+artifact gains a ``federation`` block
+(``bench.assemble_federation_result``) gated on invariant candidate 32:
+zero client-visible 5xx through the whole sawtooth, spillover served
+> 0 with zero spillover errors, every 429 carrying Retry-After, rejoin
+within the recovery deadline with ``join_cold_compiles == 0``, promotion
+refused during brownout and completed after recovery.
 """
 
 from __future__ import annotations
@@ -461,6 +480,69 @@ def _merge_admission_phase(acc: dict, part: dict) -> None:
         hist = acc["responses"].setdefault(cls, {})
         for code, cnt in codes.items():
             hist[code] = hist.get(code, 0) + cnt
+
+
+def _run_phase_codes(port: int, bodies: list[str], concurrency: int):
+    """Closed loop like :func:`_run_phase_admission`, classless: the
+    collector is a flat response-code histogram plus every 429 that
+    arrived WITHOUT its Retry-After header — the raw material of the
+    federation gates (``bench.assemble_federation_result``). A transport
+    failure is recorded as code 599 so it trips the zero-5xx gate
+    honestly (the federation FRONT must never die; cells may)."""
+    import http.client
+
+    next_i = {"i": 0}
+    lock = threading.Lock()
+    codes: dict[str, int] = {}
+    missing = {"n": 0}
+
+    def worker():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=180)
+        while True:
+            with lock:
+                i = next_i["i"]
+                if i >= len(bodies):
+                    break
+                next_i["i"] = i + 1
+            try:
+                conn.request("POST", "/score", body=bodies[i],
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                code = resp.status
+                retry_after = resp.getheader("Retry-After")
+            except Exception:
+                code, retry_after = 599, None
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=180)
+            with lock:
+                codes[str(code)] = codes.get(str(code), 0) + 1
+                if code == 429 and retry_after is None:
+                    missing["n"] += 1
+        conn.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return {
+        "requests_total": len(bodies),
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+        "codes": codes,
+        "retry_after_missing": missing["n"],
+    }
+
+
+def _merge_codes_phase(acc: dict, part: dict) -> None:
+    acc["requests_total"] += part["requests_total"]
+    acc["elapsed_s"] = round(acc["elapsed_s"] + part["elapsed_s"], 3)
+    acc["retry_after_missing"] += part["retry_after_missing"]
+    for code, cnt in part["codes"].items():
+        acc["codes"][code] = acc["codes"].get(code, 0) + cnt
 
 
 def _run_overload(ckpt, vocabs, base_sources, args, backend: str,
@@ -1167,6 +1249,358 @@ def _run_autoscale(ckpt, vocabs, bodies, args, warm_store_dir, backend: str,
         })
 
 
+def _run_federation(ckpt, vocabs, base_sources, args, warm_store_dir,
+                    backend: str, device_kind: str) -> dict:
+    """The cell-killed sawtooth (ISSUE 20, invariant candidate 32):
+    N complete cells — each ONE warm-joined replica behind its own
+    :class:`~deepdfa_tpu.serve.FleetRouter` — behind one live
+    :class:`~deepdfa_tpu.serve.FederationRouter`, five legs:
+
+    1. **nominal** — trickle through the federation; sticky routing,
+       zero sheds, zero 5xx.
+    2. **cell kill** — ``federation.cell_kill`` SIGKILLs one whole cell
+       (replica + router sockets) from the federation's own probe loop
+       while a ``load_x``× replay runs; survivors absorb the dead cell's
+       keyspace (the spillover counters are the evidence) and the lap
+       repeats until a survivor's brownout ladder visibly escalates.
+    3. **promotion refused** — a :class:`PromotionController` aimed at
+       the cells is asked to roll mid-brownout; the brownout gate must
+       refuse (journaled ``promotion_transition``, ROADMAP direction 1
+       residual).
+    4. **heal** — a replacement replica warm-joins from the shared store
+       (zero cold compiles) behind a fresh cell router, and the cell
+       rejoins the federation through the readiness gate; the recovery
+       clock runs from the kill to ready.
+    5. **recovery + promotion completes** — a trickle drains the
+       brownout ladder back to 0, then the SAME promotion (fresh
+       controller, same gates) rolls a real candidate rev across the
+       healed cell — staged warm, ``join_cold_compiles == 0``."""
+    import tempfile
+
+    import jax
+
+    from bench import assemble_federation_result
+
+    from deepdfa_tpu.config import (
+        AdmissionConfig,
+        FederationConfig,
+        ObsConfig,
+    )
+    from deepdfa_tpu.continual import PromotionController, stage_candidate
+    from deepdfa_tpu.continual.shadow import SCHEMA as SHADOW_SCHEMA
+    from deepdfa_tpu.obs.slo import write_alerts_artifact
+    from deepdfa_tpu.resilience import faults
+    from deepdfa_tpu.resilience.journal import RunJournal
+    from deepdfa_tpu.serve import FederationRouter, FleetRouter, WarmStore
+    from deepdfa_tpu.serve.engine import ScoringEngine
+
+    n_cells = args.federation
+    store = WarmStore(warm_store_dir)
+    jdir = Path(tempfile.mkdtemp(prefix="deepdfa-federation-"))
+    # the overload stage's admission shape: generous interactive budget
+    # (sheds come from the ladder, not the bucket), short brownout
+    # hysteresis + short SLO windows so the ladder tracks the sawtooth
+    adm = AdmissionConfig(
+        enabled=True,
+        interactive_rate=500.0, interactive_burst=100_000.0,
+        batch_rate=1.0, batch_burst=4.0,
+        interactive_deadline_ms=120_000.0, batch_deadline_ms=1_000.0,
+        brownout=True, burn_high=1.4, burn_low=0.8,
+        up_consecutive=2, down_consecutive=4,
+        cooldown_s=1.0, poll_interval_s=0.25, max_level=3)
+    obs = ObsConfig(slo_p99_ms=100.0, slo_fast_window_s=2.0,
+                    slo_slow_window_s=4.0)
+
+    class _Replica:
+        """In-process replica handle (the autoscale stage's duck type);
+        ``kill()`` closes the listening socket abruptly — kill -9."""
+
+        def __init__(self, server, report, replica_id):
+            self.server = server
+            self.host = "127.0.0.1"
+            self.port = server.port
+            self.name = f"127.0.0.1:{server.port}"
+            self.replica_id = replica_id
+            self.join_cold_compiles = report["misses"]
+            self._exit = None
+
+        def poll(self):
+            return self._exit
+
+        def drain(self):
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
+
+        def kill(self):
+            self._exit = 137
+            try:
+                self.server.httpd.shutdown()
+                self.server.httpd.server_close()
+            except OSError:
+                pass
+
+    spawned = {"n": 0}
+
+    def _spawn_replica(ckpt_for, tag):
+        i = spawned["n"]
+        spawned["n"] += 1
+        srv = _make_server(ckpt_for, vocabs, args.max_batch,
+                           args.max_wait_ms, warm_store=store,
+                           journal=RunJournal(jdir / f"{tag}{i}.json"),
+                           replica_id=f"{tag}{i}", latency_window=64,
+                           obs=obs, admission=adm)
+        report = srv.warmup()  # warm join off the shared store
+        srv.start()
+        return _Replica(srv, report, f"{tag}{i}")
+
+    class _CellLauncher:
+        """PromotionController-facing launcher: spawns a replica of one
+        rev into the HEALED cell (the roll's target)."""
+
+        def __init__(self, ckpt_for, tag):
+            self.ckpt_for = ckpt_for
+            self.tag = tag
+            self.handles = []
+
+        def spawn(self):
+            h = _spawn_replica(self.ckpt_for, self.tag)
+            self.handles.append(h)
+            return h
+
+    # ---- stand up N cells + the federation front
+    cells: dict[str, dict] = {}
+    for i in range(n_cells):
+        replica = _spawn_replica(ckpt, f"cell{i}r")
+        router = FleetRouter([], port=0, probe_interval_s=0.2,
+                             allow_empty=True)
+        router.start(probe=True)
+        router.add_backend(replica.name)
+        cells[f"127.0.0.1:{router.port}"] = {
+            "router": router, "replicas": [replica], "index": i}
+
+    kill_info = {"t": None, "victim": None}
+
+    def _kill_hook(name):
+        cell = cells.get(name)
+        if cell is None:
+            return
+        kill_info["t"] = time.perf_counter()
+        kill_info["victim"] = name
+        for r in cell["replicas"]:
+            r.kill()
+        try:
+            cell["router"].httpd.shutdown()
+            cell["router"].httpd.server_close()
+        except OSError:
+            pass
+
+    fcfg = FederationConfig(
+        enabled=True, vnodes=16, probe_interval_s=0.2,
+        spill_brownout_level=1, spill_queue_wait_p99_ms=5000.0,
+        spill_burn_high=2.0, drain_deadline_s=5.0, retry_after_floor_s=1)
+    fed = FederationRouter(cells=list(cells), cfg=fcfg,
+                           kill_hook=_kill_hook)
+    fed.start(probe=True)
+
+    def _live_brownout_max():
+        level = 0
+        for name, cell in cells.items():
+            if name == kill_info["victim"]:
+                continue
+            for r in cell["replicas"]:
+                if r.poll() is None and r.server.brownout is not None:
+                    level = max(level, r.server.brownout.level)
+        return level
+
+    bodies = [json.dumps({"source": _uniq_source(
+                  base_sources[i % len(base_sources)], 800_000 + i),
+                  "class": "interactive"})
+              for i in range(max(8, args.requests // 2))]
+    cell_addrs = list(cells)
+    alerts = write_alerts_artifact(jdir / "alerts.json", [])
+    shadow_report = {"schema": SHADOW_SCHEMA, "pass": True,
+                     "max_psi": 0.0, "max_abs_delta": 0.01,
+                     "synthetic": "bench_serving --federation"}
+
+    # the candidate rev: same architecture, perturbed params — a REAL,
+    # distinct model_rev whose warm ladder is staged before the roll
+    ckpt_cand = dict(ckpt)
+    ckpt_cand["params"] = jax.tree.map(
+        lambda x: x * (1 + 1e-6), ckpt["params"])
+
+    def _controller(name):
+        return PromotionController(
+            _roll_router(), cand_launcher, prior_launcher,
+            candidate_rev=cand_rev, prior_rev=prior_rev,
+            alerts_path=alerts,
+            journal=RunJournal(jdir / f"decisions_{name}.json"),
+            state_journal=RunJournal(jdir / f"state_{name}.json"),
+            brownout_targets=lambda: cell_addrs,
+            brownout_pause_timeout_s=5.0,
+            drift_settle_polls=2, poll_interval_s=0.1,
+            join_timeout_s=60.0)
+
+    error = None
+    nominal = killed = recovery = None
+    cell_kill_recovery_s = None
+    rejoined = False
+    join_cold = 0
+    refused_during_brownout = False
+    completed_after = False
+    heal_router = None
+    cand_launcher = prior_launcher = None
+    fsnap = {}
+    try:
+        # ---- leg 1: nominal trickle
+        nominal = _run_phase_codes(fed.port, bodies, concurrency=2)
+
+        # ---- leg 2: load_x× load in two movements. First saturate the
+        # live fleet until the federation visibly spills (one cell's
+        # ladder escalates → its keyspace prefers the least-burned
+        # sibling); THEN arm federation.cell_kill so the probe loop
+        # SIGKILLs a whole cell mid-replay and the survivors absorb its
+        # keyspace. Both movements land in the same ``killed`` phase —
+        # the gate reads one histogram: zero 5xx through all of it.
+        killed = {"requests_total": 0, "elapsed_s": 0.0, "codes": {},
+                  "retry_after_missing": 0}
+        high = bodies * args.load_x
+        t_high = time.perf_counter()
+        while True:
+            _merge_codes_phase(
+                killed, _run_phase_codes(fed.port, high, args.concurrency))
+            snap = fed.metrics.snapshot()
+            if int(snap.get("spillover_total") or 0) >= 1 \
+                    or time.perf_counter() - t_high > 20.0:
+                break
+        faults.install("federation.cell_kill@1")
+        t_kill = time.perf_counter()
+        while True:
+            _merge_codes_phase(
+                killed, _run_phase_codes(fed.port, high, args.concurrency))
+            if kill_info["victim"] is not None \
+                    and (_live_brownout_max() >= 1
+                         or time.perf_counter() - t_kill > 25.0):
+                break
+            if time.perf_counter() - t_kill > 40.0:
+                break
+        faults.clear()
+        brownout_seen = _live_brownout_max()
+
+        # ---- leg 3: a promotion attempted mid-brownout must be REFUSED
+        # by the brownout gate (before the shadow gate even runs)
+        prior_rev = None
+        for cell in cells.values():
+            for r in cell["replicas"]:
+                if r.poll() is None:
+                    prior_rev = r.server.engine.model_rev
+        cand_engine = ScoringEngine.from_model(
+            ckpt_cand["model"], ckpt_cand["params"],
+            ckpt_cand["label_style"], feat_keys=ckpt_cand["feat_keys"],
+            max_batch=args.max_batch, vocab_hash=ckpt_cand["vocab_hash"])
+        cand_rev = cand_engine.model_rev
+        cand_launcher = _CellLauncher(ckpt_cand, "cand")
+        prior_launcher = _CellLauncher(ckpt, "prior")
+
+        def _roll_router():
+            return (heal_router if heal_router is not None
+                    else next(iter(cells.values()))["router"])
+
+        pc = _controller("refusal")
+        refusal = pc.check_gates(shadow_report)
+        refused_during_brownout = (
+            refusal is not None and refusal.get("gate") == "brownout"
+            and brownout_seen >= 1)
+
+        # ---- leg 4: heal — replacement replica warm-joins behind a
+        # fresh cell router, the cell rejoins through the readiness gate
+        heal_replica = _spawn_replica(ckpt, "heal")
+        join_cold += heal_replica.join_cold_compiles
+        heal_router = FleetRouter([], port=0, probe_interval_s=0.2,
+                                  allow_empty=True)
+        heal_router.start(probe=True)
+        heal_router.add_backend(heal_replica.name)
+        victim = kill_info["victim"]
+        if victim is not None:
+            fed.remove_cell(victim)
+            old = cells.pop(victim)
+            heal_name = f"127.0.0.1:{heal_router.port}"
+            cells[heal_name] = {"router": heal_router,
+                                "replicas": [heal_replica],
+                                "index": old["index"]}
+            cell_addrs = list(cells)
+            cell = fed.add_cell(heal_name)
+            deadline = time.perf_counter() + 30.0
+            while cell.state != "ready" \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.1)
+                fed.probe_once()
+            rejoined = cell.state == "ready"
+            if rejoined and kill_info["t"] is not None:
+                cell_kill_recovery_s = time.perf_counter() - kill_info["t"]
+
+        # ---- leg 5a: recovery trickle until the ladder drains
+        recovery = {"requests_total": 0, "elapsed_s": 0.0, "codes": {},
+                    "retry_after_missing": 0}
+        t_low = time.perf_counter()
+        while _live_brownout_max() > 0 \
+                and time.perf_counter() - t_low < 30.0:
+            _merge_codes_phase(
+                recovery, _run_phase_codes(fed.port, bodies, concurrency=2))
+        if not recovery["requests_total"]:
+            _merge_codes_phase(
+                recovery, _run_phase_codes(fed.port, bodies, concurrency=2))
+
+        # ---- leg 5b: the SAME promotion now completes — staged warm,
+        # rolled replica-by-replica across the healed cell
+        stage_candidate(cand_engine, store)
+        roll = _controller("roll")
+        for h in ([heal_replica] if rejoined else []):
+            roll.adopt(h)
+        roll_summary = roll.promote(shadow_report)
+        join_cold += int(roll_summary.get("join_cold_compiles") or 0)
+        completed_after = bool(roll_summary.get("completed"))
+    except Exception as exc:  # noqa: BLE001 — the artifact records the
+        # failure; the gate turns it into ok=False
+        error = f"{type(exc).__name__}: {exc}"
+    finally:
+        faults.clear()
+        fsnap = fed.shutdown()
+        for cell in cells.values():
+            try:
+                cell["router"].shutdown()
+            except Exception:  # noqa: BLE001 — the killed cell's router
+                # is already gone
+                pass
+            for r in cell["replicas"]:
+                try:
+                    r.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+        for launcher in (cand_launcher, prior_launcher):
+            for h in getattr(launcher, "handles", None) or []:
+                try:
+                    h.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    return assemble_federation_result(
+        backend=backend, device_kind=device_kind, n_cells=n_cells,
+        nominal=nominal, killed=killed, recovery=recovery,
+        federation=fsnap,
+        cell_kill_recovery_s=cell_kill_recovery_s,
+        rejoined=rejoined, join_cold_compiles=join_cold,
+        promotion_refused_during_brownout=refused_during_brownout,
+        promotion_completed_after=completed_after,
+        notes={
+            "victim": kill_info["victim"],
+            "load_x": args.load_x,
+            "replicas_spawned": spawned["n"],
+            "journal_dir": str(jdir),
+            "spill_brownout_level": fcfg.spill_brownout_level,
+        },
+        error=error)
+
+
 def main(argv=None) -> dict:
     import argparse
     import tempfile
@@ -1226,6 +1660,14 @@ def main(argv=None) -> dict:
                     "recovery trickle; gates the explicit-overload "
                     "contract (429+Retry-After sheds, zero 5xx, batch "
                     "first, interactive last, honest /healthz)")
+    ap.add_argument("--federation", type=int, default=0,
+                    help="N>=2: run the multi-cell federation sawtooth — N "
+                    "complete cells (replica + cell router) behind a "
+                    "FederationRouter, one cell SIGKILLed mid-load by the "
+                    "federation.cell_kill fault; gates zero client 5xx, "
+                    "spillover served, warm cell rejoin, and the "
+                    "promotion brownout gate (refused during, completes "
+                    "after)")
     ap.add_argument("--cascade", action="store_true",
                     help="run the two-tier cascade stage: a no-cascade "
                     "baseline phase doubles as the tier-1 score oracle, "
@@ -1237,6 +1679,8 @@ def main(argv=None) -> dict:
         ap.error("--fleet needs N >= 2 (the baseline IS the single replica)")
     if args.autoscale == 1:
         ap.error("--autoscale needs N >= 2 (min_replicas is 2)")
+    if args.federation == 1:
+        ap.error("--federation needs N >= 2 (one cell cannot spill over)")
 
     backend = jax.default_backend()
     device_kind = jax.devices()[0].device_kind
@@ -1248,7 +1692,7 @@ def main(argv=None) -> dict:
     ]
 
     warm_store = journal0 = warm_dir = None
-    if args.fleet or args.autoscale:
+    if args.fleet or args.autoscale or args.federation:
         from deepdfa_tpu.resilience.journal import RunJournal
         from deepdfa_tpu.serve import WarmStore
 
@@ -1297,6 +1741,13 @@ def main(argv=None) -> dict:
         admission = _run_overload(ckpt, vocabs, base_sources, args,
                                   backend=backend, device_kind=device_kind)
 
+    federation = None
+    if args.federation:
+        federation = _run_federation(ckpt, vocabs, base_sources, args,
+                                     warm_store_dir=warm_dir,
+                                     backend=backend,
+                                     device_kind=device_kind)
+
     tiers = tier_precision = tier_refusal = None
     if args.tier_requests > 0:
         tiers, tier_precision, tier_refusal = _precision_tiers(
@@ -1322,6 +1773,7 @@ def main(argv=None) -> dict:
         cascade=cascade,
         frontend=frontend,
         admission=admission,
+        federation=federation,
         notes={
             "cold_requests_per_sec": round(len(bodies) / cold_s, 2),
             "hot_requests_per_sec": round(len(bodies) / hot_s, 2),

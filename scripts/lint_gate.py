@@ -21,7 +21,11 @@ buckets, deterministic Retry-After, brownout ladder, priority-inversion
 torture, the three ``admission.*`` chaos points) + the
 continuous-learning suite (``pytest -m 'continual and not slow'``:
 capture no-fail rule, shadow zero-diff, fail-closed veto reader, the
-promotion controller's roll/rollback/converge paths) + the
+promotion controller's roll/rollback/converge paths) + the multi-cell
+federation suite (``pytest -m 'federation and not slow'``: sticky/
+spillover routing, cross-cell shed semantics, cell-kill failover with
+zero 5xx, flag-only drain, the promotion brownout gate, the three
+``federation.*`` chaos points) + the
 invariant gate (``python -m deepdfa_tpu.analysis``: atomic-commit,
 lock-order, jit-purity/donation, fault-registry, fault-arming coverage,
 metrics conformance static passes) + the perf-regression ledger
@@ -188,6 +192,19 @@ def main() -> int:
         cwd=REPO)
     if proc.returncode != 0:
         failures.append("continual")
+
+    # the multi-cell federation suite: sticky/spillover routing plan,
+    # cross-cell shed semantics and cell-kill failover through REAL
+    # ScoreServers behind a live FederationRouter, flag-only drain, the
+    # promotion brownout gate, and the three federation.* chaos points —
+    # stub engines only, so no compile and pre-commit cadence
+    print("lint_gate: pytest -m 'federation and not slow'")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-m", "federation and not slow",
+         "-q", "tests/test_federation.py"],
+        cwd=REPO)
+    if proc.returncode != 0:
+        failures.append("federation")
 
     # step 5: the invariant gate — AST passes for atomic-commit,
     # lock-order, jit-purity/donation, fault-registry, fault-arming

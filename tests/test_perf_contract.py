@@ -599,3 +599,131 @@ def test_serve_result_ands_autoscale_block():
             **_autoscale_kwargs(errors_total=2)))
     assert bad["autoscale"]["ok"] is False
     assert bad["ok"] is False  # the autoscale failure surfaces at the top
+
+
+# --------------------------------------------------------------- federation
+
+
+def _fed_phase(total=20, codes=None, retry_after_missing=0):
+    return {"requests_total": total,
+            "codes": codes or {"200": total},
+            "retry_after_missing": retry_after_missing}
+
+
+def _fed_kwargs(**over):
+    """A fully-green --federation artifact; tests flip one knob at a
+    time (the ISSUE 20 acceptance criteria verbatim)."""
+    kw = dict(
+        backend="cpu", device_kind="cpu", n_cells=2,
+        nominal=_fed_phase(20),
+        killed=_fed_phase(60, codes={"200": 60}),
+        recovery=_fed_phase(20),
+        federation={"spillover_total": 12, "spillover_errors_total": 0,
+                    "fleetwide_shed_total": 0, "fleetwide_5xx_total": 0},
+        cell_kill_recovery_s=1.7, rejoined=True, join_cold_compiles=0,
+        promotion_refused_during_brownout=True,
+        promotion_completed_after=True)
+    kw.update(over)
+    return kw
+
+
+def test_federation_schema_and_green_gate():
+    art = bench.assemble_federation_result(**_fed_kwargs())
+    assert art["metric"] == "federation_cell_kill_recovery_s"
+    assert art["unit"] == "s"
+    assert art["value"] == 1.7 == art["cell_kill_recovery_s"]
+    # the three ledger series are TOP-LEVEL keys of this block, so the
+    # serve artifact's nested "federation" key becomes their stage
+    assert art["spillover_errors"] == 0
+    assert art["fleetwide_5xx"] == 0
+    assert art["recovery_deadline_s"] == bench.FEDERATION_RECOVERY_DEADLINE_S
+    assert art["spillover_served"] == 12
+    assert art["rejoined"] is True and art["join_cold_compiles"] == 0
+    assert art["promotion_refused_during_brownout"] is True
+    assert art["promotion_completed_after"] is True
+    assert art["ok"] is True
+    assert PROVENANCE_KEYS <= set(art)
+
+
+@pytest.mark.parametrize("knob, value", [
+    ("error", "cell spawn failed"),
+    ("nominal", None),                       # the baseline leg never ran
+    ("killed", _fed_phase(0)),               # no traffic during the kill
+    ("cell_kill_recovery_s", None),          # the heal was never measured
+    ("cell_kill_recovery_s", 120.0),         # heal blew the deadline
+    ("rejoined", False),                     # killed cell never came back
+    ("join_cold_compiles", 2),               # rejoin compiled cold
+    ("promotion_refused_during_brownout", False),
+    ("promotion_completed_after", False),
+])
+def test_federation_gate_rejects_bad_knob(knob, value):
+    art = bench.assemble_federation_result(**_fed_kwargs(**{knob: value}))
+    assert art["ok"] is False
+
+
+def test_federation_gate_zero_5xx_is_absolute():
+    """Invariant candidate 32: ONE client-visible 5xx in ANY phase — or
+    one the router counted itself — fails the stage."""
+    art = bench.assemble_federation_result(**_fed_kwargs(
+        killed=_fed_phase(60, codes={"200": 59, "502": 1})))
+    assert art["fleetwide_5xx"] == 1 and art["ok"] is False
+    art = bench.assemble_federation_result(**_fed_kwargs(
+        federation={"spillover_total": 12, "fleetwide_5xx_total": 1}))
+    assert art["fleetwide_5xx"] == 1 and art["ok"] is False
+
+
+def test_federation_gate_requires_spillover_and_retry_after():
+    """The kill leg must prove survivors ABSORBED the dead cell's
+    keyspace, and every shed 429 must carry its deterministic
+    Retry-After."""
+    art = bench.assemble_federation_result(**_fed_kwargs(
+        federation={"spillover_total": 0, "fleetwide_5xx_total": 0}))
+    assert art["ok"] is False
+    art = bench.assemble_federation_result(**_fed_kwargs(
+        killed=_fed_phase(60, codes={"200": 58, "429": 2},
+                          retry_after_missing=1)))
+    assert art["retry_after_missing"] == 1 and art["ok"] is False
+
+
+def test_federation_spilled_forward_racing_a_death_is_not_a_failure():
+    """A spilled forward that dies on the wire and is RETRIED to a 200 is
+    expected chaos, not a red run: spillover_errors is a lower-is-better
+    ledger series, not a hard gate (the zero-5xx gate already proves the
+    retry served it)."""
+    art = bench.assemble_federation_result(**_fed_kwargs(
+        federation={"spillover_total": 12, "spillover_errors_total": 3,
+                    "fleetwide_5xx_total": 0}))
+    assert art["spillover_errors"] == 3
+    assert art["ok"] is True
+
+
+def test_federation_shed_429s_do_not_count_as_errors():
+    """Honest backpressure during the kill (429 + Retry-After) is within
+    contract — only 5xx ever gates."""
+    art = bench.assemble_federation_result(**_fed_kwargs(
+        killed=_fed_phase(60, codes={"200": 55, "429": 5})))
+    assert art["ok"] is True
+
+
+def test_serve_result_ands_federation_block():
+    """The serving artifact carries the federation block and ANDs its
+    ok, like fleet/autoscale — the nested "federation" key is the ledger
+    stage for the three series."""
+    serve_kw = dict(backend="cpu", device_kind="cpu", requests_per_sec=50.0,
+                    p50_ms=5.0, p99_ms=20.0, mean_batch_occupancy=3.0,
+                    cache_hit_rate=0.5, cache_hits=10, requests_total=100,
+                    errors_total=0)
+    solo = bench.assemble_serve_result(**serve_kw)
+    assert solo["ok"] is True and solo["federation"] is None
+
+    good = bench.assemble_serve_result(
+        **serve_kw,
+        federation=bench.assemble_federation_result(**_fed_kwargs()))
+    assert good["ok"] is True and good["federation"]["ok"] is True
+
+    bad = bench.assemble_serve_result(
+        **serve_kw,
+        federation=bench.assemble_federation_result(
+            **_fed_kwargs(rejoined=False)))
+    assert bad["federation"]["ok"] is False
+    assert bad["ok"] is False  # federation failure surfaces at the top
